@@ -1,0 +1,42 @@
+"""dmlc_tpu: a TPU-native rebuild of the dmlc-core capability surface.
+
+This package provides, idiomatically for TPU (JAX/XLA) + native C++ where the
+reference is native:
+
+- ``dmlc_tpu.utils``   — logging/CHECK/Error, timer, common helpers
+  (reference: include/dmlc/logging.h, timer.h, common.h)
+- ``dmlc_tpu.params``  — Parameter/Registry/Config/env spine
+  (reference: include/dmlc/parameter.h, registry.h, config.h)
+- ``dmlc_tpu.io``      — Stream/SeekStream, FileSystem plugins, URI dispatch,
+  RecordIO format, InputSplit sharding machinery
+  (reference: include/dmlc/io.h, recordio.h, src/io/)
+- ``dmlc_tpu.data``    — RowBlock CSR batches, libsvm/libfm/csv parsers,
+  row iterators, threaded prefetch pipelines
+  (reference: include/dmlc/data.h, src/data/)
+- ``dmlc_tpu.device``  — the TPU-new part: CSR batches bucketed/padded into
+  static-shape XLA device buffers, async H2D overlap, per-host sharding
+- ``dmlc_tpu.collective`` — rabit-style Allreduce/Broadcast/CheckPoint over a
+  jax.sharding.Mesh (ICI/DCN collectives) plus a CPU socket path
+  (reference: the tracker side of rabit bootstrap, tracker/dmlc_tracker/)
+- ``dmlc_tpu.tracker`` — dmlc-submit-compatible launcher with ``--cluster=tpu``
+  (reference: tracker/dmlc_tracker/)
+- ``dmlc_tpu.models`` / ``dmlc_tpu.ops`` / ``dmlc_tpu.parallel`` — demo
+  allreduce-SGD learners, sparse ops (SpMV), mesh/sharding helpers
+
+The native C++ core (streams, RecordIO, InputSplit, parsers, prefetcher) lives
+in ``cpp/`` and is loaded through ``dmlc_tpu.native`` (ctypes); every native
+component has a pure-Python twin so the package works before the .so is built.
+"""
+
+__version__ = "0.1.0"
+
+from dmlc_tpu.utils.logging import DMLCError, check, log_info, log_warning, log_error
+
+__all__ = [
+    "DMLCError",
+    "check",
+    "log_info",
+    "log_warning",
+    "log_error",
+    "__version__",
+]

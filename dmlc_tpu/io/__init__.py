@@ -1,0 +1,66 @@
+"""I/O layer: streams, filesystems, URI dispatch, serialization.
+
+Reference capabilities mirrored: include/dmlc/io.h (Stream/SeekStream/
+Serializable + factory), src/io/filesys.h (FileSystem plugin interface),
+src/io.cc (protocol dispatch), include/dmlc/memory_io.h (in-memory streams),
+include/dmlc/serializer.h (typed binary serialization), src/io/uri_spec.h.
+RecordIO and InputSplit live in sibling modules of this package.
+"""
+
+from dmlc_tpu.io.stream import (
+    Stream,
+    SeekStream,
+    MemoryStream,
+    FixedMemoryStream,
+    Serializable,
+)
+from dmlc_tpu.io.serializer import save_obj, load_obj
+from dmlc_tpu.io.filesystem import (
+    URI,
+    FileInfo,
+    FileSystem,
+    LocalFileSystem,
+    MemoryFileSystem,
+    register_filesystem,
+    get_filesystem,
+    create_stream,
+    create_stream_for_read,
+    expand_uri_patterns,
+    list_split_files,
+)
+from dmlc_tpu.io.uri_spec import URISpec
+from dmlc_tpu.io.recordio import (
+    RECORDIO_MAGIC,
+    RecordIOWriter,
+    RecordIOReader,
+    RecordIOChunkReader,
+)
+from dmlc_tpu.io.input_split import InputSplit, create_input_split
+
+__all__ = [
+    "Stream",
+    "SeekStream",
+    "MemoryStream",
+    "FixedMemoryStream",
+    "Serializable",
+    "save_obj",
+    "load_obj",
+    "URI",
+    "FileInfo",
+    "FileSystem",
+    "LocalFileSystem",
+    "MemoryFileSystem",
+    "register_filesystem",
+    "get_filesystem",
+    "create_stream",
+    "create_stream_for_read",
+    "expand_uri_patterns",
+    "list_split_files",
+    "URISpec",
+    "RECORDIO_MAGIC",
+    "RecordIOWriter",
+    "RecordIOReader",
+    "RecordIOChunkReader",
+    "InputSplit",
+    "create_input_split",
+]

@@ -1,0 +1,884 @@
+"""InputSplit: the data-parallel sharding primitive.
+
+Capability parity with the reference's InputSplit machinery (io.h:135-282,
+src/io/input_split_base.{h,cc}, line_split, recordio_split,
+indexed_recordio_split, threaded_input_split.h, cached_input_split.h,
+include/dmlc/input_split_shuffle.h):
+
+- part k of n over a multi-file byte-ranged dataset with **exactly-once**
+  record coverage: partition boundaries are aligned byte offsets, then moved
+  forward to the next record boundary (ResetPartition,
+  input_split_base.cc:30-64) so every record belongs to exactly one part
+- chunked reading that never yields partial records: a tail ``overflow``
+  buffer holds bytes after the last record head, and the chunk buffer doubles
+  until it holds at least one whole record (ReadChunk/Chunk::Load,
+  input_split_base.cc:211-279)
+- record types: "text" (newline records), "recordio" (magic-framed binary),
+  "indexed_recordio" (record-count-equal parts via an index file, optional
+  per-epoch shuffle with a seeded RNG — indexed_recordio_split.cc)
+- decorators: background-thread chunk prefetch (threaded_input_split.h,
+  capacity 2, applied by default), first-epoch disk cache
+  (cached_input_split.h, selected by ``#cachefile``), and "global" shuffle by
+  visiting ``num_shuffle_parts`` sub-splits in seeded random order per epoch
+  (input_split_shuffle.h)
+
+TPU framing: one part per TPU host feeds that host's chips; parts are the
+per-process shards a jax.sharding mesh consumes (see dmlc_tpu.device).
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dmlc_tpu.io import recordio as _rio
+from dmlc_tpu.io.filesystem import (
+    FileInfo,
+    URI,
+    create_stream,
+    get_filesystem,
+    list_split_files,
+)
+from dmlc_tpu.io.stream import SeekStream, Stream
+from dmlc_tpu.io.uri_spec import URISpec
+from dmlc_tpu.utils.logging import DMLCError, check, check_eq
+from dmlc_tpu.utils.threaded_iter import ThreadedIter
+
+# 8 MiB chunk buffer, matching kBufferSize = 2UL<<20 uint32 words x 4 bytes
+# (src/io/input_split_base.h:39-40).
+DEFAULT_CHUNK_BYTES = (2 << 20) * 4
+
+
+class InputSplit:
+    """Abstract record/chunk pull API (io.h:135-282)."""
+
+    def next_record(self) -> Optional[bytes]:
+        """Next single record, or None at end of this part's data."""
+        raise NotImplementedError
+
+    def next_chunk(self) -> Optional[bytes]:
+        """Next multi-record chunk (for multithreaded parsing), or None."""
+        raise NotImplementedError
+
+    def next_batch(self, n_records: int) -> Optional[bytes]:
+        """Chunk of ~n_records records where supported (io.h:210)."""
+        return self.next_chunk()
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise NotImplementedError
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        pass
+
+    def get_total_size(self) -> int:
+        raise NotImplementedError
+
+    def records(self) -> Iterator[bytes]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
+
+    def chunks(self) -> Iterator[bytes]:
+        while True:
+            chunk = self.next_chunk()
+            if chunk is None:
+                return
+            yield chunk
+
+    def close(self) -> None:
+        pass
+
+
+class InputSplitBase(InputSplit):
+    """Multi-file byte-range splitting core (src/io/input_split_base.*)."""
+
+    def __init__(
+        self,
+        uri: str,
+        align_bytes: int,
+        recurse_directories: bool = False,
+    ):
+        self._files: List[FileInfo] = list_split_files(uri, recurse_directories)
+        self._file_offset = [0]
+        for info in self._files:
+            check(
+                info.size % align_bytes == 0,
+                "file %s does not align by %d bytes",
+                info.path.str_full(),
+                align_bytes,
+            )
+            self._file_offset.append(self._file_offset[-1] + info.size)
+        self._align = align_bytes
+        self._chunk_bytes = DEFAULT_CHUNK_BYTES
+        self._fs_stream: Optional[SeekStream] = None
+        self._file_ptr = 0
+        self._offset_begin = 0
+        self._offset_end = 0
+        self._offset_curr = 0
+        self._overflow = b""
+        self._pending_records: List[bytes] = []
+        self._pending_idx = 0
+
+    # ---- subclass hooks -----------------------------------------------
+    def seek_record_begin(self, stream: Stream) -> int:
+        """Read forward to the next record start; return bytes skipped."""
+        raise NotImplementedError
+
+    def find_last_record_begin(self, buf: bytes) -> int:
+        """Offset of the last record head in buf (0 when none found)."""
+        raise NotImplementedError
+
+    def extract_records(self, chunk: bytes) -> List[bytes]:
+        """Split a whole-records chunk into individual records."""
+        raise NotImplementedError
+
+    # ---- partitioning (input_split_base.cc:30-64) ----------------------
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        ntotal = self._file_offset[-1]
+        nstep = (ntotal + num_parts - 1) // num_parts
+        align = self._align
+        nstep = ((nstep + align - 1) // align) * align
+        begin = min(nstep * part_index, ntotal)
+        end = min(nstep * (part_index + 1), ntotal)
+        self._offset_begin = begin
+        self._offset_end = end
+        self._offset_curr = begin
+        if begin == end:
+            self._close_stream()
+            self.before_first()
+            return
+        # Find the exact end: seek to the raw boundary in the file containing
+        # it and extend to the next record begin.
+        file_end = self._file_index_for(end)
+        if end != self._file_offset[file_end]:
+            check(end > self._file_offset[file_end], "bad end offset")
+            check(file_end < len(self._files), "bad end offset")
+            fs = self._open(file_end)
+            fs.seek(end - self._file_offset[file_end])
+            self._offset_end = end + self.seek_record_begin(fs)
+            fs.close()
+        # Find the exact begin likewise.
+        self._file_ptr = self._file_index_for(begin)
+        fs = self._open(self._file_ptr)
+        if begin != self._file_offset[self._file_ptr]:
+            fs.seek(begin - self._file_offset[self._file_ptr])
+            self._offset_begin = begin + self.seek_record_begin(fs)
+        fs.close()
+        self.before_first()
+
+    def _file_index_for(self, offset: int) -> int:
+        # index i with file_offset[i] <= offset < file_offset[i+1]
+        import bisect
+
+        return bisect.bisect_right(self._file_offset, offset) - 1
+
+    def _open(self, file_index: int) -> SeekStream:
+        path = self._files[file_index].path
+        stream = get_filesystem(path).open_for_read(path)
+        assert stream is not None
+        return stream
+
+    def _close_stream(self) -> None:
+        if self._fs_stream is not None:
+            self._fs_stream.close()
+            self._fs_stream = None
+
+    def before_first(self) -> None:
+        self._pending_records = []
+        self._pending_idx = 0
+        self._overflow = b""
+        if self._offset_begin >= self._offset_end:
+            return
+        self._close_stream()
+        self._file_ptr = self._file_index_for(self._offset_begin)
+        self._fs_stream = self._open(self._file_ptr)
+        self._fs_stream.seek(self._offset_begin - self._file_offset[self._file_ptr])
+        self._offset_curr = self._offset_begin
+
+    # ---- raw reading across file boundaries (input_split_base.cc:177-209)
+    def _read_range(self, size: int) -> bytes:
+        if self._offset_begin >= self._offset_end or self._fs_stream is None:
+            return b""
+        size = min(size, self._offset_end - self._offset_curr)
+        if size <= 0:
+            return b""
+        parts: List[bytes] = []
+        nleft = size
+        while nleft > 0:
+            data = self._fs_stream.read(nleft)
+            if data:
+                parts.append(data)
+                nleft -= len(data)
+                self._offset_curr += len(data)
+                continue
+            # End of current file: verify bookkeeping, move to the next file.
+            check_eq(
+                self._offset_curr,
+                self._file_offset[self._file_ptr + 1],
+                "file offset not calculated correctly",
+            )
+            if self._file_ptr + 1 >= len(self._files):
+                break
+            self._file_ptr += 1
+            self._close_stream()
+            self._fs_stream = self._open(self._file_ptr)
+        return b"".join(parts)
+
+    # ---- chunk loading (ReadChunk + Chunk::Load semantics) -------------
+    def _load_chunk(self) -> Optional[bytes]:
+        """Next chunk containing only whole records, or None at end."""
+        target = self._chunk_bytes
+        buf = bytearray(self._overflow)
+        self._overflow = b""
+        while True:
+            data = self._read_range(target - len(buf))
+            buf.extend(data)
+            if not buf:
+                return None
+            if len(buf) < target:
+                # End of the partition range: remainder is the final chunk
+                # (its end was extended to a record boundary).
+                return bytes(buf)
+            pos = self.find_last_record_begin(bytes(buf))
+            if pos == 0:
+                # No record boundary inside: grow and read more
+                # (Chunk::Load doubling, input_split_base.cc:241-258).
+                target *= 2
+                continue
+            self._overflow = bytes(buf[pos:])
+            del buf[pos:]
+            return bytes(buf)
+
+    # ---- public API ----------------------------------------------------
+    def next_chunk(self) -> Optional[bytes]:
+        return self._load_chunk()
+
+    def next_record(self) -> Optional[bytes]:
+        while self._pending_idx >= len(self._pending_records):
+            chunk = self._load_chunk()
+            if chunk is None:
+                return None
+            self._pending_records = self.extract_records(chunk)
+            self._pending_idx = 0
+        rec = self._pending_records[self._pending_idx]
+        self._pending_idx += 1
+        return rec
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._chunk_bytes = max(chunk_size, self._align)
+
+    def get_total_size(self) -> int:
+        return self._file_offset[-1]
+
+    def close(self) -> None:
+        self._close_stream()
+
+
+class LineSplitter(InputSplitBase):
+    """Text records, one per line (src/io/line_split.{h,cc}).
+
+    Runs of ``\\n``/``\\r`` collapse: empty lines do not produce records,
+    matching the reference's ExtractNextRecord scan (line_split.cc:36-55).
+    """
+
+    def __init__(self, uri: str, recurse_directories: bool = False):
+        super().__init__(uri, align_bytes=1, recurse_directories=recurse_directories)
+
+    def seek_record_begin(self, stream: Stream) -> int:
+        nstep = 0
+        # scan to the first end-of-line (line_split.cc:9-26)
+        while True:
+            c = stream.read(1)
+            if not c:
+                return nstep
+            nstep += 1
+            if c in (b"\n", b"\r"):
+                break
+        # consume the rest of the newline run (not counted toward the skip
+        # except for the newline bytes themselves)
+        while True:
+            c = stream.read(1)
+            if not c:
+                return nstep
+            if c not in (b"\n", b"\r"):
+                break
+            nstep += 1
+        return nstep
+
+    def find_last_record_begin(self, buf: bytes) -> int:
+        pos_n = buf.rfind(b"\n", 1)
+        pos_r = buf.rfind(b"\r", 1)
+        pos = max(pos_n, pos_r)
+        return pos + 1 if pos >= 0 else 0
+
+    def extract_records(self, chunk: bytes) -> List[bytes]:
+        return [line for line in chunk.splitlines() if line]
+
+
+class RecordIOSplitter(InputSplitBase):
+    """Magic-framed binary records (src/io/recordio_split.{h,cc})."""
+
+    def __init__(self, uri: str, recurse_directories: bool = False):
+        super().__init__(uri, align_bytes=4, recurse_directories=recurse_directories)
+
+    def seek_record_begin(self, stream: Stream) -> int:
+        # Scan forward one u32 at a time for a record head: magic followed by
+        # an lrecord with cflag 0 or 1 (recordio_split.cc:9-24).
+        nstep = 0
+        while True:
+            word = stream.read(4)
+            if not word:
+                return nstep
+            nstep += 4
+            if struct.unpack("<I", word)[0] == _rio.RECORDIO_MAGIC:
+                lrec_b = stream.read(4)
+                check(len(lrec_b) == 4, "invalid recordio format")
+                nstep += 4
+                cflag = _rio.decode_flag(struct.unpack("<I", lrec_b)[0])
+                if cflag in (0, 1):
+                    return nstep - 8
+
+    def find_last_record_begin(self, buf: bytes) -> int:
+        check_eq(len(buf) % 4, 0, "recordio chunk must stay 4B-aligned")
+        words = np.frombuffer(buf, dtype="<u4")
+        hits = np.nonzero(words[:-1] == _rio.RECORDIO_MAGIC)[0]
+        if hits.size:
+            flags = (words[hits + 1] >> 29) & 7
+            good = hits[(flags == 0) | (flags == 1)]
+            if good.size:
+                pos = int(good[-1]) << 2
+                if pos != 0:
+                    return pos
+        return 0
+
+    def extract_records(self, chunk: bytes) -> List[bytes]:
+        return list(_rio.RecordIOChunkReader(chunk))
+
+
+class IndexedRecordIOSplitter(InputSplitBase):
+    """Record-count-equal partitioning of RecordIO via an index file
+    (src/io/indexed_recordio_split.{h,cc}).
+
+    The index file holds whitespace-separated ``index offset`` pairs; offsets
+    are sorted and turned into (offset, size) spans (ReadIndexFile,
+    indexed_recordio_split.cc:43-61). Partitioning assigns equal **record
+    counts** per part; ``shuffle=True`` visits the part's records in a fresh
+    seeded permutation each epoch (BeforeFirst, indexed_recordio_split.cc,
+    seed mixed with kRandMagic=111).
+    """
+
+    K_RAND_MAGIC = 111
+
+    def __init__(
+        self,
+        uri: str,
+        index_uri: str,
+        batch_size: int = 256,
+        shuffle: bool = False,
+        seed: int = 0,
+        recurse_directories: bool = False,
+    ):
+        super().__init__(uri, align_bytes=4, recurse_directories=recurse_directories)
+        self._index: List[Tuple[int, int]] = []  # (offset, size)
+        self._read_index_file(index_uri)
+        self.batch_size = batch_size
+        self._shuffle = shuffle
+        # One persistent engine seeded once, reshuffled every epoch — like the
+        # reference's member mt19937 (indexed_recordio_split.h:55-57).
+        self._rng = np.random.Generator(np.random.MT19937(self.K_RAND_MAGIC + seed))
+        self._index_begin = 0
+        self._index_end = 0
+        self._current = 0
+        self._n_overflow = 0
+        self._permutation: List[int] = []
+
+    def _read_index_file(self, index_uri: str) -> None:
+        stream = create_stream(index_uri, "r")
+        assert stream is not None
+        text_parts = []
+        while True:
+            data = stream.read(1 << 20)
+            if not data:
+                break
+            text_parts.append(data)
+        stream.close()
+        tokens = b"".join(text_parts).split()
+        check(len(tokens) % 2 == 0, "invalid index file: odd token count")
+        offsets = sorted(int(tokens[i + 1]) for i in range(0, len(tokens), 2))
+        check(len(offsets) > 0, "empty index file")
+        total = self._file_offset[-1]
+        for i, off in enumerate(offsets):
+            nxt = offsets[i + 1] if i + 1 < len(offsets) else total
+            self._index.append((off, nxt - off))
+
+    # Record-count partitioning (indexed_recordio_split.cc:12-41).
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        ntotal = len(self._index)
+        nstep = (ntotal + num_parts - 1) // num_parts
+        if part_index * nstep >= ntotal:
+            self._index_begin = self._index_end = 0
+            self._offset_begin = self._offset_end = 0
+            self.before_first()
+            return
+        self._index_begin = part_index * nstep
+        self._index_end = min((part_index + 1) * nstep, ntotal)
+        self._offset_begin = self._index[self._index_begin][0]
+        last_off, last_size = self._index[self._index_end - 1]
+        self._offset_end = last_off + last_size
+        self.before_first()
+
+    def before_first(self) -> None:
+        self._pending_records = []
+        self._pending_idx = 0
+        self._overflow = b""
+        self._n_overflow = 0
+        if self._shuffle:
+            perm = np.arange(self._index_begin, self._index_end)
+            self._rng.shuffle(perm)
+            self._permutation = [int(i) for i in perm]
+            self._current = 0
+        else:
+            self._current = self._index_begin
+        self._offset_curr = self._offset_begin
+        self._close_stream()
+        if self._offset_begin < self._offset_end:
+            self._file_ptr = self._file_index_for(self._offset_begin)
+            self._fs_stream = self._open(self._file_ptr)
+            self._fs_stream.seek(
+                self._offset_begin - self._file_offset[self._file_ptr]
+            )
+
+    def _read_span(self, offset: int, size: int) -> bytes:
+        """Read an absolute [offset, offset+size) span across files."""
+        file_idx = self._file_index_for(offset)
+        if self._fs_stream is None or file_idx != self._file_ptr:
+            self._close_stream()
+            self._file_ptr = file_idx
+            self._fs_stream = self._open(file_idx)
+        self._fs_stream.seek(offset - self._file_offset[file_idx])
+        self._offset_curr = offset
+        parts: List[bytes] = []
+        nleft = size
+        while nleft > 0:
+            data = self._fs_stream.read(nleft)
+            if not data:
+                check(
+                    self._file_ptr + 1 < len(self._files),
+                    "index points past end of data",
+                )
+                self._file_ptr += 1
+                self._close_stream()
+                self._fs_stream = self._open(self._file_ptr)
+                continue
+            parts.append(data)
+            nleft -= len(data)
+            self._offset_curr += len(data)
+        return b"".join(parts)
+
+    def next_batch(self, n_records: int) -> Optional[bytes]:
+        """A chunk holding the next ~n_records records (honors the reference's
+        n_overflow carry: a short batch is completed before a new one starts,
+        NextBatchEx indexed_recordio_split.cc:158-211)."""
+        n = self._n_overflow if self._n_overflow else n_records
+        if self._shuffle:
+            out: List[bytes] = []
+            n_read = 0
+            while n_read < n and self._current < len(self._permutation):
+                off, size = self._index[self._permutation[self._current]]
+                out.append(self._read_span(off, size))
+                self._current += 1
+                n_read += 1
+            if n_read == 0:
+                return None
+            self._n_overflow = n - n_read
+            return b"".join(out)
+        if self._current >= self._index_end:
+            return None
+        last = min(self._current + n, self._index_end)
+        self._n_overflow = self._current + n - last
+        begin_off = self._index[self._current][0]
+        end_off, end_size = self._index[last - 1]
+        span = self._read_span(begin_off, end_off + end_size - begin_off)
+        self._current = last
+        return span
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self.next_batch(self.batch_size)
+
+    def next_record(self) -> Optional[bytes]:
+        while self._pending_idx >= len(self._pending_records):
+            chunk = self.next_chunk()
+            if chunk is None:
+                return None
+            self._pending_records = list(_rio.RecordIOChunkReader(chunk))
+            self._pending_idx = 0
+        rec = self._pending_records[self._pending_idx]
+        self._pending_idx += 1
+        return rec
+
+    def seek_record_begin(self, stream: Stream) -> int:  # pragma: no cover
+        raise DMLCError("indexed recordio does not seek by scanning")
+
+    def find_last_record_begin(self, buf: bytes) -> int:  # pragma: no cover
+        raise DMLCError("indexed recordio does not split chunks by scanning")
+
+    def extract_records(self, chunk: bytes) -> List[bytes]:
+        return list(_rio.RecordIOChunkReader(chunk))
+
+
+class SingleFileSplit(InputSplit):
+    """stdin / single-file fallback without partitioning
+    (src/io/single_file_split.h; selected for uri == "stdin",
+    src/io.cc:95-97). Text records only."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._pending: List[bytes] = []
+        self._idx = 0
+        self._chunk_bytes = DEFAULT_CHUNK_BYTES
+        self._tail = b""
+        self._eof = False
+        self._stream = None
+        self.before_first()
+
+    def _open(self):
+        if self._path == "stdin":
+            return sys.stdin.buffer
+        return open(self._path, "rb")
+
+    def before_first(self) -> None:
+        if self._stream is not None and self._path != "stdin":
+            self._stream.close()
+            self._stream = None
+        self._stream = self._open()
+        self._pending = []
+        self._idx = 0
+        self._tail = b""
+        self._eof = False
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        check_eq(num_parts, 1, "SingleFileSplit does not support partitioning")
+        self.before_first()
+
+    def next_chunk(self) -> Optional[bytes]:
+        if self._eof and not self._tail:
+            return None
+        data = self._stream.read(self._chunk_bytes)
+        if not data:
+            self._eof = True
+            out, self._tail = self._tail, b""
+            return out or None
+        buf = self._tail + data
+        pos = max(buf.rfind(b"\n"), buf.rfind(b"\r")) + 1
+        if pos == 0:
+            out, self._tail = b"", buf
+            # keep reading until we find a boundary or EOF
+            nxt = self.next_chunk()
+            return nxt
+        self._tail = buf[pos:]
+        return buf[:pos]
+
+    def next_record(self) -> Optional[bytes]:
+        while self._idx >= len(self._pending):
+            chunk = self.next_chunk()
+            if chunk is None:
+                return None
+            self._pending = [ln for ln in chunk.splitlines() if ln]
+            self._idx = 0
+        rec = self._pending[self._idx]
+        self._idx += 1
+        return rec
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._chunk_bytes = chunk_size
+
+    def get_total_size(self) -> int:
+        import os
+
+        if self._path == "stdin":
+            return 0
+        return os.path.getsize(self._path)
+
+
+# ---------------------------------------------------------------------------
+# Decorators
+# ---------------------------------------------------------------------------
+
+
+class ThreadedInputSplit(InputSplit):
+    """Background-thread chunk prefetch, queue capacity 2
+    (src/io/threaded_input_split.h:33). Applied by default by the factory."""
+
+    def __init__(self, base: InputSplitBase, capacity: int = 2):
+        self._base = base
+        self._iter = ThreadedIter(
+            self._chunk_source, max_capacity=capacity, name="input-split-prefetch"
+        )
+        self._pending: List[bytes] = []
+        self._idx = 0
+
+    def _chunk_source(self) -> Iterator[bytes]:
+        while True:
+            chunk = self._base.next_chunk()
+            if chunk is None:
+                return
+            yield chunk
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._iter.next()
+
+    def next_record(self) -> Optional[bytes]:
+        while self._idx >= len(self._pending):
+            chunk = self.next_chunk()
+            if chunk is None:
+                return None
+            self._pending = self._base.extract_records(chunk)
+            self._idx = 0
+        rec = self._pending[self._idx]
+        self._idx += 1
+        return rec
+
+    def before_first(self) -> None:
+        self._iter.close()
+        self._base.before_first()
+        self._iter.before_first()
+        self._pending = []
+        self._idx = 0
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        self._iter.close()
+        self._base.reset_partition(part_index, num_parts)
+        self._iter.before_first()
+        self._pending = []
+        self._idx = 0
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._base.hint_chunk_size(chunk_size)
+
+    def get_total_size(self) -> int:
+        return self._base.get_total_size()
+
+    def close(self) -> None:
+        self._iter.close()
+        self._base.close()
+
+
+class CachedInputSplit(InputSplit):
+    """First epoch streams chunks AND writes ``[u64 size][bytes]`` frames to a
+    local cache file; later epochs replay the cache instead of the (possibly
+    remote) source (src/io/cached_input_split.h:148-189)."""
+
+    PREFETCH = 16  # cached_input_split.h:151
+
+    def __init__(self, base: InputSplitBase, cache_file: str):
+        import os
+
+        self._base = base
+        self._cache_file = cache_file
+        self._cache_ready = os.path.exists(cache_file)
+        self._tmp_file = cache_file + ".tmp"
+        self._iter = ThreadedIter(
+            self._chunk_source, max_capacity=self.PREFETCH, name="cached-split"
+        )
+
+    def _chunk_source(self) -> Iterator[bytes]:
+        import os
+
+        if self._cache_ready:
+            with open(self._cache_file, "rb") as fp:
+                while True:
+                    head = fp.read(8)
+                    if len(head) < 8:
+                        return
+                    (size,) = struct.unpack("<Q", head)
+                    yield fp.read(size)
+        else:
+            with open(self._tmp_file, "wb") as out:
+                while True:
+                    chunk = self._base.next_chunk()
+                    if chunk is None:
+                        break
+                    out.write(struct.pack("<Q", len(chunk)))
+                    out.write(chunk)
+                    yield chunk
+            os.replace(self._tmp_file, self._cache_file)
+            self._cache_ready = True
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._iter.next()
+
+    def next_record(self) -> Optional[bytes]:
+        raise DMLCError(
+            "CachedInputSplit is chunk-only (cached_input_split.h:57-60)"
+        )
+
+    def before_first(self) -> None:
+        self._iter.close()
+        if not self._cache_ready:
+            self._base.before_first()
+        self._iter.before_first()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise DMLCError("CachedInputSplit cannot repartition after caching")
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._base.hint_chunk_size(chunk_size)
+
+    def get_total_size(self) -> int:
+        return self._base.get_total_size()
+
+    def close(self) -> None:
+        self._iter.close()
+        self._base.close()
+
+
+class InputSplitShuffle(InputSplit):
+    """"Global" shuffle: split this part into ``num_shuffle_parts`` sub-splits
+    and visit them in a fresh seeded random order each epoch
+    (include/dmlc/input_split_shuffle.h:24-33,138-147)."""
+
+    def __init__(
+        self,
+        make_split,  # Callable[[int, int], InputSplit] for (sub_part, total)
+        part_index: int,
+        num_parts: int,
+        num_shuffle_parts: int,
+        seed: int = 0,
+    ):
+        self._make_split = make_split
+        self._part_index = part_index
+        self._num_parts = num_parts
+        self._num_shuffle = num_shuffle_parts
+        self._rng = np.random.Generator(np.random.MT19937(seed))
+        self._split: Optional[InputSplit] = None
+        self._order: List[int] = []
+        self._pos = 0
+        self.before_first()
+
+    def before_first(self) -> None:
+        self._order = [
+            self._part_index * self._num_shuffle + i for i in range(self._num_shuffle)
+        ]
+        self._rng.shuffle(self._order)
+        self._pos = 0
+        self._advance()
+
+    def _advance(self) -> None:
+        if self._split is not None:
+            self._split.close()
+            self._split = None
+        if self._pos < len(self._order):
+            self._split = self._make_split(
+                self._order[self._pos], self._num_parts * self._num_shuffle
+            )
+            self._pos += 1
+
+    def next_record(self) -> Optional[bytes]:
+        while self._split is not None:
+            rec = self._split.next_record()
+            if rec is not None:
+                return rec
+            self._advance()
+        return None
+
+    def next_chunk(self) -> Optional[bytes]:
+        while self._split is not None:
+            chunk = self._split.next_chunk()
+            if chunk is not None:
+                return chunk
+            self._advance()
+        return None
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        self._part_index = part_index
+        self._num_parts = num_parts
+        self.before_first()
+
+    def get_total_size(self) -> int:
+        return self._split.get_total_size() if self._split else 0
+
+    def close(self) -> None:
+        if self._split is not None:
+            self._split.close()
+
+
+# ---------------------------------------------------------------------------
+# Factory (io.h:241-281 + src/io.cc:82-131)
+# ---------------------------------------------------------------------------
+
+
+def create_input_split(
+    uri: str,
+    part_index: int,
+    num_parts: int,
+    split_type: str = "text",
+    *,
+    index_uri: str = "",
+    shuffle: bool = False,
+    seed: int = 0,
+    batch_size: int = 256,
+    recurse_directories: bool = False,
+    num_shuffle_parts: int = 0,
+    threaded: bool = True,
+) -> InputSplit:
+    """InputSplit::Create.
+
+    ``split_type`` ∈ {"text", "recordio", "indexed_recordio"}; a
+    ``#cachefile`` suffix on the uri selects the disk-cache decorator
+    (src/io.cc:120-125); ``uri == "stdin"`` selects SingleFileSplit
+    (src/io.cc:95-97); prefetch is applied by default like the reference.
+    ``num_shuffle_parts > 0`` wraps in InputSplitShuffle.
+    """
+    if uri == "stdin":
+        return SingleFileSplit(uri)
+    spec = URISpec(uri, part_index, num_parts)
+    if num_shuffle_parts > 0:
+        check(not spec.cache_file, "shuffle splits do not combine with cache files")
+
+        def make_sub(sub_part: int, total: int) -> InputSplit:
+            return create_input_split(
+                spec.uri,
+                sub_part,
+                total,
+                split_type,
+                index_uri=index_uri,
+                batch_size=batch_size,
+                recurse_directories=recurse_directories,
+                threaded=threaded,
+            )
+
+        return InputSplitShuffle(
+            make_sub, part_index, num_parts, num_shuffle_parts, seed=seed
+        )
+
+    base: InputSplitBase
+    if split_type == "text":
+        base = LineSplitter(spec.uri, recurse_directories)
+    elif split_type == "recordio":
+        base = RecordIOSplitter(spec.uri, recurse_directories)
+    elif split_type == "indexed_recordio":
+        check(bool(index_uri), "indexed_recordio requires index_uri")
+        base = IndexedRecordIOSplitter(
+            spec.uri,
+            index_uri,
+            batch_size=batch_size,
+            shuffle=shuffle,
+            seed=seed,
+            recurse_directories=recurse_directories,
+        )
+    else:
+        raise DMLCError(f"unknown input split type {split_type!r}")
+    base.reset_partition(part_index, num_parts)
+    if spec.cache_file:
+        return CachedInputSplit(base, spec.cache_file)
+    if threaded:
+        return ThreadedInputSplit(base)
+    return base

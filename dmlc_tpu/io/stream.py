@@ -1,0 +1,191 @@
+"""Stream / SeekStream abstractions and in-memory implementations.
+
+Capability parity: ``dmlc::Stream`` Read/Write (reference io.h:29-86),
+``SeekStream`` (io.h:89-107), ``Serializable`` (io.h:112-126), and the
+in-memory streams of memory_io.h (MemoryFixedSizeStream:21,
+MemoryStringStream:66).
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+import struct
+from typing import Optional, Protocol, runtime_checkable
+
+
+class Stream:
+    """Abstract byte stream.
+
+    ``read(n)`` returns up to ``n`` bytes (b"" at EOF); ``write(data)`` writes
+    all bytes. Typed helpers mirror the reference's templated Write/Read
+    (io.h:68-86): little-endian fixed-width scalars and length-prefixed blobs.
+    """
+
+    def read(self, nbytes: int) -> bytes:
+        raise NotImplementedError
+
+    def write(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "Stream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- exact-size reads ---------------------------------------------
+    def read_exact(self, nbytes: int) -> bytes:
+        """Read exactly nbytes or raise EOFError (partial read at EOF raises)."""
+        chunks = []
+        remaining = nbytes
+        while remaining > 0:
+            chunk = self.read(remaining)
+            if not chunk:
+                raise EOFError(
+                    f"Stream ended: wanted {nbytes} bytes, got {nbytes - remaining}"
+                )
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+    def try_read_exact(self, nbytes: int) -> Optional[bytes]:
+        """Like read_exact but returns None on clean EOF at a record boundary."""
+        first = self.read(nbytes)
+        if not first:
+            return None
+        if len(first) == nbytes:
+            return first
+        rest = self.read_exact(nbytes - len(first))
+        return first + rest
+
+    # ---- typed scalar helpers (little-endian, like the reference on all
+    # supported platforms — endian.h) -----------------------------------
+    def write_fmt(self, fmt: str, *values) -> None:
+        self.write(struct.pack("<" + fmt, *values))
+
+    def read_fmt(self, fmt: str):
+        size = struct.calcsize("<" + fmt)
+        vals = struct.unpack("<" + fmt, self.read_exact(size))
+        return vals if len(vals) > 1 else vals[0]
+
+    def write_uint32(self, v: int) -> None:
+        self.write_fmt("I", v)
+
+    def read_uint32(self) -> int:
+        return self.read_fmt("I")
+
+    def write_uint64(self, v: int) -> None:
+        self.write_fmt("Q", v)
+
+    def read_uint64(self) -> int:
+        return self.read_fmt("Q")
+
+    def write_bytes_prefixed(self, data: bytes) -> None:
+        """Length(u64)-prefixed blob — matches Stream::Write(std::string)
+        shape (serializer.h string handler)."""
+        self.write_uint64(len(data))
+        self.write(data)
+
+    def read_bytes_prefixed(self) -> bytes:
+        return self.read_exact(self.read_uint64())
+
+
+class SeekStream(Stream):
+    """Stream with random access (reference io.h:89-107)."""
+
+    def seek(self, pos: int) -> None:
+        raise NotImplementedError
+
+    def tell(self) -> int:
+        raise NotImplementedError
+
+
+@runtime_checkable
+class Serializable(Protocol):
+    """Objects that can round-trip through a Stream (reference io.h:112-126)."""
+
+    def save(self, stream: Stream) -> None: ...
+
+    def load(self, stream: Stream) -> None: ...
+
+
+class FileObjStream(SeekStream):
+    """Adapter from any Python binary file object (reference dmlc::istream/
+    ostream adapters play the inverse role, io.h:298-422)."""
+
+    def __init__(self, fileobj, seekable: bool = True):
+        self._f = fileobj
+        self._seekable = seekable
+
+    def read(self, nbytes: int) -> bytes:
+        return self._f.read(nbytes)
+
+    def write(self, data: bytes) -> None:
+        self._f.write(data)
+
+    def seek(self, pos: int) -> None:
+        self._f.seek(pos)
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class MemoryStream(SeekStream):
+    """Growable in-memory stream (reference MemoryStringStream,
+    memory_io.h:66-102)."""
+
+    def __init__(self, data: bytes = b""):
+        self._buf = _pyio.BytesIO(data)
+
+    def read(self, nbytes: int) -> bytes:
+        return self._buf.read(nbytes)
+
+    def write(self, data: bytes) -> None:
+        self._buf.write(data)
+
+    def seek(self, pos: int) -> None:
+        self._buf.seek(pos)
+
+    def tell(self) -> int:
+        return self._buf.tell()
+
+    def getvalue(self) -> bytes:
+        return self._buf.getvalue()
+
+
+class FixedMemoryStream(SeekStream):
+    """SeekStream over a fixed-size caller-owned buffer (reference
+    MemoryFixedSizeStream, memory_io.h:21-63): writes past the end raise."""
+
+    def __init__(self, buf: bytearray | memoryview):
+        self._view = memoryview(buf)
+        self._pos = 0
+
+    def read(self, nbytes: int) -> bytes:
+        end = min(self._pos + nbytes, len(self._view))
+        out = bytes(self._view[self._pos : end])
+        self._pos = end
+        return out
+
+    def write(self, data: bytes) -> None:
+        end = self._pos + len(data)
+        if end > len(self._view):
+            raise IOError(
+                f"FixedMemoryStream overflow: {end} > {len(self._view)}"
+            )
+        self._view[self._pos : end] = data
+        self._pos = end
+
+    def seek(self, pos: int) -> None:
+        if pos < 0 or pos > len(self._view):
+            raise IOError(f"seek out of range: {pos}")
+        self._pos = pos
+
+    def tell(self) -> int:
+        return self._pos

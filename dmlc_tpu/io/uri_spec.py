@@ -1,0 +1,51 @@
+"""URI "sugar" parsing: ``path?key=val&...#cachefile``.
+
+Capability parity with ``dmlc::io::URISpec`` (src/io/uri_spec.h:43-76):
+one optional ``#cachefile`` suffix (which gains ``.splitN.partK`` when
+num_parts != 1), one optional ``?``-query of ``&``-separated ``key=value``
+args (e.g. ``format=libsvm`` selecting the parser — src/data.cc:70-76).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from dmlc_tpu.utils.logging import check_eq
+
+
+@dataclass
+class URISpec:
+    uri: str = ""
+    args: Dict[str, str] = field(default_factory=dict)
+    cache_file: str = ""
+
+    def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1):
+        name_cache = uri.split("#")
+        check_eq(
+            len(name_cache) <= 2,
+            True,
+            "only one `#` is allowed in file path for cachefile specification",
+        )
+        if len(name_cache) == 2:
+            cache = name_cache[1]
+            if num_parts != 1:
+                cache += f".split{num_parts}.part{part_index}"
+            self.cache_file = cache
+        else:
+            self.cache_file = ""
+        name_args = name_cache[0].split("?")
+        check_eq(
+            len(name_args) <= 2,
+            True,
+            "only one `?` is allowed in file path for argument specification",
+        )
+        self.args = {}
+        if len(name_args) == 2:
+            for i, item in enumerate(name_args[1].split("&")):
+                if not item:
+                    continue
+                key, sep, value = item.partition("=")
+                check_eq(sep, "=", f"Invalid uri argument format in arg {i + 1}")
+                self.args[key] = value
+        self.uri = name_args[0]

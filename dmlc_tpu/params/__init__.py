@@ -1,0 +1,23 @@
+"""Configuration spine: Parameter structs, factory Registry, Config files, env.
+
+Reference capabilities mirrored: include/dmlc/parameter.h (declarative typed
+parameter structs with validation + docgen), include/dmlc/registry.h (global
+factory singletons), include/dmlc/config.h + src/config.cc (key=value config
+files), parameter.h:1035-1063 (typed GetEnv/SetEnv).
+"""
+
+from dmlc_tpu.params.parameter import Parameter, ParamError, field
+from dmlc_tpu.params.registry import Registry, RegistryEntry
+from dmlc_tpu.params.config import Config
+from dmlc_tpu.params.env import get_env, set_env
+
+__all__ = [
+    "Parameter",
+    "ParamError",
+    "field",
+    "Registry",
+    "RegistryEntry",
+    "Config",
+    "get_env",
+    "set_env",
+]

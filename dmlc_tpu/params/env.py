@@ -1,0 +1,32 @@
+"""Typed environment-variable access (reference GetEnv/SetEnv,
+parameter.h:45-56, 1035-1063)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Type, TypeVar
+
+from dmlc_tpu.params.parameter import FieldInfo
+
+T = TypeVar("T")
+
+
+def get_env(key: str, default: T, ftype: Optional[Type[T]] = None) -> T:
+    """Read env var ``key`` parsed as the type of ``default``.
+
+    Uses the same string→typed parsing as Parameter fields (bool accepts
+    true/false/1/0, etc.). Missing variable returns ``default``.
+    """
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    info = FieldInfo(ftype or type(default))
+    info.name = key
+    return info.parse(raw)  # type: ignore[return-value]
+
+
+def set_env(key: str, value: Any) -> None:
+    """Set env var ``key`` from a typed value, using Parameter stringification."""
+    info = FieldInfo(type(value))
+    info.name = key
+    os.environ[key] = info.to_string(value)

@@ -1,0 +1,50 @@
+"""Utility layer: logging/CHECK/Error, timing, small helpers.
+
+Reference capabilities mirrored: include/dmlc/logging.h (CHECK/LOG/Error with
+stack traces, pluggable sink), include/dmlc/timer.h (GetTime), and
+include/dmlc/common.h (Split, HashCombine).
+"""
+
+from dmlc_tpu.utils.logging import (
+    DMLCError,
+    check,
+    check_eq,
+    check_ne,
+    check_lt,
+    check_le,
+    check_gt,
+    check_ge,
+    check_notnull,
+    log_debug,
+    log_info,
+    log_warning,
+    log_error,
+    log_fatal,
+    set_log_sink,
+    get_logger,
+)
+from dmlc_tpu.utils.timer import get_time, Timer
+from dmlc_tpu.utils.common import split_string, hash_combine
+
+__all__ = [
+    "DMLCError",
+    "check",
+    "check_eq",
+    "check_ne",
+    "check_lt",
+    "check_le",
+    "check_gt",
+    "check_ge",
+    "check_notnull",
+    "log_debug",
+    "log_info",
+    "log_warning",
+    "log_error",
+    "log_fatal",
+    "set_log_sink",
+    "get_logger",
+    "get_time",
+    "Timer",
+    "split_string",
+    "hash_combine",
+]

@@ -1,0 +1,20 @@
+"""Small shared helpers (reference: include/dmlc/common.h)."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def split_string(s: str, delim: str) -> List[str]:
+    """Split on a single-char delimiter, dropping empty segments.
+
+    Matches dmlc::Split semantics (common.h:20-31): `std::getline` over a
+    stringstream drops empty fields.
+    """
+    return [part for part in s.split(delim) if part != ""]
+
+
+def hash_combine(seed: int, value: int) -> int:
+    """Boost-style hash combine (common.h:33-46), 64-bit wrapped."""
+    mask = (1 << 64) - 1
+    return (seed ^ (value + 0x9E3779B9 + ((seed << 6) & mask) + (seed >> 2))) & mask

@@ -1,0 +1,168 @@
+"""Logging and check/assert layer.
+
+Capability parity with the reference's glog-compatible mini-logger
+(``include/dmlc/logging.h``): CHECK/CHECK_op macros that raise a rich
+``DMLCError`` carrying a stack trace (reference ``logging.h:121-132,322-339``),
+severity-leveled LOG with timestamps, a pluggable custom sink (reference
+``CustomLogMessage::Log``, ``logging.h:253-272``), and env-controlled verbosity.
+
+Idiomatic-Python differences (deliberate): the check macros are functions, the
+Error type integrates with Python exception chaining, and LOG rides the stdlib
+``logging`` module so downstream apps can route/filter with standard tooling.
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+import os
+import sys
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+_LOGGER_NAME = "dmlc_tpu"
+
+
+class DMLCError(RuntimeError):
+    """Error raised by failed checks and FATAL logs.
+
+    Mirrors ``dmlc::Error`` (reference ``logging.h:31``). When
+    ``DMLC_LOG_STACK_TRACE`` is truthy (default on), the message includes a
+    captured Python stack trace, mirroring ``StackTrace()`` capture at
+    ``logging.h:322-339``.
+    """
+
+    def __init__(self, msg: str):
+        if _stack_trace_enabled():
+            tb = "".join(traceback.format_stack()[:-2])
+            msg = f"{msg}\n\nStack trace:\n{tb}"
+        super().__init__(msg)
+
+
+def _stack_trace_enabled() -> bool:
+    val = os.environ.get("DMLC_LOG_STACK_TRACE", "1").lower()
+    return val not in ("0", "false", "")
+
+
+def get_logger() -> _pylogging.Logger:
+    """The package logger; lazily configured with a stderr handler."""
+    logger = _pylogging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = _pylogging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            _pylogging.Formatter(
+                fmt="[%(asctime)s] %(levelname)s %(filename)s:%(lineno)d: %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+        logger.addHandler(handler)
+        level = os.environ.get("DMLC_LOG_LEVEL", "INFO").upper()
+        logger.setLevel(getattr(_pylogging, level, _pylogging.INFO))
+    return logger
+
+
+# Pluggable sink: if set, all log lines go through it instead of the stdlib
+# logger (reference: DMLC_LOG_CUSTOMIZE / CustomLogMessage, logging.h:253-272).
+_custom_sink: Optional[Callable[[str, str], None]] = None
+
+
+def set_log_sink(sink: Optional[Callable[[str, str], None]]) -> None:
+    """Install a custom sink ``sink(severity, message)``; None restores default."""
+    global _custom_sink
+    _custom_sink = sink
+
+
+def _emit(severity: str, msg: str) -> None:
+    if _custom_sink is not None:
+        _custom_sink(severity, msg)
+        return
+    logger = get_logger()
+    logger.log(getattr(_pylogging, severity, _pylogging.INFO), msg, stacklevel=3)
+
+
+def log_debug(msg: str, *args: Any) -> None:
+    _emit("DEBUG", msg % args if args else msg)
+
+
+def log_info(msg: str, *args: Any) -> None:
+    _emit("INFO", msg % args if args else msg)
+
+
+def log_warning(msg: str, *args: Any) -> None:
+    _emit("WARNING", msg % args if args else msg)
+
+
+def log_error(msg: str, *args: Any) -> None:
+    _emit("ERROR", msg % args if args else msg)
+
+
+def log_fatal(msg: str, *args: Any) -> None:
+    """LOG(FATAL): emits then raises DMLCError (reference logging.h:379-405,
+    behavior of DMLC_LOG_FATAL_THROW=1, which is the mode every DMLC-based
+    library ships with)."""
+    text = msg % args if args else msg
+    _emit("ERROR", text)
+    raise DMLCError(text)
+
+
+def check(cond: Any, msg: str = "", *args: Any) -> None:
+    """CHECK(cond): raise DMLCError when cond is falsy (logging.h:121)."""
+    if not cond:
+        text = msg % args if args else msg
+        raise DMLCError(f"Check failed: {text}" if text else "Check failed")
+
+
+def _check_op(op_name: str, ok: bool, x: Any, y: Any, msg: str) -> None:
+    if not ok:
+        detail = f" {msg}" if msg else ""
+        raise DMLCError(f"Check failed: {x!r} {op_name} {y!r}{detail}")
+
+
+def check_eq(x: Any, y: Any, msg: str = "") -> None:
+    _check_op("==", x == y, x, y, msg)
+
+
+def check_ne(x: Any, y: Any, msg: str = "") -> None:
+    _check_op("!=", x != y, x, y, msg)
+
+
+def check_lt(x: Any, y: Any, msg: str = "") -> None:
+    _check_op("<", x < y, x, y, msg)
+
+
+def check_le(x: Any, y: Any, msg: str = "") -> None:
+    _check_op("<=", x <= y, x, y, msg)
+
+
+def check_gt(x: Any, y: Any, msg: str = "") -> None:
+    _check_op(">", x > y, x, y, msg)
+
+
+def check_ge(x: Any, y: Any, msg: str = "") -> None:
+    _check_op(">=", x >= y, x, y, msg)
+
+
+def check_notnull(x: Any, msg: str = "") -> Any:
+    """CHECK_NOTNULL: raise if None, else return x (logging.h:159-166)."""
+    if x is None:
+        raise DMLCError(f"Check notnull failed: {msg}" if msg else "Check notnull failed")
+    return x
+
+
+class LogOncePer:
+    """Rate-limited logging helper: at most one emit per ``period`` seconds.
+
+    TPU-new convenience used by throughput telemetry (the reference logs every
+    10MB instead; basic_row_iter.h:66-75)."""
+
+    def __init__(self, period: float = 10.0):
+        self.period = period
+        self._last = 0.0
+
+    def __call__(self, msg: str, *args: Any) -> bool:
+        now = time.monotonic()
+        if now - self._last >= self.period:
+            self._last = now
+            log_info(msg, *args)
+            return True
+        return False

@@ -1,0 +1,134 @@
+"""Bounded producer/consumer prefetch iterator.
+
+Capability parity with ``dmlc::ThreadedIter`` (include/dmlc/threadediter.h):
+a background producer thread fills a bounded queue (default capacity 8,
+threadediter.h:80) ahead of the consumer; ``before_first`` restarts the
+producer for a new epoch (the kBeforeFirst signal, threadediter.h:211-215);
+exceptions thrown in the producer are captured and re-raised in the consumer
+(threadediter.h:374-404,456-466). The reference's free-cell ``Recycle`` buffer
+pool (threadediter.h:442-454) exists to reach zero steady-state allocation in
+C++; the Python twin relies on refcounting (the native C++ core in cpp/ keeps
+the recycling design).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Generic, Iterable, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
+
+_END = object()
+
+
+class _Exc:
+    def __init__(self, err: BaseException):
+        self.err = err
+
+
+class ThreadedIter(Generic[T]):
+    """Prefetch items of ``make_iter()`` in a background thread.
+
+    ``make_iter`` is called once per epoch (at construction and at each
+    ``before_first``) and must return a fresh iterator — the analog of the
+    reference's ``next``/``beforefirst`` producer closures
+    (threadediter.h:300-408).
+    """
+
+    def __init__(
+        self,
+        make_iter: Callable[[], Iterable[T]],
+        max_capacity: int = 8,
+        name: str = "threaded-iter",
+    ):
+        self._make_iter = make_iter
+        self._cap = max_capacity
+        self._name = name
+        self._thread: Optional[threading.Thread] = None
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._finished = False
+        self.before_first()
+
+    # ---- producer ------------------------------------------------------
+    def _run(self, q: "queue.Queue", stop: threading.Event) -> None:
+        try:
+            for item in self._make_iter():
+                while True:
+                    if stop.is_set():
+                        return
+                    try:
+                        q.put(item, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+            while not stop.is_set():
+                try:
+                    q.put(_END, timeout=0.05)
+                    return
+                except queue.Full:
+                    continue
+        except BaseException as err:  # noqa: BLE001 — propagate to consumer
+            while not stop.is_set():
+                try:
+                    q.put(_Exc(err), timeout=0.05)
+                    return
+                except queue.Full:
+                    continue
+
+    def _shutdown_producer(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            # Drain so a blocked put() notices the stop flag promptly.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join()
+            self._thread = None
+
+    # ---- consumer API --------------------------------------------------
+    def before_first(self) -> None:
+        """Restart the producer for a fresh epoch."""
+        self._shutdown_producer()
+        self._queue = queue.Queue(self._cap)
+        self._stop = threading.Event()
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._run,
+            args=(self._queue, self._stop),
+            name=self._name,
+            daemon=True,
+        )
+        self._thread.start()
+
+    def next(self) -> Optional[T]:
+        """Next item, or None at end of epoch. Re-raises producer errors."""
+        if self._finished:
+            return None
+        item = self._queue.get()
+        if item is _END:
+            self._finished = True
+            return None
+        if isinstance(item, _Exc):
+            self._finished = True
+            raise item.err
+        return item
+
+    def __iter__(self) -> Iterator[T]:
+        while True:
+            item = self.next()
+            if item is None:
+                return
+            yield item
+
+    def close(self) -> None:
+        self._shutdown_producer()
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,40 @@
+"""Timing helpers (reference: include/dmlc/timer.h GetTime, timer.h:27-47)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+def get_time() -> float:
+    """Seconds from a monotonic high-resolution clock, as double.
+
+    The reference prefers chrono high_resolution_clock (timer.h:29-33); the
+    Python equivalent is time.perf_counter().
+    """
+    return time.perf_counter()
+
+
+class Timer:
+    """Context-manager stopwatch with accumulated elapsed time.
+
+    TPU-new: the reference only has GetTime(); pipelines here want per-stage
+    timers (SURVEY §5.1), so this accumulates across multiple enters.
+    """
+
+    def __init__(self) -> None:
+        self.elapsed = 0.0
+        self._start: Optional[float] = None
+
+    def __enter__(self) -> "Timer":
+        self._start = get_time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        assert self._start is not None
+        self.elapsed += get_time() - self._start
+        self._start = None
+
+    def reset(self) -> None:
+        self.elapsed = 0.0
+        self._start = None

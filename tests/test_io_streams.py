@@ -1,0 +1,186 @@
+"""Stream/FileSystem/serializer/URISpec tests (mirrors unittest_serializer.cc,
+unittest_json.cc round-trip intent, filesys_test.cc, iostream_test.cc)."""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io import (
+    FixedMemoryStream,
+    MemoryStream,
+    URI,
+    URISpec,
+    create_stream,
+    load_obj,
+    save_obj,
+)
+from dmlc_tpu.io.filesystem import (
+    FILE_TYPE_DIR,
+    FILE_TYPE_FILE,
+    MemoryFileSystem,
+    get_filesystem,
+)
+from dmlc_tpu.utils.threaded_iter import ThreadedIter
+
+
+@pytest.fixture(autouse=True)
+def _clean_memfs():
+    MemoryFileSystem.reset()
+    yield
+    MemoryFileSystem.reset()
+
+
+class TestURI:
+    def test_parse(self):
+        uri = URI.parse("hdfs://host:9000/a/b.txt")
+        assert uri.protocol == "hdfs://"
+        assert uri.host == "host:9000"
+        assert uri.name == "/a/b.txt"
+
+    def test_plain_path(self):
+        uri = URI.parse("/tmp/x")
+        assert uri.protocol == "file://"
+        assert uri.name == "/tmp/x"
+        assert uri.str_full() == "/tmp/x"
+
+
+class TestURISpec:
+    def test_args_and_cache(self):
+        spec = URISpec("hdfs:///data/?format=libsvm&clabel=0#mycache", 2, 4)
+        assert spec.uri == "hdfs:///data/"
+        assert spec.args == {"format": "libsvm", "clabel": "0"}
+        assert spec.cache_file == "mycache.split4.part2"
+
+    def test_single_part_no_suffix(self):
+        spec = URISpec("/data.txt#cache", 0, 1)
+        assert spec.cache_file == "cache"
+
+    def test_no_sugar(self):
+        spec = URISpec("/plain.txt", 0, 1)
+        assert spec.uri == "/plain.txt"
+        assert spec.args == {}
+        assert spec.cache_file == ""
+
+    def test_double_hash_rejected(self):
+        with pytest.raises(Exception):
+            URISpec("/a#b#c", 0, 1)
+
+
+class TestStreams:
+    def test_memory_stream_roundtrip(self):
+        s = MemoryStream()
+        s.write_uint32(7)
+        s.write_uint64(1 << 40)
+        s.write_bytes_prefixed(b"hello")
+        s.seek(0)
+        assert s.read_uint32() == 7
+        assert s.read_uint64() == 1 << 40
+        assert s.read_bytes_prefixed() == b"hello"
+
+    def test_fixed_memory_stream(self):
+        buf = bytearray(8)
+        s = FixedMemoryStream(buf)
+        s.write(b"abcd")
+        with pytest.raises(IOError):
+            s.write(b"toolong67")
+        s.seek(0)
+        assert s.read(4) == b"abcd"
+
+    def test_read_exact_raises_at_eof(self):
+        s = MemoryStream(b"abc")
+        with pytest.raises(EOFError):
+            s.read_exact(4)
+
+    def test_local_file_stream(self, tmp_path):
+        path = str(tmp_path / "f.bin")
+        with create_stream(path, "w") as s:
+            s.write(b"data123")
+        with create_stream(path, "r") as s:
+            assert s.read(100) == b"data123"
+        with create_stream(path, "a") as s:
+            s.write(b"-more")
+        with create_stream(path, "r") as s:
+            assert s.read(100) == b"data123-more"
+
+    def test_allow_null(self):
+        assert create_stream("/nonexistent/x", "r", allow_null=True) is None
+
+
+class TestSerializer:
+    def test_roundtrip_nested(self):
+        obj = {
+            "ints": [1, -5, 2**70],
+            "floats": (3.14, -0.0),
+            "strs": {"k": "väl", "b": b"\x00\xff"},
+            "none": None,
+            "flag": True,
+            "set": {1, 2, 3},
+        }
+        s = MemoryStream()
+        save_obj(s, obj)
+        s.seek(0)
+        assert load_obj(s) == obj
+
+    def test_ndarray(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        s = MemoryStream()
+        save_obj(s, {"w": arr})
+        s.seek(0)
+        out = load_obj(s)
+        np.testing.assert_array_equal(out["w"], arr)
+        assert out["w"].dtype == np.float32
+
+    def test_unsupported_type_raises(self):
+        with pytest.raises(TypeError):
+            save_obj(MemoryStream(), object())
+
+
+class TestMemoryFileSystem:
+    def test_put_stat_list_read(self):
+        MemoryFileSystem.put("h/a/x.txt", b"xx")
+        MemoryFileSystem.put("h/a/y.txt", b"yyy")
+        MemoryFileSystem.put("h/a/sub/z.txt", b"z")
+        fs = get_filesystem(URI.parse("mem://h/a"))
+        info = fs.get_path_info(URI.parse("mem://h/a/x.txt"))
+        assert info.size == 2 and info.type == FILE_TYPE_FILE
+        listing = fs.list_directory(URI.parse("mem://h/a"))
+        names = [i.path.name for i in listing]
+        assert names == ["/a/sub", "/a/x.txt", "/a/y.txt"]
+        assert [i.type for i in listing] == [FILE_TYPE_DIR, FILE_TYPE_FILE, FILE_TYPE_FILE]
+        rec = fs.list_directory_recursive(URI.parse("mem://h/a"))
+        assert sorted(i.path.name for i in rec) == ["/a/sub/z.txt", "/a/x.txt", "/a/y.txt"]
+
+    def test_write_via_stream(self):
+        with create_stream("mem://h/out.bin", "w") as s:
+            s.write(b"abc")
+        with create_stream("mem://h/out.bin", "a") as s:
+            s.write(b"def")
+        with create_stream("mem://h/out.bin", "r") as s:
+            assert s.read(10) == b"abcdef"
+
+
+class TestThreadedIter:
+    def test_basic_prefetch(self):
+        ti = ThreadedIter(lambda: iter(range(100)), max_capacity=4)
+        assert list(ti) == list(range(100))
+
+    def test_before_first_restarts(self):
+        ti = ThreadedIter(lambda: iter(range(5)))
+        assert list(ti) == [0, 1, 2, 3, 4]
+        ti.before_first()
+        assert list(ti) == [0, 1, 2, 3, 4]
+
+    def test_exception_propagates(self):
+        def bad():
+            yield 1
+            raise ValueError("producer died")
+
+        ti = ThreadedIter(bad)
+        assert ti.next() == 1
+        with pytest.raises(ValueError, match="producer died"):
+            while ti.next() is not None:
+                pass
+
+    def test_early_close_mid_epoch(self):
+        ti = ThreadedIter(lambda: iter(range(10**6)), max_capacity=2)
+        assert ti.next() == 0
+        ti.close()  # must not hang

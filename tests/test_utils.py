@@ -1,0 +1,69 @@
+"""Tests for the utility layer (mirrors reference unittest_logging.cc style)."""
+
+import pytest
+
+from dmlc_tpu.utils import (
+    DMLCError,
+    check,
+    check_eq,
+    check_lt,
+    check_notnull,
+    get_time,
+    hash_combine,
+    split_string,
+    Timer,
+)
+from dmlc_tpu.utils.logging import log_fatal, set_log_sink
+
+
+def test_check_passes():
+    check(True)
+    check_eq(1, 1)
+    check_lt(1, 2)
+    assert check_notnull("x") == "x"
+
+
+def test_check_raises():
+    with pytest.raises(DMLCError):
+        check(False, "boom %d", 42)
+    with pytest.raises(DMLCError, match="=="):
+        check_eq(1, 2)
+    with pytest.raises(DMLCError):
+        check_notnull(None)
+
+
+def test_log_fatal_raises():
+    with pytest.raises(DMLCError, match="fatal thing"):
+        log_fatal("fatal thing")
+
+
+def test_custom_sink():
+    seen = []
+    set_log_sink(lambda sev, msg: seen.append((sev, msg)))
+    try:
+        from dmlc_tpu.utils import log_info
+
+        log_info("hello %s", "world")
+    finally:
+        set_log_sink(None)
+    assert seen == [("INFO", "hello world")]
+
+
+def test_split_string():
+    assert split_string("a;b;;c", ";") == ["a", "b", "c"]
+    assert split_string("", ";") == []
+
+
+def test_hash_combine_deterministic():
+    a = hash_combine(0, 123)
+    assert a == hash_combine(0, 123)
+    assert a != hash_combine(1, 123)
+    assert 0 <= a < (1 << 64)
+
+
+def test_timer():
+    t = Timer()
+    with t:
+        pass
+    assert t.elapsed >= 0
+    assert get_time() > 0
