@@ -1,0 +1,228 @@
+"""Rabit-style collective API.
+
+The user-facing surface mirrors rabit's (the library the reference's tracker
+bootstraps): ``init / finalize / rank / world_size / allreduce / broadcast /
+allgather / barrier / checkpoint / load_checkpoint / version_number /
+tracker_print``. Engines:
+
+- "socket": the tree/ring TCP engine speaking the reference tracker protocol
+  (dmlc_tpu.collective.socket_engine) — CPU-parity runs, or anywhere the
+  DMLC_TRACKER_URI env contract is in effect
+- "device": XLA collectives over the TPU mesh (dmlc_tpu.collective.device),
+  bootstrapped by jax.distributed (the --cluster=tpu path)
+- "local": world-size-1 no-op engine
+
+``init()`` picks automatically: DMLC_TRACKER_URI set → socket; multi-process
+JAX runtime → device; else local.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+from dmlc_tpu.collective import device as device_collectives
+from dmlc_tpu.collective.device import (
+    DeviceEngine,
+    all_gather,
+    make_allreduce_step,
+    pmax,
+    pmean,
+    pmin,
+    psum,
+    ppermute_next,
+)
+from dmlc_tpu.collective.socket_engine import SocketEngine
+from dmlc_tpu.io.serializer import load_obj, save_obj
+from dmlc_tpu.io.stream import MemoryStream
+from dmlc_tpu.io.filesystem import create_stream
+from dmlc_tpu.utils.logging import DMLCError, check, log_info
+
+_engine = None
+_engine_lock = threading.Lock()
+_version = 0
+_checkpoint_blob: Optional[bytes] = None
+
+
+class _LocalEngine:
+    """world=1 no-op engine (rabit semantics when not launched distributed)."""
+
+    rank = 0
+    world_size = 1
+
+    def allreduce(self, array, op="sum"):
+        return np.asarray(array)
+
+    def broadcast(self, array, root=0):
+        assert array is not None
+        return np.asarray(array)
+
+    def allgather(self, array):
+        return [np.asarray(array)]
+
+    def barrier(self):
+        pass
+
+    def tracker_print(self, msg):
+        log_info("%s", msg)
+
+    def shutdown(self):
+        pass
+
+
+def init(engine: str = "auto", **kwargs) -> None:
+    """Initialize the collective engine (rabit.init equivalent)."""
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            return
+        if engine == "auto":
+            if os.environ.get("DMLC_TRACKER_URI"):
+                engine = "socket"
+            else:
+                import jax
+
+                engine = "device" if jax.process_count() > 1 else "local"
+        if engine == "socket":
+            _engine = SocketEngine(**kwargs)
+        elif engine == "device":
+            _engine = DeviceEngine(**kwargs)
+        elif engine == "local":
+            _engine = _LocalEngine()
+        else:
+            raise DMLCError(f"unknown collective engine {engine!r}")
+
+
+def _get():
+    if _engine is None:
+        init()
+    return _engine
+
+
+def finalize() -> None:
+    """rabit.finalize: release links / notify tracker."""
+    global _engine, _version, _checkpoint_blob
+    with _engine_lock:
+        if _engine is not None:
+            shutdown = getattr(_engine, "shutdown", None)
+            if shutdown:
+                shutdown()
+            _engine = None
+        _version = 0
+        _checkpoint_blob = None
+
+
+def rank() -> int:
+    return _get().rank
+
+
+def world_size() -> int:
+    return _get().world_size
+
+
+def allreduce(array, op: str = "sum") -> np.ndarray:
+    """Allreduce a host array across workers (rabit.allreduce)."""
+    return _get().allreduce(np.asarray(array), op=op)
+
+
+def broadcast(array, root: int = 0) -> np.ndarray:
+    """Broadcast from ``root`` (rabit.broadcast)."""
+    return _get().broadcast(None if array is None else np.asarray(array), root=root)
+
+
+def allgather(array) -> List[np.ndarray]:
+    engine = _get()
+    if hasattr(engine, "allgather"):
+        return engine.allgather(np.asarray(array))
+    return [engine.broadcast(np.asarray(array) if r == engine.rank else None, root=r)
+            for r in range(engine.world_size)]
+
+
+def barrier() -> None:
+    _get().barrier()
+
+
+def tracker_print(msg: str) -> None:
+    """Print through the tracker (rank 0 style logging; rabit.tracker_print)."""
+    engine = _get()
+    if hasattr(engine, "tracker_print"):
+        engine.tracker_print(msg)
+    else:
+        if engine.rank == 0:
+            log_info("%s", msg)
+
+
+# ---- checkpointing (rabit CheckPoint/LoadCheckPoint semantics) ------------
+
+
+def checkpoint(state: Any, uri: Optional[str] = None) -> None:
+    """Save a recoverable model snapshot and bump the version.
+
+    Rabit keeps checkpoints in memory (replicated for ring recovery); here the
+    blob is kept in-process and optionally persisted to any Stream URI
+    (file://, gs://, mem://...) — the building blocks the reference exposes as
+    Serializable + Stream::Create (io.h:112-126, SURVEY §5.4).
+    """
+    global _version, _checkpoint_blob
+    stream = MemoryStream()
+    save_obj(stream, state)
+    _checkpoint_blob = stream.getvalue()
+    _version += 1
+    if uri:
+        with create_stream(uri, "w") as out:
+            out.write(_checkpoint_blob)
+
+
+def load_checkpoint(uri: Optional[str] = None) -> Optional[Any]:
+    """Return (latest checkpoint state) or None if none exists."""
+    global _version, _checkpoint_blob
+    blob = _checkpoint_blob
+    if blob is None and uri:
+        stream = create_stream(uri, "r", allow_null=True)
+        if stream is not None:
+            data = []
+            while True:
+                piece = stream.read(1 << 20)
+                if not piece:
+                    break
+                data.append(piece)
+            blob = b"".join(data)
+            _checkpoint_blob = blob
+    if blob is None:
+        return None
+    return load_obj(MemoryStream(blob))
+
+
+def version_number() -> int:
+    """Number of checkpoints taken (rabit.version_number)."""
+    return _version
+
+
+__all__ = [
+    "init",
+    "finalize",
+    "rank",
+    "world_size",
+    "allreduce",
+    "broadcast",
+    "allgather",
+    "barrier",
+    "tracker_print",
+    "checkpoint",
+    "load_checkpoint",
+    "version_number",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "ppermute_next",
+    "make_allreduce_step",
+    "DeviceEngine",
+    "SocketEngine",
+    "device_collectives",
+]

@@ -1,0 +1,130 @@
+"""Device-plane collectives: XLA over ICI/DCN.
+
+This is the TPU replacement for rabit's socket tree/ring (SURVEY §5.8 "TPU
+native equivalent"): inside jit, collectives are axis-name primitives
+(psum/pmean/all_gather/ppermute) that XLA lowers to ICI AllReduce etc.; at
+the host level, cross-process reductions ride a jitted psum over the global
+mesh via jax.experimental.multihost_utils.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# ---- in-jit collectives (use inside shard_map/pjit-ed functions) ----------
+
+def psum(x, axis: str = "dp"):
+    """Cross-replica sum over a mesh axis (ICI AllReduce)."""
+    return jax.lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str = "dp"):
+    return jax.lax.pmean(x, axis_name=axis)
+
+def pmax(x, axis: str = "dp"):
+    return jax.lax.pmax(x, axis_name=axis)
+
+
+def pmin(x, axis: str = "dp"):
+    return jax.lax.pmin(x, axis_name=axis)
+
+
+def all_gather(x, axis: str = "dp", tiled: bool = False):
+    return jax.lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def ppermute_next(x, axis: str = "dp"):
+    """Rotate shards one step around the mesh axis ring — the ICI analog of
+    the tracker's ring links (tracker.py:212-225)."""
+    size = jax.lax.axis_size(axis)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    return jax.lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+# ---- host-level collectives over the global device mesh -------------------
+
+
+class DeviceEngine:
+    """Host-callable allreduce/broadcast executing as XLA collectives.
+
+    Single-process: reductions over the local mesh axis. Multi-process (one
+    process per TPU host, bootstrapped by jax.distributed.initialize):
+    reductions span all hosts over ICI/DCN via a jitted psum on a
+    globally-sharded array.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, axis: str = "dp"):
+        if mesh is None:
+            devs = np.asarray(jax.devices())
+            mesh = Mesh(devs, (axis,))
+        self.mesh = mesh
+        self.axis = axis
+        self.rank = jax.process_index()
+        self.world_size = jax.process_count()
+
+    def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        """Allreduce a host array across all processes' devices."""
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray(array)
+        if self.world_size == 1:
+            # Single process owns every device: nothing to reduce across
+            # processes; return as-is (matches rabit world=1 semantics).
+            return arr
+        ops = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min, "prod": jnp.prod}
+        if op not in ops:
+            raise ValueError(f"unknown op {op!r}")
+        # stack contributions along a new leading axis sharded over processes,
+        # then reduce it with a jitted global reduction (XLA AllReduce).
+        stacked = multihost_utils.process_allgather(arr)
+        reduce_fn = ops[op]
+        return np.asarray(reduce_fn(stacked, axis=0))
+
+    def broadcast(self, array: Optional[np.ndarray], root: int = 0) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        if self.world_size == 1:
+            assert array is not None
+            return np.asarray(array)
+        return np.asarray(
+            multihost_utils.broadcast_one_to_all(
+                array, is_source=self.rank == root
+            )
+        )
+
+    def barrier(self) -> None:
+        from jax.experimental import multihost_utils
+
+        if self.world_size > 1:
+            multihost_utils.sync_global_devices("dmlc_tpu_barrier")
+
+
+# ---- gradient-sync building block (the BASELINE north-star op) ------------
+
+
+def make_allreduce_step(mesh: Mesh, axis: str = "dp"):
+    """Return a jitted f(sharded_grads_pytree) -> summed pytree using one
+    fused AllReduce over the mesh axis. Large fused buckets + donation are
+    what push ICI utilization ≥90% (SURVEY §7 hard parts)."""
+    shard_map = jax.shard_map
+
+    def _sum(grads):
+        return jax.tree.map(lambda g: jax.lax.psum(g, axis), grads)
+
+    spec = P(axis)
+    return jax.jit(
+        shard_map(
+            _sum,
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=P(),
+        ),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,296 @@
+"""Socket collective engine: the worker side of the rabit protocol.
+
+The reference repo ships only the *tracker* half of rabit's bootstrap
+(SURVEY §5.8); the worker half (rendezvous client + tree collectives) lived
+downstream. This module provides that worker half, wire-compatible with our
+tracker (dmlc_tpu.tracker.rendezvous) and the reference's tracker.py:
+
+- handshake: connect to DMLC_TRACKER_URI:PORT, send magic/rank/world/jobid/
+  cmd; receive rank, parent, world, tree neighbors, ring prev/next
+  (mirror of tracker.py:58-104)
+- peer-link brokering: listen on an ephemeral port, run the goodset/badset
+  loop, dial the peers the tracker names, accept the rest
+  (mirror of tracker.py:105-135)
+- collectives over the tree links: Allreduce (reduce-up + broadcast-down,
+  deterministic child order → bit-reproducible sums) and Broadcast;
+  Allgather via per-rank broadcast rounds
+- cmd='recover' re-entry with the old rank, and 'print'/'shutdown' control
+  messages
+
+On TPU this engine is the CPU-parity/control path; the data plane for
+gradients is XLA collectives (dmlc_tpu.collective.device). The public
+rabit-style API in dmlc_tpu.collective dispatches between them.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dmlc_tpu.tracker.rendezvous import MAGIC, FramedSocket
+from dmlc_tpu.utils.logging import DMLCError, check
+
+# peer handshake tag (worker-to-worker links are our protocol)
+_PEER_MAGIC = 0xDC99
+
+# broadcast metadata frame size in int64 slots: 1 (ndim) + up to 23 dims + 8
+# (dtype code). A protocol constant — every rank sizes the frame identically.
+_META_SLOTS = 32
+
+
+_REDUCERS: Dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
+    "sum": lambda a, b: a + b,
+    "max": np.maximum,
+    "min": np.minimum,
+    "prod": lambda a, b: a * b,
+    "bitor": np.bitwise_or,
+}
+
+
+class SocketEngine:
+    """One worker's connection set + tree collectives."""
+
+    def __init__(
+        self,
+        tracker_uri: Optional[str] = None,
+        tracker_port: Optional[int] = None,
+        rank: int = -1,
+        world_size: int = -1,
+        jobid: Optional[str] = None,
+        cmd: str = "start",
+        connect_retry: int = 5,
+    ):
+        self.tracker_uri = tracker_uri or os.environ.get("DMLC_TRACKER_URI")
+        self.tracker_port = int(
+            tracker_port or os.environ.get("DMLC_TRACKER_PORT", 0)
+        )
+        check(self.tracker_uri, "no tracker address (DMLC_TRACKER_URI unset)")
+        self.jobid = jobid or os.environ.get("DMLC_TASK_ID", "NULL")
+        self.rank = rank
+        self.world_size = world_size
+        self.parent_rank = -1
+        self.ring_prev = -1
+        self.ring_next = -1
+        self.tree_links: List[int] = []
+        self.links: Dict[int, FramedSocket] = {}
+        self._listener: Optional[socket.socket] = None
+        self._connect(cmd, connect_retry)
+
+    # ---- rendezvous ----------------------------------------------------
+    def _dial_tracker(self, cmd: str) -> FramedSocket:
+        sock = socket.create_connection(
+            (self.tracker_uri, self.tracker_port), timeout=60
+        )
+        conn = FramedSocket(sock)
+        conn.send_int(MAGIC)
+        got = conn.recv_int()
+        if got != MAGIC:
+            raise DMLCError(f"tracker handshake failed: magic {got:#x}")
+        conn.send_int(self.rank)
+        conn.send_int(self.world_size)
+        conn.send_str(self.jobid)
+        conn.send_str(cmd)
+        return conn
+
+    def _connect(self, cmd: str, retries: int) -> None:
+        last_err = None
+        for attempt in range(retries):
+            try:
+                conn = self._dial_tracker(cmd)
+                break
+            except (ConnectionError, OSError) as err:
+                last_err = err
+                time.sleep(0.2 * (attempt + 1))
+        else:
+            raise DMLCError(f"cannot reach tracker: {last_err}")
+
+        self.rank = conn.recv_int()
+        self.parent_rank = conn.recv_int()
+        self.world_size = conn.recv_int()
+        num_neighbors = conn.recv_int()
+        self.tree_links = [conn.recv_int() for _ in range(num_neighbors)]
+        self.ring_prev = conn.recv_int()
+        self.ring_next = conn.recv_int()
+        expected = set(self.tree_links)
+        if self.ring_prev not in (-1, self.rank):
+            expected.add(self.ring_prev)
+        if self.ring_next not in (-1, self.rank):
+            expected.add(self.ring_next)
+
+        # listen for peers that will dial us
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.bind(("", 0))
+        self._listener.listen(16)
+        my_port = self._listener.getsockname()[1]
+
+        # goodset/badset loop (the worker half of tracker.py:105-135)
+        conn.send_int(len(self.links))
+        for r in self.links:
+            conn.send_int(r)
+        num_conn = conn.recv_int()
+        num_accept = conn.recv_int()
+        errors = 0
+        for _ in range(num_conn):
+            peer_host = conn.recv_str()
+            peer_port = conn.recv_int()
+            peer_rank = conn.recv_int()
+            try:
+                self._dial_peer(peer_host, peer_port, peer_rank)
+            except OSError:
+                errors += 1
+        conn.send_int(errors)
+        if errors:
+            raise DMLCError("peer connect failed")  # tracker would loop; keep strict
+        conn.send_int(my_port)
+        # accept the remaining peers
+        for _ in range(num_accept):
+            fd, _addr = self._listener.accept()
+            peer = FramedSocket(fd)
+            got = peer.recv_int()
+            check(got == _PEER_MAGIC, "bad peer magic")
+            peer_rank = peer.recv_int()
+            peer.send_int(_PEER_MAGIC)
+            peer.send_int(self.rank)
+            self.links[peer_rank] = peer
+        conn.close()
+        missing = expected - set(self.links)
+        check(not missing, "missing peer links: %s", missing)
+
+    def _dial_peer(self, host: str, port: int, peer_rank: int) -> None:
+        sock = socket.create_connection((host, port), timeout=60)
+        peer = FramedSocket(sock)
+        peer.send_int(_PEER_MAGIC)
+        peer.send_int(self.rank)
+        got = peer.recv_int()
+        check(got == _PEER_MAGIC, "bad peer magic")
+        got_rank = peer.recv_int()
+        check(got_rank == peer_rank, "peer rank mismatch")
+        self.links[peer_rank] = peer
+
+    # ---- framed array transport ---------------------------------------
+    @staticmethod
+    def _send_array(conn: FramedSocket, arr: np.ndarray) -> None:
+        payload = arr.tobytes()
+        header = f"{arr.dtype.str}|{','.join(map(str, arr.shape))}"
+        conn.send_str(header)
+        conn.send_int(len(payload))
+        conn.sock.sendall(payload)
+
+    @staticmethod
+    def _recv_array(conn: FramedSocket) -> np.ndarray:
+        header = conn.recv_str()
+        # dtype.str may itself start with '|' (e.g. "|u1"), so split from the
+        # right where the shape field is.
+        dtype_str, shape_str = header.rsplit("|", 1)
+        shape = tuple(int(x) for x in shape_str.split(",") if x)
+        nbytes = conn.recv_int()
+        data = conn.recv_all(nbytes)
+        return np.frombuffer(data, dtype=np.dtype(dtype_str)).reshape(shape).copy()
+
+    # ---- collectives ----------------------------------------------------
+    def _tree_children(self) -> List[int]:
+        return sorted(r for r in self.tree_links if r != self.parent_rank)
+
+    def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        """Tree allreduce: reduce up (children in sorted rank order, so the
+        reduction order is deterministic → bit-exact reproducibility), then
+        broadcast the result down."""
+        check(op in _REDUCERS, "unknown reduce op %s", op)
+        reduce_fn = _REDUCERS[op]
+        acc = np.asarray(array).copy()
+        for child in self._tree_children():
+            acc = reduce_fn(acc, self._recv_array(self.links[child]))
+        if self.parent_rank != -1:
+            self._send_array(self.links[self.parent_rank], acc)
+            acc = self._recv_array(self.links[self.parent_rank])
+        for child in self._tree_children():
+            self._send_array(self.links[child], acc)
+        return acc
+
+    def broadcast(self, array: Optional[np.ndarray], root: int = 0) -> np.ndarray:
+        """Tree broadcast from any root.
+
+        Implemented as an or-style allreduce of (mine-if-root else zeros):
+        first the payload shape/dtype spreads via a small allreduce, then the
+        payload itself — avoiding root-path routing over the relabeled tree.
+        """
+        if self.world_size == 1:
+            assert array is not None
+            return np.asarray(array)
+        is_root = self.rank == root
+        # fixed-size metadata frame: [ndim, shape..., 8-byte dtype code];
+        # both sides must agree on the slot count, so the dimension cap is a
+        # protocol constant with an explicit check rather than a crash.
+        max_ndim = _META_SLOTS - 9
+        if is_root:
+            check(array is not None, "broadcast root must supply data")
+            arr = np.asarray(array)
+            check(
+                arr.ndim <= max_ndim,
+                "broadcast supports at most %d dims, got %d",
+                max_ndim,
+                arr.ndim,
+            )
+            dtype_code = np.frombuffer(
+                arr.dtype.str.ljust(8, " ").encode(), dtype=np.uint8
+            ).astype(np.int64)
+            meta = np.concatenate(
+                [
+                    np.asarray([arr.ndim], dtype=np.int64),
+                    np.asarray(arr.shape, dtype=np.int64),
+                    dtype_code,
+                ]
+            )
+            meta_padded = np.zeros(_META_SLOTS, dtype=np.int64)
+            meta_padded[: len(meta)] = meta
+        else:
+            meta_padded = np.zeros(_META_SLOTS, dtype=np.int64)
+        meta_out = self.allreduce(meta_padded, op="sum")
+        ndim = int(meta_out[0])
+        shape = tuple(int(x) for x in meta_out[1 : 1 + ndim])
+        dtype = np.dtype(
+            bytes(meta_out[1 + ndim : 1 + ndim + 8].astype(np.uint8)).decode().strip()
+        )
+        if is_root:
+            payload = np.asarray(array).astype(dtype).reshape(shape)
+        else:
+            payload = np.zeros(shape, dtype=dtype)
+        view = payload.reshape(-1).view(np.uint8)
+        out = self.allreduce(view, op="bitor")
+        return out.view(dtype).reshape(shape)
+
+    def allgather(self, array: np.ndarray) -> List[np.ndarray]:
+        """Gather every rank's array (rabit Allgather semantics): one
+        broadcast round per rank."""
+        out = []
+        for r in range(self.world_size):
+            out.append(self.broadcast(array if r == self.rank else None, root=r))
+        return out
+
+    def barrier(self) -> None:
+        self.allreduce(np.zeros(1, dtype=np.int32), op="sum")
+
+    # ---- control messages ----------------------------------------------
+    def tracker_print(self, msg: str) -> None:
+        """Relay a message through the tracker log (tracker.py:269-272)."""
+        conn = self._dial_tracker("print")
+        conn.send_str(msg)
+        conn.close()
+
+    def shutdown(self) -> None:
+        for peer in self.links.values():
+            peer.close()
+        self.links.clear()
+        try:
+            conn = self._dial_tracker("shutdown")
+            conn.close()
+        except (DMLCError, OSError):
+            pass
+        if self._listener is not None:
+            self._listener.close()

@@ -1,0 +1,45 @@
+"""Data layer: CSR RowBlocks, text parsers, row iterators.
+
+Reference capabilities mirrored: include/dmlc/data.h (DataIter, Row/RowBlock
+CSR batches, Parser/RowBlockIter factories + registry), src/data/ (libsvm /
+libfm / csv parsers with thread-parallel chunk parsing, RowBlockContainer,
+Basic/Disk row iterators, ThreadedParser prefetch decorator).
+
+The TPU-new part — device-resident CSR batches — lives in dmlc_tpu.device.
+"""
+
+from dmlc_tpu.data.row_block import Row, RowBlock, RowBlockContainer
+from dmlc_tpu.data.parsers import (
+    Parser,
+    LibSVMParser,
+    LibFMParser,
+    CSVParser,
+    ThreadedParser,
+    create_parser,
+    register_parser,
+    PARSER_REGISTRY,
+)
+from dmlc_tpu.data.row_iter import (
+    RowBlockIter,
+    BasicRowIter,
+    DiskRowIter,
+    create_row_block_iter,
+)
+
+__all__ = [
+    "Row",
+    "RowBlock",
+    "RowBlockContainer",
+    "Parser",
+    "LibSVMParser",
+    "LibFMParser",
+    "CSVParser",
+    "ThreadedParser",
+    "create_parser",
+    "register_parser",
+    "PARSER_REGISTRY",
+    "RowBlockIter",
+    "BasicRowIter",
+    "DiskRowIter",
+    "create_row_block_iter",
+]

@@ -1,0 +1,20 @@
+"""Device bridge: host CSR RowBlocks → static-shape XLA device buffers.
+
+This is the TPU-new subsystem (SURVEY §7 stage 7): the reference's pipeline
+ends at host CSR (`RowBlock`); here batches are padded/bucketed to static
+shapes (so XLA compiles once per bucket, not per batch), transferred with
+async ``jax.device_put`` overlapped with parsing via the ThreadedIter
+prefetcher (the ThreadedIter role from threadediter.h, now hiding H2D DMA),
+and laid out with per-host batch sharding over a jax.sharding.Mesh.
+"""
+
+from dmlc_tpu.device.csr import DeviceCSRBatch, pad_to_bucket, round_up_bucket
+from dmlc_tpu.device.feed import DeviceFeed, BatchSpec
+
+__all__ = [
+    "DeviceCSRBatch",
+    "pad_to_bucket",
+    "round_up_bucket",
+    "DeviceFeed",
+    "BatchSpec",
+]

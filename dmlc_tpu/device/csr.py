@@ -1,0 +1,121 @@
+"""Device-resident CSR batches with static-shape padding/bucketing.
+
+The reference's ``RowBlock`` (data.h:170) is variable-length CSR on the host.
+XLA wants static shapes: a new shape means a new compilation, and a stream of
+ragged batches would cause a recompilation storm (SURVEY §7 "hard parts").
+
+Policy here:
+- row count is fixed per feed (``batch_size``; the final short batch is
+  padded with zero-weight rows so loss/grad contributions vanish),
+- nnz is rounded up to a bucket (default: next power of two above a floor),
+  padded entries point at index 0 with value 0 so they are arithmetic no-ops,
+- the row-mapping is carried as a per-entry ``row_ids`` array (COO-style),
+  which is what TPU-friendly ``segment_sum`` SpMV consumes — instead of the
+  host CSR ``offset`` array, whose per-row dynamic slicing XLA can't tile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.utils.logging import check
+
+
+def round_up_bucket(n: int, floor: int = 256) -> int:
+    """Next power-of-two ≥ n (with a floor) — the nnz bucketing policy."""
+    n = max(n, floor, 1)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass
+class DeviceCSRBatch:
+    """A static-shape, device-ready sparse batch (host numpy twin).
+
+    Shapes: labels/weights/row_valid are [batch]; indices/values/row_ids are
+    [nnz_bucket]. Padded nnz entries have value 0 at feature 0 and row_id
+    pointing at the first padded row (or row 0 with value 0 — a no-op either
+    way for segment-sum SpMV).
+    """
+
+    labels: np.ndarray  # [batch] f32
+    weights: np.ndarray  # [batch] f32 (0.0 for padded rows)
+    indices: np.ndarray  # [nnz_bucket] i32 feature ids
+    values: np.ndarray  # [nnz_bucket] f32 (0.0 for padded entries)
+    row_ids: np.ndarray  # [nnz_bucket] i32 row of each entry
+    num_rows: int  # valid rows
+    num_nonzero: int  # valid entries
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.labels)
+
+    @property
+    def nnz_bucket(self) -> int:
+        return len(self.indices)
+
+
+def pad_to_bucket(
+    block: RowBlock,
+    batch_size: int,
+    nnz_bucket: Optional[int] = None,
+    nnz_floor: int = 256,
+) -> DeviceCSRBatch:
+    """Pad a host RowBlock slice into a static-shape DeviceCSRBatch."""
+    n = len(block)
+    check(n <= batch_size, "block larger than batch_size")
+    nnz = block.num_nonzero
+    bucket = nnz_bucket if nnz_bucket is not None else round_up_bucket(nnz, nnz_floor)
+    check(nnz <= bucket, "nnz exceeds bucket")
+
+    labels = np.zeros(batch_size, dtype=np.float32)
+    labels[:n] = block.label
+    weights = np.zeros(batch_size, dtype=np.float32)
+    weights[:n] = 1.0 if block.weight is None else block.weight
+
+    indices = np.zeros(bucket, dtype=np.int32)
+    values = np.zeros(bucket, dtype=np.float32)
+    row_ids = np.zeros(bucket, dtype=np.int32)
+    indices[:nnz] = block.index
+    values[:nnz] = (
+        np.ones(nnz, dtype=np.float32) if block.value is None else block.value
+    )
+    row_ids[:nnz] = np.repeat(
+        np.arange(n, dtype=np.int32), np.diff(block.offset).astype(np.int64)
+    )
+    return DeviceCSRBatch(
+        labels=labels,
+        weights=weights,
+        indices=indices,
+        values=values,
+        row_ids=row_ids,
+        num_rows=n,
+        num_nonzero=nnz,
+    )
+
+
+def block_to_dense(
+    block: RowBlock, batch_size: int, num_features: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Densify a RowBlock into fixed [batch, num_features] — the right layout
+    when the feature dim is small/dense (e.g. HIGGS's 28), letting the MXU do
+    a plain matmul instead of gather+segment-sum."""
+    n = len(block)
+    check(n <= batch_size, "block larger than batch_size")
+    x = np.zeros((batch_size, num_features), dtype=np.float32)
+    rows = np.repeat(np.arange(n), np.diff(block.offset).astype(np.int64))
+    vals = (
+        np.ones(block.num_nonzero, dtype=np.float32)
+        if block.value is None
+        else block.value
+    )
+    keep = block.index < num_features
+    x[rows[keep], block.index[keep]] = vals[keep]
+    labels = np.zeros(batch_size, dtype=np.float32)
+    labels[:n] = block.label
+    weights = np.zeros(batch_size, dtype=np.float32)
+    weights[:n] = 1.0 if block.weight is None else block.weight
+    return x, labels, weights

@@ -1,0 +1,26 @@
+"""Mesh / sharding helpers: the TPU replacement for the reference's socket
+tree+ring topologies (tracker.py:185-252).
+
+On TPU the interconnect is a torus and XLA chooses the collective algorithm;
+what remains of the reference's topology bookkeeping is (a) building the
+device mesh, (b) choosing which axes ride ICI vs DCN, and (c) the
+rank/world-size bookkeeping the tracker used to own.
+"""
+
+from dmlc_tpu.parallel.mesh import (
+    make_mesh,
+    data_parallel_mesh,
+    local_mesh,
+    batch_sharding,
+    replicated_sharding,
+    mesh_rank_info,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_parallel_mesh",
+    "local_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "mesh_rank_info",
+]

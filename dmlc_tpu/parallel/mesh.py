@@ -1,0 +1,77 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's tracker computes a binary tree + ring over worker sockets
+(tracker.py:185-252) and brokers the links; on TPU the ICI torus plus XLA's
+collective scheduler replace all of it. These helpers build the standard
+meshes ("dp" over all chips; optional "dcn" outer axis for multi-slice) and
+the shardings the rest of the framework uses.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_sizes: Dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh over ``devices`` (default: all) with the given axis
+    sizes, e.g. {"dp": 8} or {"dp": 4, "mp": 2}. Axis sizes must multiply to
+    the device count; -1 once means "fill"."""
+    devs = list(devices if devices is not None else jax.devices())
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need {total} devices, "
+            f"have {len(devs)}"
+        )
+    arr = np.asarray(devs).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, axis: str = "dp"
+) -> Mesh:
+    """One-axis mesh over every chip — the allreduce-DP topology that
+    replaces the tracker's tree+ring."""
+    devs = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def local_mesh(axis: str = "dp") -> Mesh:
+    """Mesh over this process's addressable devices only."""
+    return Mesh(np.asarray(jax.local_devices()), (axis,))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (parameters / scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def mesh_rank_info() -> Dict[str, int]:
+    """The DMLC_* style rank/world bookkeeping, sourced from JAX.
+
+    Mirrors what the reference tracker hands each worker via env
+    (tracker.py:182-183): rank = process_index, world = process_count.
+    """
+    return {
+        "rank": jax.process_index(),
+        "world_size": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

@@ -1,0 +1,25 @@
+"""Distributed launch & rendezvous control plane.
+
+Capability parity with tracker/dmlc_tracker/ in the reference: the
+``dmlc-submit``-style CLI (opts/submit), the rabit rendezvous tracker
+(rank assignment, tree+ring link maps, peer-link brokering, recover), the
+parameter-server scheduler bootstrap, and per-cluster launchers — plus the
+TPU-new ``--cluster=tpu`` mode that maps rendezvous onto
+``jax.distributed.initialize`` and one worker process per TPU host.
+"""
+
+from dmlc_tpu.tracker.rendezvous import (
+    MAGIC,
+    FramedSocket,
+    RabitTracker,
+    PSTracker,
+    submit_with_tracker,
+)
+
+__all__ = [
+    "MAGIC",
+    "FramedSocket",
+    "RabitTracker",
+    "PSTracker",
+    "submit_with_tracker",
+]

@@ -1,0 +1,465 @@
+"""Rabit-compatible rendezvous tracker.
+
+Capability parity with tracker/dmlc_tracker/tracker.py — same wire protocol,
+so reference rabit workers could rendezvous here and our workers could
+rendezvous with the reference tracker:
+
+- framed int/str TCP protocol with magic 0x_ff99 handshake (ExSocket /
+  SlaveEntry, tracker.py:24-78)
+- worker handshake carries (rank, world_size, jobid, cmd) with
+  cmd ∈ {start, recover, shutdown, print} (tracker.py:66-69)
+- rank assignment: batch assignment sorted by host once all expected workers
+  are pending; jobid→rank map makes ranks stable across restarts; 'recover'
+  re-enters with the old rank (tracker.py:254-320)
+- link maps: binary-heap tree (get_neighbor/get_tree, tracker.py:165-191), a
+  tree-sharing ring for long-message/recovery paths (find_share_ring /
+  get_ring, tracker.py:193-225), relabeled so ring order is contiguous
+  (get_link_map, tracker.py:227-252)
+- peer-link brokering: the goodset/badset reconciliation loop that tells each
+  worker which already-listening peers to dial (assign_rank,
+  tracker.py:80-135)
+- PSTracker scheduler bootstrap exporting DMLC_PS_ROOT_URI/PORT
+  (tracker.py:336-386)
+- world size may be decided by the first worker (tracker.py:281-287)
+
+On TPU this socket machinery is only the *control* plane (CPU-parity runs and
+process bootstrap); the data plane is XLA collectives over ICI — see
+dmlc_tpu.collective.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+MAGIC = 0xFF99
+
+logger = logging.getLogger("dmlc_tpu.tracker")
+
+
+class FramedSocket:
+    """int/str framing over a TCP socket (ExSocket, tracker.py:24-47).
+
+    Ints are native-endian i32 ('@i') to stay wire-compatible.
+    """
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def recv_all(self, nbytes: int) -> bytes:
+        parts = []
+        nread = 0
+        while nread < nbytes:
+            chunk = self.sock.recv(min(nbytes - nread, 65536))
+            if not chunk:
+                raise ConnectionError("peer closed during recv")
+            parts.append(chunk)
+            nread += len(chunk)
+        return b"".join(parts)
+
+    def recv_int(self) -> int:
+        return struct.unpack("@i", self.recv_all(4))[0]
+
+    def send_int(self, value: int) -> None:
+        self.sock.sendall(struct.pack("@i", value))
+
+    def send_str(self, value: str) -> None:
+        data = value.encode()
+        self.send_int(len(data))
+        self.sock.sendall(data)
+
+    def recv_str(self) -> str:
+        return self.recv_all(self.recv_int()).decode()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _resolve_ip(host: str) -> str:
+    return socket.getaddrinfo(host, None)[0][4][0]
+
+
+def get_host_ip(host_ip: Optional[str] = None) -> str:
+    """Best-effort routable IP (tracker.py:389-407)."""
+    if host_ip in (None, "auto", "ip"):
+        try:
+            ip = socket.gethostbyname(socket.getfqdn())
+        except socket.gaierror:
+            ip = socket.gethostbyname(socket.gethostname())
+        if ip.startswith("127."):
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect(("10.255.255.255", 1))
+                ip = probe.getsockname()[0]
+            except OSError:
+                ip = "127.0.0.1"
+            finally:
+                probe.close()
+        return ip
+    if host_ip == "dns":
+        return socket.getfqdn()
+    return host_ip
+
+
+# ---------------------------------------------------------------------------
+# Topology: tree + ring link maps
+# ---------------------------------------------------------------------------
+
+
+def tree_neighbors(rank: int, world: int) -> List[int]:
+    """Binary-heap neighbors of ``rank`` (tracker.py:165-175)."""
+    r1 = rank + 1
+    out = []
+    if r1 > 1:
+        out.append(r1 // 2 - 1)
+    if r1 * 2 - 1 < world:
+        out.append(r1 * 2 - 1)
+    if r1 * 2 < world:
+        out.append(r1 * 2)
+    return out
+
+
+def build_tree(world: int) -> Tuple[Dict[int, List[int]], Dict[int, int]]:
+    tree = {r: tree_neighbors(r, world) for r in range(world)}
+    parent = {r: (r + 1) // 2 - 1 for r in range(world)}
+    return tree, parent
+
+
+def _dfs_share_ring(
+    tree: Dict[int, List[int]], parent: Dict[int, int], root: int
+) -> List[int]:
+    """DFS order that shares edges with the tree (tracker.py:193-210)."""
+    children = [v for v in tree[root] if v != parent[root]]
+    order = [root]
+    for i, child in enumerate(children):
+        sub = _dfs_share_ring(tree, parent, child)
+        if i == len(children) - 1:
+            sub.reverse()
+        order.extend(sub)
+    return order
+
+
+def build_ring(
+    tree: Dict[int, List[int]], parent: Dict[int, int]
+) -> Dict[int, Tuple[int, int]]:
+    order = _dfs_share_ring(tree, parent, 0)
+    world = len(tree)
+    ring: Dict[int, Tuple[int, int]] = {}
+    for pos in range(world):
+        ring[order[pos]] = (order[(pos - 1) % world], order[(pos + 1) % world])
+    return ring
+
+
+def build_link_maps(world: int):
+    """Tree+ring, relabeled so ring order is 0,1,2,... (tracker.py:227-252)."""
+    tree, parent = build_tree(world)
+    ring = build_ring(tree, parent)
+    relabel = {0: 0}
+    cur = 0
+    for i in range(world - 1):
+        cur = ring[cur][1]
+        relabel[cur] = i + 1
+    tree2 = {relabel[k]: [relabel[x] for x in v] for k, v in tree.items()}
+    parent2 = {
+        relabel[k]: (relabel[v] if k != 0 else -1) for k, v in parent.items()
+    }
+    ring2 = {relabel[k]: (relabel[a], relabel[b]) for k, (a, b) in ring.items()}
+    return tree2, parent2, ring2
+
+
+# ---------------------------------------------------------------------------
+# Tracker
+# ---------------------------------------------------------------------------
+
+
+class _Worker:
+    """Tracker-side view of one connected worker (SlaveEntry)."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.conn = FramedSocket(sock)
+        self.host = _resolve_ip(addr[0])
+        magic = self.conn.recv_int()
+        if magic != MAGIC:
+            raise ConnectionError(f"invalid magic {magic:#x} from {self.host}")
+        self.conn.send_int(MAGIC)
+        self.rank = self.conn.recv_int()
+        self.world_size = self.conn.recv_int()
+        self.jobid = self.conn.recv_str()
+        self.cmd = self.conn.recv_str()
+        self.wait_accept = 0
+        self.port: Optional[int] = None
+
+    def decide_rank(self, job_map: Dict[str, int]) -> int:
+        if self.rank >= 0:
+            return self.rank
+        if self.jobid != "NULL" and self.jobid in job_map:
+            return job_map[self.jobid]
+        return -1
+
+    def assign_rank(
+        self,
+        rank: int,
+        wait_conn: Dict[int, "_Worker"],
+        tree: Dict[int, List[int]],
+        parent: Dict[int, int],
+        ring: Dict[int, Tuple[int, int]],
+    ) -> List[int]:
+        """Send topology + broker peer connections (tracker.py:80-135)."""
+        self.rank = rank
+        neighbors: Set[int] = set(tree[rank])
+        rprev, rnext = ring[rank]
+        conn = self.conn
+        conn.send_int(rank)
+        conn.send_int(parent[rank])
+        conn.send_int(len(tree))
+        conn.send_int(len(neighbors))
+        for r in neighbors:
+            conn.send_int(r)
+        if rprev != -1 and rprev != rank:
+            neighbors.add(rprev)
+            conn.send_int(rprev)
+        else:
+            conn.send_int(-1)
+        if rnext != -1 and rnext != rank:
+            neighbors.add(rnext)
+            conn.send_int(rnext)
+        else:
+            conn.send_int(-1)
+        while True:
+            ngood = conn.recv_int()
+            goodset = {conn.recv_int() for _ in range(ngood)}
+            assert goodset.issubset(neighbors), (goodset, neighbors)
+            badset = neighbors - goodset
+            to_connect = [r for r in badset if r in wait_conn]
+            conn.send_int(len(to_connect))
+            conn.send_int(len(badset) - len(to_connect))
+            for r in to_connect:
+                conn.send_str(wait_conn[r].host)
+                conn.send_int(wait_conn[r].port)
+                conn.send_int(r)
+            nerr = conn.recv_int()
+            if nerr != 0:
+                continue
+            self.port = conn.recv_int()
+            done = []
+            for r in to_connect:
+                wait_conn[r].wait_accept -= 1
+                if wait_conn[r].wait_accept == 0:
+                    done.append(r)
+            for r in done:
+                wait_conn.pop(r, None)
+            self.wait_accept = len(badset) - len(to_connect)
+            return done
+
+
+class RabitTracker:
+    """The rendezvous tracker (tracker.py:137-334)."""
+
+    def __init__(
+        self,
+        host_ip: str,
+        num_workers: int,
+        port: int = 9091,
+        port_end: int = 9999,
+    ):
+        family = socket.getaddrinfo(host_ip, None)[0][0]
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        bound = False
+        for p in range(port, port_end):
+            try:
+                sock.bind((host_ip, p))
+                self.port = p
+                bound = True
+                break
+            except OSError as err:
+                if err.errno in (48, 98):  # EADDRINUSE
+                    continue
+                raise
+        if not bound:
+            raise OSError(f"no free tracker port in [{port},{port_end})")
+        sock.listen(256)
+        self.sock = sock
+        self.host_ip = host_ip
+        self.num_workers = num_workers
+        self.thread: Optional[threading.Thread] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        logger.info("tracker listening on %s:%d", host_ip, self.port)
+
+    def worker_envs(self) -> Dict[str, object]:
+        """Env contract handed to workers (tracker.py:177-183)."""
+        return {"DMLC_TRACKER_URI": self.host_ip, "DMLC_TRACKER_PORT": self.port}
+
+    def _accept_loop(self, num_workers: int) -> None:
+        shutdown: Dict[int, _Worker] = {}
+        wait_conn: Dict[int, _Worker] = {}
+        job_map: Dict[str, int] = {}
+        pending: List[_Worker] = []
+        todo: List[int] = []
+        tree = parent = ring = None
+        while len(shutdown) != num_workers:
+            fd, addr = self.sock.accept()
+            try:
+                worker = _Worker(fd, addr)
+            except ConnectionError as err:
+                logger.warning("rejected connection: %s", err)
+                fd.close()
+                continue
+            if worker.cmd == "print":
+                logger.info(worker.conn.recv_str().strip())
+                continue
+            if worker.cmd == "shutdown":
+                assert worker.rank >= 0 and worker.rank not in shutdown
+                shutdown[worker.rank] = worker
+                logger.debug("shutdown from rank %d", worker.rank)
+                continue
+            assert worker.cmd in ("start", "recover"), worker.cmd
+            if tree is None:
+                assert worker.cmd == "start"
+                if worker.world_size > 0:
+                    num_workers = worker.world_size
+                    self.num_workers = num_workers
+                tree, parent, ring = build_link_maps(num_workers)
+                todo = list(range(num_workers))
+            else:
+                assert worker.world_size in (-1, num_workers)
+            if worker.cmd == "recover":
+                assert worker.rank >= 0
+            rank = worker.decide_rank(job_map)
+            if rank == -1:
+                assert todo, "no unassigned ranks left"
+                pending.append(worker)
+                if len(pending) == len(todo):
+                    pending.sort(key=lambda w: w.host)
+                    for w in pending:
+                        r = todo.pop(0)
+                        if w.jobid != "NULL":
+                            job_map[w.jobid] = r
+                        w.assign_rank(r, wait_conn, tree, parent, ring)
+                        if w.wait_accept > 0:
+                            wait_conn[r] = w
+                        logger.debug("assigned rank %d to %s", r, w.host)
+                    pending = []
+                if not todo:
+                    logger.info("@tracker all %d workers started", num_workers)
+                    self.start_time = time.time()
+            else:
+                worker.assign_rank(rank, wait_conn, tree, parent, ring)
+                if worker.wait_accept > 0:
+                    wait_conn[rank] = worker
+                logger.debug("%s from rank %d", worker.cmd, rank)
+        self.end_time = time.time()
+        if self.start_time is not None:
+            logger.info(
+                "@tracker %.3f secs between node start and job finish",
+                self.end_time - self.start_time,
+            )
+
+    def start(self, num_workers: Optional[int] = None) -> None:
+        n = num_workers if num_workers is not None else self.num_workers
+        self.thread = threading.Thread(
+            target=self._accept_loop, args=(n,), daemon=True, name="rabit-tracker"
+        )
+        self.thread.start()
+
+    def join(self) -> None:
+        while self.thread is not None and self.thread.is_alive():
+            self.thread.join(0.1)
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class PSTracker:
+    """Parameter-server scheduler bootstrap (tracker.py:336-386): spawns the
+    user command as DMLC_ROLE=scheduler and advertises DMLC_PS_ROOT_URI/PORT."""
+
+    def __init__(
+        self,
+        host_ip: str,
+        cmd: Optional[str],
+        port: int = 9091,
+        port_end: int = 9999,
+        envs: Optional[Dict[str, object]] = None,
+    ):
+        self.cmd = cmd
+        self.host_ip = host_ip
+        if cmd is None:
+            return
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.port = None
+        for p in range(port, port_end):
+            try:
+                probe.bind(("", p))
+                self.port = p
+                probe.close()
+                break
+            except OSError:
+                continue
+        assert self.port is not None, "no free scheduler port"
+        env = os.environ.copy()
+        env["DMLC_ROLE"] = "scheduler"
+        env["DMLC_PS_ROOT_URI"] = str(host_ip)
+        env["DMLC_PS_ROOT_PORT"] = str(self.port)
+        for k, v in (envs or {}).items():
+            env[k] = str(v)
+        self.thread = threading.Thread(
+            target=lambda: subprocess.check_call(self.cmd, env=env, shell=True),
+            daemon=True,
+            name="ps-scheduler",
+        )
+        self.thread.start()
+
+    def worker_envs(self) -> Dict[str, object]:
+        if self.cmd is None:
+            return {}
+        return {"DMLC_PS_ROOT_URI": self.host_ip, "DMLC_PS_ROOT_PORT": self.port}
+
+    def alive(self) -> bool:
+        return self.cmd is not None and self.thread.is_alive()
+
+    def join(self) -> None:
+        if self.cmd is not None:
+            while self.thread.is_alive():
+                self.thread.join(0.1)
+
+
+def submit_with_tracker(
+    nworker: int,
+    nserver: int,
+    fun_submit: Callable[[int, int, Dict[str, object]], None],
+    host_ip: str = "auto",
+    pscmd: Optional[str] = None,
+) -> None:
+    """Start a tracker, hand env vars to the launcher callback, join
+    (tracker.py:410-433)."""
+    envs: Dict[str, object] = {
+        "DMLC_NUM_WORKER": nworker,
+        "DMLC_NUM_SERVER": nserver,
+    }
+    ip = get_host_ip(host_ip)
+    if nserver == 0:
+        tracker = RabitTracker(host_ip=ip, num_workers=nworker)
+        envs.update(tracker.worker_envs())
+        tracker.start(nworker)
+        if tracker.alive():
+            fun_submit(nworker, nserver, envs)
+        tracker.join()
+    else:
+        ps = PSTracker(host_ip=ip, cmd=pscmd, envs=envs)
+        envs.update(ps.worker_envs())
+        if ps.alive() or pscmd is None:
+            fun_submit(nworker, nserver, envs)
+        ps.join()

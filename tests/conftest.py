@@ -1,21 +1,35 @@
 """Test configuration.
 
 Multi-chip sharding tests run on a virtual 8-device CPU mesh
-(xla_force_host_platform_device_count) so they work without TPU hardware; this
-must be set before jax is first imported anywhere in the test process.
+(xla_force_host_platform_device_count) so they work without TPU hardware.
+
+The session interpreter may boot with a TPU PJRT hook (axon sitecustomize)
+that pre-imports jax and registers a remote-TPU plugin whose backend init
+blocks on a tunnel. Backends are created lazily, so forcing the platform via
+``jax.config.update`` (NOT the JAX_PLATFORMS env var — jax has already been
+imported and won't re-read it) keeps tests on 8 virtual CPU devices and never
+touches the TPU plugin.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA reads XLA_FLAGS from the environment at (lazy) backend creation, so
+# setting it here is still early enough — as long as no test imported jax and
+# created a backend before conftest ran, which pytest's conftest-first
+# ordering guarantees.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocess the tests spawn
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 # Keep test logs quiet and deterministic.
 os.environ.setdefault("DMLC_LOG_STACK_TRACE", "0")
-
-import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
