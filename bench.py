@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Headline benchmark: HIGGS-like LibSVM ingest throughput.
+
+Measures a full pass of the sharded ingest pipeline (InputSplit chunking →
+native chunk parse → CSR RowBlocks) over a deterministic synthetic HIGGS-like
+file (600k rows × 28 dense features ≈ 190 MB), the same workload as the
+reference's `test/libsvm_parser_test.cc` harness.
+
+vs_baseline compares against the reference C++ parser (libsvm_parser_test,
+compiled -O3, best of nthread ∈ {4,8,16}) measured on the same class of host:
+334 MB/s (see BASELINE.md "measured" section).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+REFERENCE_MBPS = 334.0  # reference libsvm_parser_test on this host class
+ROWS = 600_000
+FEATURES = 28
+CACHE_DIR = os.environ.get("DMLC_TPU_BENCH_DIR", "/tmp/dmlc_tpu_bench")
+DATA_PATH = os.path.join(CACHE_DIR, f"higgs_like_{ROWS}.svm")
+
+
+def _ensure_data() -> str:
+    if os.path.exists(DATA_PATH) and os.path.getsize(DATA_PATH) > 0:
+        return DATA_PATH
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    import numpy as np
+
+    rng = np.random.RandomState(42)
+    tmp = DATA_PATH + ".tmp"
+    with open(tmp, "w") as fh:
+        chunk_rows = 20_000
+        for start in range(0, ROWS, chunk_rows):
+            n = min(chunk_rows, ROWS - start)
+            labels = rng.randint(0, 2, size=n)
+            vals = rng.rand(n, FEATURES)
+            lines = []
+            for i in range(n):
+                row = vals[i]
+                lines.append(
+                    str(labels[i])
+                    + " "
+                    + " ".join(
+                        f"{j + 1}:{row[j]:.6f}" for j in range(FEATURES)
+                    )
+                )
+            fh.write("\n".join(lines) + "\n")
+    os.replace(tmp, DATA_PATH)
+    return DATA_PATH
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    path = _ensure_data()
+
+    from dmlc_tpu.data import create_parser
+
+    best = 0.0
+    for _trial in range(3):
+        t0 = time.time()
+        parser = create_parser(path, 0, 1, nthread=2)
+        rows = 0
+        nnz = 0
+        for block in parser:
+            rows += len(block)
+            nnz += block.num_nonzero
+        dt = time.time() - t0
+        parser.close()
+        assert rows == ROWS, f"row count mismatch: {rows}"
+        assert nnz == ROWS * FEATURES, f"nnz mismatch: {nnz}"
+        mbps = parser.bytes_read / (1 << 20) / dt
+        best = max(best, mbps)
+
+    print(
+        json.dumps(
+            {
+                "metric": "higgs_libsvm_ingest",
+                "value": round(best, 1),
+                "unit": "MB/s",
+                "vs_baseline": round(best / REFERENCE_MBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,298 @@
+// Native hot loops: text chunk -> CSR arrays.
+//
+// TPU-build equivalent of the reference's parse path (src/data/strtonum.h,
+// libsvm_parser.h, libfm_parser.h, csv_parser.h): the chunk-level tokenize +
+// numeric-convert loop is the ingest bottleneck, so it lives in C++ behind a
+// flat C ABI (ctypes-loadable, zero Python objects inside). Design differs
+// from the reference: single forward scan with branch-light inline float
+// parsing, caller-allocated output arrays (upper bounds derived from the
+// chunk), and row/nnz counts returned for exact trimming. No OpenMP — the
+// Python side maps chunk pieces onto a thread pool and ctypes releases the
+// GIL, so parallelism composes at the chunk level.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+namespace {
+
+inline bool is_space(char c) { return c == ' ' || c == '\t'; }
+
+// '\r' is a line terminator (LineSplitter record boundaries accept \n, \r,
+// and \r\n), never inline whitespace — treating it as a space would merge
+// adjacent rows.
+inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
+
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
+// Fast float scan: sign, integer part, fraction, optional exponent.
+// Handles the common data-file cases inline; no INF/NAN/hex (same contract
+// as the reference's strtonum.h:37, by design: data files don't contain
+// them, and rejecting keeps the loop branch-light).
+inline const char* scan_double(const char* p, const char* end, double* out) {
+  if (p == end) return nullptr;
+  bool neg = false;
+  if (*p == '-') { neg = true; ++p; }
+  else if (*p == '+') { ++p; }
+  if (p == end || (!is_digit(*p) && *p != '.')) return nullptr;
+  double val = 0.0;
+  while (p != end && is_digit(*p)) {
+    val = val * 10.0 + (*p - '0');
+    ++p;
+  }
+  if (p != end && *p == '.') {
+    ++p;
+    double scale = 0.1;
+    while (p != end && is_digit(*p)) {
+      val += (*p - '0') * scale;
+      scale *= 0.1;
+      ++p;
+    }
+  }
+  if (p != end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p != end && (*p == '-' || *p == '+')) { eneg = (*p == '-'); ++p; }
+    int ex = 0;
+    while (p != end && is_digit(*p)) { ex = ex * 10 + (*p - '0'); ++p; }
+    val *= std::pow(10.0, eneg ? -ex : ex);
+  }
+  *out = neg ? -val : val;
+  return p;
+}
+
+inline const char* scan_u64(const char* p, const char* end, uint64_t* out) {
+  if (p == end || !is_digit(*p)) return nullptr;
+  uint64_t v = 0;
+  while (p != end && is_digit(*p)) { v = v * 10 + (*p - '0'); ++p; }
+  *out = v;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Status codes shared by all parsers.
+enum {
+  DMLC_TPU_OK = 0,
+  DMLC_TPU_EOVERFLOW = -1,  // output capacity exceeded
+  DMLC_TPU_EPARSE = -2,     // malformed input
+};
+
+// Feature flags reported by parse_libsvm.
+enum {
+  DMLC_TPU_HAS_WEIGHT = 1,
+  DMLC_TPU_HAS_QID = 2,
+  DMLC_TPU_HAS_VALUE = 4,
+};
+
+// Parse libsvm text: "label[:weight] [qid:n] idx[:val] ..." per line.
+// Outputs: labels/weights [max_rows], qids [max_rows], row_nnz [max_rows],
+// indices/values [max_nnz]. Rows with no explicit weight get 1.0; bare
+// indices get value 1.0. Returns DMLC_TPU_OK/errors; *out_rows, *out_nnz,
+// *out_flags are filled on success.
+int parse_libsvm(const char* data, int64_t len,
+                 float* labels, float* weights, int64_t* qids,
+                 int64_t* row_nnz, uint64_t* indices, float* values,
+                 int64_t max_rows, int64_t max_nnz,
+                 int64_t* out_rows, int64_t* out_nnz, int* out_flags) {
+  const char* p = data;
+  const char* end = data + len;
+  int64_t rows = 0, nnz = 0;
+  int flags = 0;
+  while (p != end) {
+    while (p != end && (is_space(*p) || is_eol(*p))) ++p;
+    if (p == end) break;
+    // label [:weight]
+    double label;
+    const char* q = scan_double(p, end, &label);
+    if (q == nullptr) return DMLC_TPU_EPARSE;
+    p = q;
+    double weight = 1.0;
+    if (p != end && *p == ':') {
+      ++p;
+      q = scan_double(p, end, &weight);
+      if (q == nullptr) return DMLC_TPU_EPARSE;
+      p = q;
+      flags |= DMLC_TPU_HAS_WEIGHT;
+    }
+    if (rows >= max_rows) return DMLC_TPU_EOVERFLOW;
+    // missing qid -> 0, matching RowBlockContainer's neutral-default policy
+    // (and the pure-Python twin)
+    int64_t qid = 0;
+    int64_t row_start = nnz;
+    // features until newline
+    for (;;) {
+      while (p != end && is_space(*p)) ++p;
+      if (p == end || is_eol(*p)) {
+        if (p != end) ++p;
+        break;
+      }
+      if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
+        uint64_t qv;
+        q = scan_u64(p + 4, end, &qv);
+        if (q == nullptr) return DMLC_TPU_EPARSE;
+        qid = static_cast<int64_t>(qv);
+        flags |= DMLC_TPU_HAS_QID;
+        p = q;
+        continue;
+      }
+      uint64_t idx;
+      q = scan_u64(p, end, &idx);
+      if (q == nullptr) return DMLC_TPU_EPARSE;
+      p = q;
+      double val = 1.0;
+      if (p != end && *p == ':') {
+        ++p;
+        q = scan_double(p, end, &val);
+        if (q == nullptr) return DMLC_TPU_EPARSE;
+        p = q;
+        flags |= DMLC_TPU_HAS_VALUE;
+      }
+      if (nnz >= max_nnz) return DMLC_TPU_EOVERFLOW;
+      indices[nnz] = idx;
+      values[nnz] = static_cast<float>(val);
+      ++nnz;
+    }
+    labels[rows] = static_cast<float>(label);
+    weights[rows] = static_cast<float>(weight);
+    qids[rows] = qid;
+    row_nnz[rows] = nnz - row_start;
+    ++rows;
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  *out_flags = flags;
+  return DMLC_TPU_OK;
+}
+
+// Parse libfm text: "label field:idx:val ..." per line. Outputs as libsvm
+// plus fields [max_nnz].
+int parse_libfm(const char* data, int64_t len,
+                float* labels, int64_t* row_nnz,
+                uint64_t* fields, uint64_t* indices, float* values,
+                int64_t max_rows, int64_t max_nnz,
+                int64_t* out_rows, int64_t* out_nnz) {
+  const char* p = data;
+  const char* end = data + len;
+  int64_t rows = 0, nnz = 0;
+  while (p != end) {
+    while (p != end && (is_space(*p) || is_eol(*p))) ++p;
+    if (p == end) break;
+    double label;
+    const char* q = scan_double(p, end, &label);
+    if (q == nullptr) return DMLC_TPU_EPARSE;
+    p = q;
+    if (rows >= max_rows) return DMLC_TPU_EOVERFLOW;
+    int64_t row_start = nnz;
+    for (;;) {
+      while (p != end && is_space(*p)) ++p;
+      if (p == end || is_eol(*p)) {
+        if (p != end) ++p;
+        break;
+      }
+      uint64_t field, idx;
+      double val;
+      q = scan_u64(p, end, &field);
+      if (q == nullptr || q == end || *q != ':') return DMLC_TPU_EPARSE;
+      q = scan_u64(q + 1, end, &idx);
+      if (q == nullptr || q == end || *q != ':') return DMLC_TPU_EPARSE;
+      q = scan_double(q + 1, end, &val);
+      if (q == nullptr) return DMLC_TPU_EPARSE;
+      p = q;
+      if (nnz >= max_nnz) return DMLC_TPU_EOVERFLOW;
+      fields[nnz] = field;
+      indices[nnz] = idx;
+      values[nnz] = static_cast<float>(val);
+      ++nnz;
+    }
+    labels[rows] = static_cast<float>(label);
+    row_nnz[rows] = nnz - row_start;
+    ++rows;
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  return DMLC_TPU_OK;
+}
+
+// Parse dense CSV (no quoting — numeric data files): every line becomes
+// ncols doubles; the first line fixes ncols. Outputs values row-major into
+// out [max_rows * expect_cols]. If expect_cols == 0 it is inferred and
+// written to *out_cols.
+int parse_csv(const char* data, int64_t len, float* out,
+              int64_t max_rows, int64_t expect_cols,
+              int64_t* out_rows, int64_t* out_cols) {
+  const char* p = data;
+  const char* end = data + len;
+  int64_t rows = 0;
+  int64_t ncols = expect_cols;
+  while (p != end) {
+    while (p != end && is_eol(*p)) ++p;
+    if (p == end) break;
+    if (rows >= max_rows) return DMLC_TPU_EOVERFLOW;
+    int64_t col = 0;
+    float* row_out = out + rows * (ncols > 0 ? ncols : 0);
+    for (;;) {
+      double val = 0.0;
+      while (p != end && is_space(*p)) ++p;
+      if (p != end && *p != ',' && !is_eol(*p)) {
+        const char* q = scan_double(p, end, &val);
+        if (q == nullptr) return DMLC_TPU_EPARSE;
+        p = q;
+        while (p != end && is_space(*p)) ++p;
+      }
+      if (ncols > 0) {
+        if (col >= ncols) return DMLC_TPU_EPARSE;
+        row_out[col] = static_cast<float>(val);
+      } else {
+        // inference pass for first row: caller guarantees capacity via
+        // max_rows * (commas in first line + 1)
+        out[col] = static_cast<float>(val);
+      }
+      ++col;
+      if (p == end || is_eol(*p)) {
+        if (p != end) ++p;
+        break;
+      }
+      if (*p != ',') return DMLC_TPU_EPARSE;
+      ++p;
+    }
+    if (ncols <= 0) {
+      ncols = col;
+      row_out = out;
+    } else if (col != ncols) {
+      return DMLC_TPU_EPARSE;
+    }
+    ++rows;
+  }
+  *out_rows = rows;
+  *out_cols = ncols;
+  return DMLC_TPU_OK;
+}
+
+// One-pass upper-bound counter for output sizing: *out_rows = newline count
+// + 1, *out_tokens = whitespace-delimited token count (>= nnz + rows).
+void count_tokens(const char* data, int64_t len,
+                  int64_t* out_rows, int64_t* out_tokens) {
+  int64_t rows = 1, tokens = 0;
+  bool in_tok = false;
+  for (int64_t i = 0; i < len; ++i) {
+    char c = data[i];
+    if (is_eol(c)) {
+      ++rows;
+      in_tok = false;
+    } else if (is_space(c)) {
+      in_tok = false;
+    } else if (!in_tok) {
+      in_tok = true;
+      ++tokens;
+    }
+  }
+  *out_rows = rows;
+  *out_tokens = tokens;
+}
+
+int dmlc_tpu_abi_version() { return 1; }
+
+}  // extern "C"

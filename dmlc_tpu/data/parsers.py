@@ -118,10 +118,54 @@ def _tokens_to_floats(tokens: List[bytes]) -> np.ndarray:
     return np.asarray(tokens, dtype="S").astype(np.float64)
 
 
+def _native_libsvm(chunk: bytes) -> Optional[RowBlockContainer]:
+    """Native-core libsvm chunk parse (cpp/parse.cc); None → python path."""
+    from dmlc_tpu import native
+
+    parsed = native.parse_libsvm_chunk(chunk)
+    if parsed is None:
+        return None
+    out = RowBlockContainer()
+    if len(parsed["labels"]) == 0:
+        return out
+    flags = parsed["flags"]
+    out.push_arrays(
+        parsed["labels"],
+        parsed["counts"],
+        parsed["indices"],
+        value=parsed["values"] if flags & native.HAS_VALUE else None,
+        weight=parsed["weights"] if flags & native.HAS_WEIGHT else None,
+        qid=parsed["qids"] if flags & native.HAS_QID else None,
+    )
+    return out
+
+
+def _native_libfm(chunk: bytes) -> Optional[RowBlockContainer]:
+    from dmlc_tpu import native
+
+    parsed = native.parse_libfm_chunk(chunk)
+    if parsed is None:
+        return None
+    out = RowBlockContainer()
+    if len(parsed["labels"]) == 0:
+        return out
+    out.push_arrays(
+        parsed["labels"],
+        parsed["counts"],
+        parsed["indices"],
+        value=parsed["values"],
+        field=parsed["fields"],
+    )
+    return out
+
+
 class LibSVMParser(Parser):
     """``label[:weight] [qid:n] index[:value]...`` (libsvm_parser.h)."""
 
     def parse_chunk(self, chunk: bytes) -> RowBlockContainer:
+        native_out = _native_libsvm(chunk)
+        if native_out is not None:
+            return native_out
         out = RowBlockContainer()
         if b"qid:" in chunk:
             self._parse_general(chunk, out)
@@ -226,6 +270,9 @@ class LibFMParser(Parser):
     """``label field:index:value`` triples (libfm_parser.h:35-90)."""
 
     def parse_chunk(self, chunk: bytes) -> RowBlockContainer:
+        native_out = _native_libfm(chunk)
+        if native_out is not None:
+            return native_out
         out = RowBlockContainer()
         lines = [ln for ln in chunk.splitlines() if ln.strip()]
         if not lines:
@@ -291,33 +338,47 @@ class CSVParser(Parser):
         check(self.param.format == "csv", "CSVParser requires format=csv")
 
     def parse_chunk(self, chunk: bytes) -> RowBlockContainer:
+        from dmlc_tpu import native
+
         out = RowBlockContainer()
+        table = native.parse_csv_chunk(chunk)
+        if table is not None:
+            if len(table) == 0:
+                return out
+            return self._table_to_block(table, out)
         lines = [ln for ln in chunk.splitlines() if ln.strip()]
         if not lines:
             return out
         ncols = lines[0].count(b",") + 1
         uniform = all(ln.count(b",") + 1 == ncols for ln in lines)
-        label_col = self.param.label_column
-        weight_col = self.param.weight_column
         if uniform:
-            table = (
-                np.asarray(b",".join(lines).split(b","), dtype="S")
-                .astype(np.float64)
-                .reshape(len(lines), ncols)
-            )
+            cells = np.asarray(b",".join(lines).split(b","), dtype="S")
+            # empty cells parse as 0.0 (strtof-on-empty semantics)
+            cells = np.where(cells == b"", b"0", cells)
+            table = cells.astype(np.float64).reshape(len(lines), ncols)
         else:
             # ragged csv: pad per line (reference treats each line separately)
             rows = [
-                np.asarray(ln.split(b","), dtype="S").astype(np.float64)
+                np.asarray(
+                    [c or b"0" for c in ln.split(b",")], dtype="S"
+                ).astype(np.float64)
                 for ln in lines
             ]
             width = max(len(r) for r in rows)
             table = np.zeros((len(rows), width), dtype=np.float64)
             for i, r in enumerate(rows):
                 table[i, : len(r)] = r
-            ncols = width
+        return self._table_to_block(table, out)
+
+    def _table_to_block(
+        self, table: np.ndarray, out: RowBlockContainer
+    ) -> RowBlockContainer:
+        """Split label/weight columns out of a dense table → CSR block."""
+        nrows, ncols = table.shape
+        label_col = self.param.label_column
+        weight_col = self.param.weight_column
         keep = np.ones(ncols, dtype=bool)
-        labels = np.zeros(len(lines), dtype=REAL_DTYPE)
+        labels = np.zeros(nrows, dtype=REAL_DTYPE)
         weight = None
         if 0 <= label_col < ncols:
             labels = table[:, label_col].astype(REAL_DTYPE)
@@ -327,13 +388,13 @@ class CSVParser(Parser):
             keep[weight_col] = False
         data = table[:, keep]
         nfeat = data.shape[1]
-        counts = np.full(len(lines), nfeat, dtype=np.int64)
-        index = np.tile(np.arange(nfeat, dtype=INDEX_DTYPE), len(lines))
+        counts = np.full(nrows, nfeat, dtype=np.int64)
+        index = np.tile(np.arange(nfeat, dtype=INDEX_DTYPE), nrows)
         out.push_arrays(
             labels,
             counts,
             index,
-            value=data.reshape(-1).astype(REAL_DTYPE),
+            value=np.ascontiguousarray(data).reshape(-1).astype(REAL_DTYPE),
             weight=weight,
         )
         return out

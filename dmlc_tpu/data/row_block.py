@@ -150,33 +150,39 @@ class RowBlock:
 
 
 class RowBlockContainer:
-    """Growable CSR builder (src/data/row_block.h:26-215)."""
+    """Growable CSR builder (src/data/row_block.h:26-215).
+
+    Internals are lists of numpy array *parts* concatenated once at
+    ``to_block`` — pushes are O(1) appends with no Python-object conversion
+    (the host ingest hot path runs through here; list-of-float accumulation
+    was the original bottleneck). weight/qid/value follow an any-present
+    policy: omitted entries get neutral defaults (1.0 / 0 / ones) rather than
+    being silently dropped (the reference CHECK-fails on count mismatch,
+    row_block.h GetBlock).
+    """
 
     def __init__(self, index_dtype=INDEX_DTYPE):
         self.index_dtype = index_dtype
         self.clear()
 
     def clear(self) -> None:
-        self._offsets: List[int] = [0]
-        self._labels: List[float] = []
-        # weight/qid/value are kept dense with neutral defaults (1.0 / 0 /
-        # ones) and emitted only if any push supplied them — mixing weighted
-        # and unweighted rows must not silently drop data (the reference
-        # CHECK-fails on count mismatch instead, row_block.h GetBlock).
-        self._weights: List[float] = []
-        self._any_weight = False
-        self._qids: List[int] = []
-        self._any_qid = False
-        self._any_value = False
+        self._count_parts: List[np.ndarray] = []
+        self._label_parts: List[np.ndarray] = []
+        self._weight_parts: List[Optional[np.ndarray]] = []
+        self._qid_parts: List[Optional[np.ndarray]] = []
         self._index_parts: List[np.ndarray] = []
         self._value_parts: List[Optional[np.ndarray]] = []
         self._field_parts: List[Optional[np.ndarray]] = []
+        self._any_weight = False
+        self._any_qid = False
+        self._any_value = False
         self.max_index = 0
+        self._nrows = 0
         self._nnz = 0
 
     @property
     def size(self) -> int:
-        return len(self._labels)
+        return self._nrows
 
     def __len__(self) -> int:
         return self.size
@@ -190,22 +196,15 @@ class RowBlockContainer:
         qid: Optional[int] = None,
         field: Optional[Sequence[int]] = None,
     ) -> None:
-        idx = np.asarray(index, dtype=self.index_dtype)
-        if len(idx):
-            self.max_index = max(self.max_index, int(idx.max()))
-        self._index_parts.append(idx)
-        self._value_parts.append(
-            None if value is None else np.asarray(value, dtype=REAL_DTYPE)
+        self.push_arrays(
+            np.asarray([label], dtype=REAL_DTYPE),
+            np.asarray([len(index)], dtype=np.int64),
+            np.asarray(index, dtype=self.index_dtype),
+            value=None if value is None else np.asarray(value, dtype=REAL_DTYPE),
+            weight=None if weight is None else np.asarray([weight], dtype=REAL_DTYPE),
+            qid=None if qid is None else np.asarray([qid], dtype=np.int64),
+            field=None if field is None else np.asarray(field),
         )
-        self._field_parts.append(None if field is None else np.asarray(field))
-        self._labels.append(float(label))
-        self._weights.append(1.0 if weight is None else float(weight))
-        self._any_weight = self._any_weight or weight is not None
-        self._qids.append(0 if qid is None else int(qid))
-        self._any_qid = self._any_qid or qid is not None
-        self._any_value = self._any_value or value is not None
-        self._nnz += len(idx)
-        self._offsets.append(self._nnz)
 
     def push_arrays(
         self,
@@ -219,20 +218,17 @@ class RowBlockContainer:
     ) -> None:
         """Bulk append many rows at once (the vectorized parser path)."""
         check_eq(len(labels), len(counts), "labels/counts mismatch")
-        self._labels.extend(labels.tolist())
         if weight is not None:
             check_eq(len(weight), len(labels), "weight/labels mismatch")
-            self._weights.extend(weight.tolist())
             self._any_weight = True
-        else:
-            self._weights.extend([1.0] * len(labels))
         if qid is not None:
             check_eq(len(qid), len(labels), "qid/labels mismatch")
-            self._qids.extend(qid.tolist())
             self._any_qid = True
-        else:
-            self._qids.extend([0] * len(labels))
         self._any_value = self._any_value or value is not None
+        self._label_parts.append(np.asarray(labels, dtype=REAL_DTYPE))
+        self._count_parts.append(np.asarray(counts, dtype=np.int64))
+        self._weight_parts.append(weight)
+        self._qid_parts.append(qid)
         idx = np.asarray(index, dtype=self.index_dtype)
         if len(idx):
             self.max_index = max(self.max_index, int(idx.max()))
@@ -241,9 +237,8 @@ class RowBlockContainer:
             None if value is None else np.asarray(value, dtype=REAL_DTYPE)
         )
         self._field_parts.append(None if field is None else np.asarray(field))
-        ends = self._nnz + np.cumsum(counts)
-        self._offsets.extend(ends.tolist())
-        self._nnz = int(ends[-1]) if len(ends) else self._nnz
+        self._nrows += len(labels)
+        self._nnz += len(idx)
 
     def push_block(self, block: RowBlock) -> None:
         """Append a whole RowBlock (row_block.h Push(RowBlock))."""
@@ -260,15 +255,25 @@ class RowBlockContainer:
 
     def to_block(self) -> RowBlock:
         """Finalize into a RowBlock view (row_block.h GetBlock :169-188)."""
-        nrows = len(self._labels)
-        fields_present = [f for f in self._field_parts if f is not None]
+        nrows = self._nrows
+        counts = (
+            np.concatenate(self._count_parts)
+            if self._count_parts
+            else np.empty(0, dtype=np.int64)
+        )
+        offset = np.zeros(nrows + 1, dtype=np.int64)
+        np.cumsum(counts, out=offset[1:])
         index = (
             np.concatenate(self._index_parts)
             if self._index_parts
             else np.empty(0, dtype=self.index_dtype)
         )
-        # value/weight/qid are emitted only if some push supplied them; parts
-        # that omitted values get explicit ones so lengths always match nnz.
+        label = (
+            np.concatenate(self._label_parts)
+            if self._label_parts
+            else np.empty(0, dtype=REAL_DTYPE)
+        )
+        # optional arrays: fill neutral defaults for parts that omitted them
         value = None
         if self._any_value:
             value = np.concatenate(
@@ -278,20 +283,27 @@ class RowBlockContainer:
                 ]
                 or [np.empty(0, dtype=REAL_DTYPE)]
             )
+        fields_present = [f for f in self._field_parts if f is not None]
         field = np.concatenate(fields_present) if fields_present else None
-        weight = (
-            np.asarray(self._weights, dtype=REAL_DTYPE)
-            if self._any_weight and nrows
-            else None
-        )
-        qid = (
-            np.asarray(self._qids, dtype=np.int64)
-            if self._any_qid and nrows
-            else None
-        )
+        weight = None
+        if self._any_weight and nrows:
+            weight = np.concatenate(
+                [
+                    np.ones(len(lbl), dtype=REAL_DTYPE) if w is None else w
+                    for w, lbl in zip(self._weight_parts, self._label_parts)
+                ]
+            )
+        qid = None
+        if self._any_qid and nrows:
+            qid = np.concatenate(
+                [
+                    np.zeros(len(lbl), dtype=np.int64) if q is None else q
+                    for q, lbl in zip(self._qid_parts, self._label_parts)
+                ]
+            )
         return RowBlock(
-            offset=np.asarray(self._offsets, dtype=np.int64),
-            label=np.asarray(self._labels, dtype=REAL_DTYPE),
+            offset=offset,
+            label=label,
             index=index,
             value=value,
             weight=weight,
@@ -336,7 +348,7 @@ class RowBlockContainer:
     def mem_cost_bytes(self) -> int:
         """Incremental size estimate of the finalized block — O(1), no
         materialization (data.h MemCostBytes:194-208)."""
-        nrows = len(self._labels)
+        nrows = self._nrows
         idx_item = np.dtype(self.index_dtype).itemsize
         cost = (nrows + 1) * 8 + nrows * 4 + self._nnz * idx_item
         if self._any_value:

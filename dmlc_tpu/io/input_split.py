@@ -230,32 +230,34 @@ class InputSplitBase(InputSplit):
             self._file_ptr += 1
             self._close_stream()
             self._fs_stream = self._open(self._file_ptr)
+        if len(parts) == 1:
+            return parts[0]
         return b"".join(parts)
 
     # ---- chunk loading (ReadChunk + Chunk::Load semantics) -------------
     def _load_chunk(self) -> Optional[bytes]:
         """Next chunk containing only whole records, or None at end."""
         target = self._chunk_bytes
-        buf = bytearray(self._overflow)
+        overflow = self._overflow
         self._overflow = b""
+        data = self._read_range(target - len(overflow))
+        # fast path: no pending overflow join needed
+        buf = (overflow + data) if overflow else data
+        if not buf:
+            return None
         while True:
-            data = self._read_range(target - len(buf))
-            buf.extend(data)
-            if not buf:
-                return None
             if len(buf) < target:
                 # End of the partition range: remainder is the final chunk
                 # (its end was extended to a record boundary).
                 return bytes(buf)
-            pos = self.find_last_record_begin(bytes(buf))
-            if pos == 0:
-                # No record boundary inside: grow and read more
-                # (Chunk::Load doubling, input_split_base.cc:241-258).
-                target *= 2
-                continue
-            self._overflow = bytes(buf[pos:])
-            del buf[pos:]
-            return bytes(buf)
+            pos = self.find_last_record_begin(buf)
+            if pos != 0:
+                self._overflow = bytes(buf[pos:])
+                return bytes(memoryview(buf)[:pos])
+            # No record boundary inside: grow and read more
+            # (Chunk::Load doubling, input_split_base.cc:241-258).
+            target *= 2
+            buf = buf + self._read_range(target - len(buf))
 
     # ---- public API ----------------------------------------------------
     def next_chunk(self) -> Optional[bytes]:

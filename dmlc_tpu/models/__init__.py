@@ -1,0 +1,38 @@
+"""Demo learner families on top of the ingest + collective stack.
+
+The reference is a backbone library, not a model zoo — its downstream
+consumers (xgboost/rabit/mxnet) supply the learners. The BASELINE north star
+for this rebuild names one concrete end-to-end model — LibSVM allreduce-SGD —
+so this package ships that learner family TPU-natively:
+
+- ``linear``: logistic / squared / hinge linear models, dense or sparse-CSR
+  batches, data-parallel psum gradient sync over a mesh axis
+- ``fm``: factorization machines (the libfm format's model family), embedding
+  table sharded or replicated, same segment-sum sparse kernels
+"""
+
+from dmlc_tpu.models.linear import (
+    LinearModelParam,
+    LinearLearner,
+    init_linear_params,
+    make_linear_train_step,
+    linear_predict_dense,
+)
+from dmlc_tpu.models.fm import (
+    FMParam,
+    FMLearner,
+    init_fm_params,
+    make_fm_train_step,
+)
+
+__all__ = [
+    "LinearModelParam",
+    "LinearLearner",
+    "init_linear_params",
+    "make_linear_train_step",
+    "linear_predict_dense",
+    "FMParam",
+    "FMLearner",
+    "init_fm_params",
+    "make_fm_train_step",
+]

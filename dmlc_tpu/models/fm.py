@@ -1,0 +1,192 @@
+"""Factorization machines over COO device batches.
+
+The libfm format the reference parses (libfm_parser.h) exists to feed this
+model family; the reference ships the parser and leaves the model downstream.
+TPU-first formulation: all per-entry work is gathers + segment_sums (static
+shapes), and the O(nnz·K) factor math is batched so XLA can keep it on the
+vector units; the factor table gradient is one scatter-add.
+
+score(x) = b + Σ_i w_i x_i + ½ Σ_k [(Σ_i v_ik x_i)² − Σ_i v_ik² x_i²]
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dmlc_tpu.models.linear import _margin_grad, step_batch
+from dmlc_tpu.ops.spmv import spmv, spmv_transpose
+from dmlc_tpu.params.parameter import Parameter, field
+from dmlc_tpu.utils.logging import check
+
+
+class FMParam(Parameter):
+    objective = field(str, "logistic")
+    learning_rate = field(float, 0.05, lower_bound=0.0)
+    l2 = field(float, 0.0, lower_bound=0.0)
+    num_factors = field(int, 8, lower_bound=1)
+    num_features = field(int, 0)
+    init_scale = field(float, 0.01, lower_bound=0.0)
+
+
+def init_fm_params(
+    num_features: int, num_factors: int, init_scale: float = 0.01, seed: int = 0
+) -> Dict:
+    key = jax.random.PRNGKey(seed)
+    return {
+        "w": jnp.zeros((num_features,), dtype=jnp.float32),
+        "b": jnp.zeros((), dtype=jnp.float32),
+        "v": init_scale
+        * jax.random.normal(key, (num_features, num_factors), dtype=jnp.float32),
+    }
+
+
+def _fm_forward_grads(params, batch, objective: str, num_features: int):
+    """Local (unreduced) grads + loss sums for one COO batch shard."""
+    label = batch["label"]
+    weight = batch["weight"]
+    values = batch["values"]
+    indices = batch["indices"]
+    row_ids = batch["row_ids"]
+    num_rows = label.shape[0]
+
+    v_e = jnp.take(params["v"], indices, axis=0)  # [nnz, K]
+    xv = values[:, None] * v_e  # [nnz, K]
+    s = jax.ops.segment_sum(xv, row_ids, num_segments=num_rows)  # [B, K]
+    q = jax.ops.segment_sum(xv * xv, row_ids, num_segments=num_rows)
+    linear = spmv(values, indices, row_ids, params["w"], num_rows)
+    margin = params["b"] + linear + 0.5 * jnp.sum(s * s - q, axis=-1)
+
+    loss, gmargin = _margin_grad(objective, margin, label)
+    wg = weight * gmargin  # [B]
+
+    gw = spmv_transpose(values, indices, row_ids, wg, num_features)
+    gb = jnp.sum(wg)
+    # dv[i,k]: per entry x_e * (s[r,k] − x_e v[i,k]), scaled by wg[r]
+    s_e = jnp.take(s, row_ids, axis=0)  # [nnz, K]
+    dv_entry = (wg[row_ids] * values)[:, None] * (s_e - xv)
+    gv = jax.ops.segment_sum(dv_entry, indices, num_segments=num_features)
+    return gw, gb, gv, jnp.sum(weight * loss), jnp.sum(weight)
+
+
+def make_fm_train_step(
+    mesh: Optional[Mesh],
+    num_features: int,
+    objective: str = "logistic",
+    learning_rate: float = 0.05,
+    l2: float = 0.0,
+    axis: str = "dp",
+):
+    """Jitted FM SGD step over COO batches; one fused psum on the mesh."""
+    check(num_features > 0, "num_features required")
+
+    def _apply(params, gw, gb, gv, wsum):
+        denom = jnp.maximum(wsum, 1e-12)
+        return {
+            "w": params["w"] - learning_rate * (gw / denom + l2 * params["w"]),
+            "b": params["b"] - learning_rate * (gb / denom),
+            "v": params["v"] - learning_rate * (gv / denom + l2 * params["v"]),
+        }
+
+    if mesh is None:
+
+        @jax.jit
+        def step(params, batch):
+            gw, gb, gv, loss_sum, wsum = _fm_forward_grads(
+                params, batch, objective, num_features
+            )
+            params = _apply(params, gw, gb, gv, wsum)
+            return params, {"loss_sum": loss_sum, "weight_sum": wsum}
+
+        return step
+
+    batch_specs = {
+        "label": P(axis),
+        "weight": P(axis),
+        "indices": P(),
+        "values": P(),
+        "row_ids": P(),
+    }
+
+    def _sharded(params, batch):
+        n_local = batch["label"].shape[0]
+        base = jax.lax.axis_index(axis) * n_local
+        local_ids = batch["row_ids"] - base
+        oob = (local_ids < 0) | (local_ids >= n_local)
+        local = dict(batch)
+        local["row_ids"] = jnp.where(oob, 0, local_ids)
+        local["values"] = jnp.where(oob, 0.0, batch["values"])
+        gw, gb, gv, loss_sum, wsum = _fm_forward_grads(
+            params, local, objective, num_features
+        )
+        gw, gb, gv, loss_sum, wsum = jax.lax.psum(
+            (gw, gb, gv, loss_sum, wsum), axis_name=axis
+        )
+        params = _apply(params, gw, gb, gv, wsum)
+        return params, {"loss_sum": loss_sum, "weight_sum": wsum}
+
+    step = jax.shard_map(
+        _sharded, mesh=mesh, in_specs=(P(), batch_specs), out_specs=(P(), P())
+    )
+    return jax.jit(step, donate_argnums=(0,))
+
+
+class FMLearner:
+    """uri → fitted FM params over a DeviceFeed (csr layout)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, **hyper):
+        self.param = FMParam()
+        self.param.init(hyper)
+        self.mesh = mesh
+        self.params = None
+        self._step = None
+
+    def _ensure(self, num_features: int):
+        if self.params is not None:
+            return
+        nf = self.param.num_features or num_features
+        self.params = init_fm_params(
+            nf, self.param.num_factors, self.param.init_scale
+        )
+        self._step = make_fm_train_step(
+            self.mesh,
+            nf,
+            objective=self.param.objective,
+            learning_rate=self.param.learning_rate,
+            l2=self.param.l2,
+        )
+
+    def fit_feed(self, feed, epochs: int = 1):
+        check(feed.spec.layout == "csr", "FM consumes csr batches")
+        history = []
+        for epoch in range(epochs):
+            loss_sum = weight_sum = 0.0
+            for batch in feed:
+                self._ensure(self.param.num_features)
+                self.params, metrics = self._step(
+                    self.params, step_batch(batch, "csr")
+                )
+                loss_sum += float(metrics["loss_sum"])
+                weight_sum += float(metrics["weight_sum"])
+            history.append(loss_sum / max(weight_sum, 1e-12))
+            if epoch + 1 < epochs:
+                feed.before_first()
+        return history
+
+    def predict_batch(self, batch) -> np.ndarray:
+        num_rows = int(batch["label"].shape[0])
+        v_e = jnp.take(self.params["v"], batch["indices"], axis=0)
+        xv = batch["values"][:, None] * v_e
+        s = jax.ops.segment_sum(xv, batch["row_ids"], num_segments=num_rows)
+        q = jax.ops.segment_sum(xv * xv, batch["row_ids"], num_segments=num_rows)
+        linear = spmv(
+            batch["values"], batch["indices"], batch["row_ids"],
+            self.params["w"], num_rows,
+        )
+        return np.asarray(
+            self.params["b"] + linear + 0.5 * jnp.sum(s * s - q, axis=-1)
+        )

@@ -1,0 +1,304 @@
+"""Linear learners with data-parallel psum gradient sync.
+
+This is the BASELINE north-star model: ``libsvm file → InputSplit(part=host)
+→ parser → device batch → psum(grad) → SGD`` (SURVEY §7 minimum end-to-end
+slice). The reference has no learners; this is the allreduce-SGD loop its
+downstream (rabit-based) consumers run, built TPU-first:
+
+- the train step is one jitted shard_map over the mesh: local forward +
+  gradient, one fused psum per step (large fused buckets are what push ICI
+  utilization up — SURVEY §7 hard parts), parameters replicated and donated
+- deterministic f32 accumulation: per-shard sums then a single psum, so the
+  reduction order is fixed and CPU-vs-TPU runs are comparable bit-for-bit at
+  the f32 level
+- dense layout for small feature spaces (HIGGS: one [B,F]·[F] matvec on the
+  MXU) and COO/segment-sum for sparse (dmlc_tpu.ops.spmv)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.ops.spmv import spmv, spmv_transpose
+from dmlc_tpu.params.parameter import Parameter, field
+from dmlc_tpu.utils.logging import DMLCError, check
+
+
+class LinearModelParam(Parameter):
+    """Hyper-parameters (a dmlc Parameter struct, parameter.h style)."""
+
+    objective = field(
+        str,
+        "logistic",
+        description="Loss: logistic (labels 0/1), squared, or hinge (0/1).",
+    )
+    learning_rate = field(float, 0.1, lower_bound=0.0)
+    l2 = field(float, 0.0, lower_bound=0.0, description="L2 penalty on w.")
+    momentum = field(float, 0.0, lower_bound=0.0, upper_bound=1.0)
+    num_features = field(int, 0, description="Feature dim (0 = infer).")
+
+
+_DENSE_KEYS = ("x", "label", "weight")
+_CSR_KEYS = ("label", "weight", "indices", "values", "row_ids")
+
+
+def step_batch(batch: Dict, layout: str) -> Dict:
+    """Strip DeviceFeed metadata (num_rows/num_nonzero ints) down to the
+    array fields a jitted train step consumes."""
+    keys = _DENSE_KEYS if layout == "dense" else _CSR_KEYS
+    return {k: batch[k] for k in keys}
+
+
+def init_linear_params(num_features: int, dtype=jnp.float32) -> Dict:
+    """{"w": [F], "b": scalar} — replicated across the mesh."""
+    return {
+        "w": jnp.zeros((num_features,), dtype=dtype),
+        "b": jnp.zeros((), dtype=dtype),
+    }
+
+
+def linear_predict_dense(params: Dict, x):
+    return x @ params["w"] + params["b"]
+
+
+def _margin_grad(objective: str, margin, label):
+    """Per-row (loss, dloss/dmargin) for the supported objectives."""
+    if objective == "logistic":
+        # labels in {0,1}; numerically stable softplus form
+        loss = jnp.maximum(margin, 0.0) - margin * label + jnp.log1p(
+            jnp.exp(-jnp.abs(margin))
+        )
+        grad = jax.nn.sigmoid(margin) - label
+    elif objective == "squared":
+        diff = margin - label
+        loss = 0.5 * diff * diff
+        grad = diff
+    elif objective == "hinge":
+        # labels in {0,1} mapped to {-1,+1}
+        y = 2.0 * label - 1.0
+        loss = jnp.maximum(0.0, 1.0 - y * margin)
+        grad = jnp.where(y * margin < 1.0, -y, 0.0)
+    else:
+        raise DMLCError(f"unknown objective {objective!r}")
+    return loss, grad
+
+
+def make_linear_train_step(
+    mesh: Optional[Mesh],
+    objective: str = "logistic",
+    learning_rate: float = 0.1,
+    l2: float = 0.0,
+    momentum: float = 0.0,
+    layout: str = "dense",
+    num_features: int = 0,
+    axis: str = "dp",
+):
+    """Build the jitted allreduce-SGD step.
+
+    Returns step(params, velocity, batch) -> (params, velocity, metrics)
+    where metrics = {"loss_sum": Σ w·loss, "weight_sum": Σ w} (host divides).
+    With ``mesh`` the batch is consumed sharded over ``axis`` and gradients
+    cross ICI in one fused psum; without, it is a single-device step.
+    """
+    check(layout in ("dense", "csr"), "layout must be dense or csr")
+    if layout == "csr":
+        check(num_features > 0, "csr layout requires num_features")
+
+    def _local_grads(params, batch):
+        label = batch["label"]
+        weight = batch["weight"]
+        if layout == "dense":
+            margin = batch["x"] @ params["w"] + params["b"]
+        else:
+            margin = (
+                spmv(
+                    batch["values"],
+                    batch["indices"],
+                    batch["row_ids"],
+                    params["w"],
+                    label.shape[0],
+                )
+                + params["b"]
+            )
+        loss, gmargin = _margin_grad(objective, margin, label)
+        wg = weight * gmargin
+        if layout == "dense":
+            gw = batch["x"].T @ wg
+        else:
+            gw = spmv_transpose(
+                batch["values"], batch["indices"], batch["row_ids"], wg,
+                num_features,
+            )
+        gb = jnp.sum(wg)
+        loss_sum = jnp.sum(weight * loss)
+        weight_sum = jnp.sum(weight)
+        return gw, gb, loss_sum, weight_sum
+
+    def _apply(params, velocity, gw, gb, wsum):
+        denom = jnp.maximum(wsum, 1e-12)
+        gw = gw / denom + l2 * params["w"]
+        gb = gb / denom
+        if momentum > 0.0:
+            velocity = {
+                "w": momentum * velocity["w"] + gw,
+                "b": momentum * velocity["b"] + gb,
+            }
+            gw, gb = velocity["w"], velocity["b"]
+        params = {
+            "w": params["w"] - learning_rate * gw,
+            "b": params["b"] - learning_rate * gb,
+        }
+        return params, velocity
+
+    if mesh is None:
+
+        @jax.jit
+        def step(params, velocity, batch):
+            gw, gb, loss_sum, wsum = _local_grads(params, batch)
+            params, velocity = _apply(params, velocity, gw, gb, wsum)
+            return params, velocity, {"loss_sum": loss_sum, "weight_sum": wsum}
+
+        return step
+
+    # Mesh path: one shard_map; batch rows sharded, params replicated. For
+    # the csr layout entries are replicated and each shard reduces its row
+    # range (ops.spmv sharded variant would shard entries too; here the
+    # per-batch entry arrays are small relative to the gradient).
+    if layout == "dense":
+        batch_specs = {
+            "x": P(axis),
+            "label": P(axis),
+            "weight": P(axis),
+        }
+    else:
+        batch_specs = {
+            "label": P(axis),
+            "weight": P(axis),
+            "indices": P(),
+            "values": P(),
+            "row_ids": P(),
+        }
+
+    def _sharded(params, velocity, batch):
+        if layout == "csr":
+            # Global row_ids → this shard's local range.
+            n_local = batch["label"].shape[0]
+            base = jax.lax.axis_index(axis) * n_local
+            local_ids = batch["row_ids"] - base
+            oob = (local_ids < 0) | (local_ids >= n_local)
+            local = dict(batch)
+            local["row_ids"] = jnp.where(oob, 0, local_ids)
+            local["values"] = jnp.where(oob, 0.0, batch["values"])
+            batch = local
+        gw, gb, loss_sum, wsum = _local_grads(params, batch)
+        # ONE fused allreduce for everything that crosses ICI.
+        gw, gb, loss_sum, wsum = jax.lax.psum(
+            (gw, gb, loss_sum, wsum), axis_name=axis
+        )
+        params, velocity = _apply(params, velocity, gw, gb, wsum)
+        return params, velocity, {"loss_sum": loss_sum, "weight_sum": wsum}
+
+    step = jax.shard_map(
+        _sharded,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_specs),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+class LinearLearner:
+    """Convenience trainer: uri → fitted params (the rabit-SGD loop)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, **hyper):
+        self.param = LinearModelParam()
+        self.param.init(hyper)
+        self.mesh = mesh
+        self.params = None
+        self.velocity = None
+        self._step = None
+
+    def _ensure(self, num_features: int, layout: str):
+        if self.params is not None:
+            return
+        nf = self.param.num_features or num_features
+        self.params = init_linear_params(nf)
+        self.velocity = {
+            "w": jnp.zeros_like(self.params["w"]),
+            "b": jnp.zeros_like(self.params["b"]),
+        }
+        self._step = make_linear_train_step(
+            self.mesh,
+            objective=self.param.objective,
+            learning_rate=self.param.learning_rate,
+            l2=self.param.l2,
+            momentum=self.param.momentum,
+            layout=layout,
+            num_features=nf,
+        )
+
+    def fit_feed(self, feed, epochs: int = 1, log_every: int = 0):
+        """Train over a DeviceFeed for N epochs; returns per-epoch losses."""
+        from dmlc_tpu.utils.logging import log_info
+
+        layout = feed.spec.layout
+        history = []
+        for epoch in range(epochs):
+            loss_sum = 0.0
+            weight_sum = 0.0
+            nstep = 0
+            for batch in feed:
+                self._ensure(feed.spec.num_features, layout)
+                self.params, self.velocity, metrics = self._step(
+                    self.params, self.velocity, step_batch(batch, layout)
+                )
+                loss_sum += float(metrics["loss_sum"])
+                weight_sum += float(metrics["weight_sum"])
+                nstep += 1
+                if log_every and nstep % log_every == 0:
+                    log_info(
+                        "epoch %d step %d loss %.6f",
+                        epoch,
+                        nstep,
+                        loss_sum / max(weight_sum, 1e-12),
+                    )
+            history.append(loss_sum / max(weight_sum, 1e-12))
+            if epoch + 1 < epochs:
+                feed.before_first()
+        return history
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        check(self.params is not None, "model not fitted")
+        return np.asarray(linear_predict_dense(self.params, jnp.asarray(x)))
+
+    # ---- checkpointing via the Stream surface (SURVEY §5.4) -------------
+    def save(self, uri: str) -> None:
+        from dmlc_tpu.io.filesystem import create_stream
+        from dmlc_tpu.io.serializer import save_obj
+
+        with create_stream(uri, "w") as out:
+            save_obj(
+                out,
+                {
+                    "param": self.param.to_dict(),
+                    "w": np.asarray(self.params["w"]),
+                    "b": np.asarray(self.params["b"]),
+                },
+            )
+
+    def load(self, uri: str) -> None:
+        from dmlc_tpu.io.filesystem import create_stream
+        from dmlc_tpu.io.serializer import load_obj
+
+        with create_stream(uri, "r") as stream:
+            payload = load_obj(stream)
+        self.param.init(payload["param"], allow_unknown=True)
+        self.params = {
+            "w": jnp.asarray(payload["w"]),
+            "b": jnp.asarray(payload["b"]),
+        }

@@ -1,0 +1,223 @@
+"""ctypes bindings for the native core (cpp/libdmlc_tpu.so).
+
+Loading policy (DMLC_TPU_NATIVE env):
+- unset / "auto": use the .so when present, else pure-Python fallbacks
+- "0": never load (pure Python)
+- "1": require it — raise if the library is missing
+
+Every native entry point has a pure-Python twin, so the package works before
+``make -C cpp`` has run; the twins live next to their call sites (parsers).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from dmlc_tpu.utils.logging import DMLCError
+
+_OK = 0
+_EOVERFLOW = -1
+_EPARSE = -2
+
+HAS_WEIGHT = 1
+HAS_QID = 2
+HAS_VALUE = 4
+
+_lib = None
+_tried = False
+
+
+def _candidate_paths():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = os.environ.get("DMLC_TPU_NATIVE_LIB")
+    if env:
+        yield env
+    yield os.path.join(os.path.dirname(here), "cpp", "libdmlc_tpu.so")
+    yield os.path.join(here, "cpp", "libdmlc_tpu.so")
+
+
+def _bind(lib) -> None:
+    i64 = ctypes.c_int64
+    lib.parse_libsvm.restype = ctypes.c_int
+    lib.parse_libsvm.argtypes = [
+        ctypes.c_char_p, i64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p,
+        i64, i64,
+        ctypes.POINTER(i64), ctypes.POINTER(i64), ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.parse_libfm.restype = ctypes.c_int
+    lib.parse_libfm.argtypes = [
+        ctypes.c_char_p, i64,
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        i64, i64,
+        ctypes.POINTER(i64), ctypes.POINTER(i64),
+    ]
+    lib.parse_csv.restype = ctypes.c_int
+    lib.parse_csv.argtypes = [
+        ctypes.c_char_p, i64, ctypes.c_void_p,
+        i64, i64,
+        ctypes.POINTER(i64), ctypes.POINTER(i64),
+    ]
+    lib.count_tokens.restype = None
+    lib.count_tokens.argtypes = [
+        ctypes.c_char_p, i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
+    ]
+    lib.dmlc_tpu_abi_version.restype = ctypes.c_int
+    lib.dmlc_tpu_abi_version.argtypes = []
+
+
+def get_lib():
+    """The loaded native library, or None (per the DMLC_TPU_NATIVE policy)."""
+    global _lib, _tried
+    mode = os.environ.get("DMLC_TPU_NATIVE", "auto")
+    if mode == "0":
+        return None
+    if _lib is not None:
+        return _lib
+    if _tried and mode != "1":
+        return None
+    _tried = True
+    for path in _candidate_paths():
+        if os.path.exists(path):
+            lib = ctypes.CDLL(path)
+            _bind(lib)
+            if lib.dmlc_tpu_abi_version() != 1:
+                raise DMLCError(f"native ABI mismatch in {path}")
+            _lib = lib
+            return _lib
+    if mode == "1":
+        raise DMLCError(
+            "DMLC_TPU_NATIVE=1 but libdmlc_tpu.so not found; run `make -C cpp`"
+        )
+    return None
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def parse_libsvm_chunk(chunk: bytes) -> Optional[dict]:
+    """Native libsvm chunk parse → dict of arrays, or None if unavailable.
+
+    Returns {labels f32[n], weights f32[n], qids i64[n], counts i64[n],
+    indices u64[nnz], values f32[nnz], flags int}.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    max_rows, max_nnz = _bounds(lib, chunk)
+    labels = np.empty(max_rows, dtype=np.float32)
+    weights = np.empty(max_rows, dtype=np.float32)
+    qids = np.empty(max_rows, dtype=np.int64)
+    counts = np.empty(max_rows, dtype=np.int64)
+    indices = np.empty(max_nnz, dtype=np.uint64)
+    values = np.empty(max_nnz, dtype=np.float32)
+    out_rows = ctypes.c_int64()
+    out_nnz = ctypes.c_int64()
+    out_flags = ctypes.c_int()
+    rc = lib.parse_libsvm(
+        chunk, len(chunk),
+        _ptr(labels), _ptr(weights), _ptr(qids), _ptr(counts),
+        _ptr(indices), _ptr(values),
+        max_rows, max_nnz,
+        ctypes.byref(out_rows), ctypes.byref(out_nnz), ctypes.byref(out_flags),
+    )
+    if rc == _EPARSE:
+        # tokens the branch-light native scan rejects (inf/nan/hex) may still
+        # be valid for the Python twin — fall back instead of failing
+        return None
+    if rc != _OK:
+        raise DMLCError(f"native libsvm parse failed rc={rc}")
+    n, nnz = out_rows.value, out_nnz.value
+    return {
+        "labels": labels[:n],
+        "weights": weights[:n],
+        "qids": qids[:n],
+        "counts": counts[:n],
+        "indices": indices[:nnz],
+        "values": values[:nnz],
+        "flags": out_flags.value,
+    }
+
+
+def _bounds(lib, chunk: bytes):
+    """(max_rows, max_nnz) upper bounds from the chunk length alone.
+
+    Every row is >= 2 bytes ("0\\n") and every feature token >= 2 bytes, so
+    len/2 bounds both. np.empty is a virtual allocation — untouched pages
+    cost nothing — and the parse returns exact counts for trimming, so
+    over-sizing beats scanning the chunk to size exactly.
+    """
+    bound = len(chunk) // 2 + 2
+    return bound, bound
+
+
+def parse_libfm_chunk(chunk: bytes) -> Optional[dict]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    max_rows, max_nnz = _bounds(lib, chunk)
+    labels = np.empty(max_rows, dtype=np.float32)
+    counts = np.empty(max_rows, dtype=np.int64)
+    fields = np.empty(max_nnz, dtype=np.uint64)
+    indices = np.empty(max_nnz, dtype=np.uint64)
+    values = np.empty(max_nnz, dtype=np.float32)
+    out_rows = ctypes.c_int64()
+    out_nnz = ctypes.c_int64()
+    rc = lib.parse_libfm(
+        chunk, len(chunk),
+        _ptr(labels), _ptr(counts),
+        _ptr(fields), _ptr(indices), _ptr(values),
+        max_rows, max_nnz,
+        ctypes.byref(out_rows), ctypes.byref(out_nnz),
+    )
+    if rc == _EPARSE:
+        return None  # fall back to the Python twin (see parse_libsvm_chunk)
+    if rc != _OK:
+        raise DMLCError(f"native libfm parse failed rc={rc}")
+    n, nnz = out_rows.value, out_nnz.value
+    return {
+        "labels": labels[:n],
+        "counts": counts[:n],
+        "fields": fields[:nnz],
+        "indices": indices[:nnz],
+        "values": values[:nnz],
+    }
+
+
+def parse_csv_chunk(chunk: bytes, expect_cols: int = 0) -> Optional[tuple]:
+    """Native dense-CSV parse → (table f32[rows, cols]) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    max_rows = chunk.count(b"\n") + 2
+    if expect_cols <= 0:
+        nl = chunk.find(b"\n")
+        first = chunk[: nl if nl >= 0 else len(chunk)]
+        expect_cols_hint = first.count(b",") + 1
+    else:
+        expect_cols_hint = expect_cols
+    out = np.empty((max_rows, expect_cols_hint), dtype=np.float32)
+    out_rows = ctypes.c_int64()
+    out_cols = ctypes.c_int64()
+    rc = lib.parse_csv(
+        chunk, len(chunk), _ptr(out),
+        max_rows, expect_cols_hint,
+        ctypes.byref(out_rows), ctypes.byref(out_cols),
+    )
+    if rc == _EPARSE:
+        # ragged csv → caller falls back to the python path
+        return None
+    if rc != _OK:
+        raise DMLCError(f"native csv parse failed rc={rc}")
+    return out[: out_rows.value, : out_cols.value]

@@ -1,0 +1,15 @@
+"""TPU compute ops over device-resident CSR batches.
+
+The reference stops at host CSR (`RowBlock`, data.h:170) and leaves compute to
+downstream learners; here the framework supplies the TPU-shaped kernels those
+learners need: COO/segment-sum SpMV (forward) and its transpose (gradient
+scatter), plus mesh-sharded variants.
+"""
+
+from dmlc_tpu.ops.spmv import (
+    spmv,
+    spmv_transpose,
+    make_sharded_spmv,
+)
+
+__all__ = ["spmv", "spmv_transpose", "make_sharded_spmv"]

@@ -1,0 +1,232 @@
+"""Linear/FM learners: convergence, mesh-vs-single-device parity, graft entry."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlc_tpu.models import (
+    FMLearner,
+    LinearLearner,
+    init_fm_params,
+    init_linear_params,
+    make_fm_train_step,
+    make_linear_train_step,
+)
+from dmlc_tpu.parallel import data_parallel_mesh
+
+
+def _dense_batch(rng, batch, nfeat, w_true):
+    x = rng.rand(batch, nfeat).astype(np.float32)
+    margin = x @ w_true
+    y = (margin > np.median(margin)).astype(np.float32)
+    return {
+        "x": jnp.asarray(x),
+        "label": jnp.asarray(y),
+        "weight": jnp.ones(batch, dtype=jnp.float32),
+    }
+
+
+class TestLinearSingleDevice:
+    def test_logistic_converges(self):
+        rng = np.random.RandomState(0)
+        nfeat = 16
+        w_true = rng.randn(nfeat).astype(np.float32)
+        step = make_linear_train_step(None, learning_rate=1.0, momentum=0.9)
+        params = init_linear_params(nfeat)
+        velocity = {"w": jnp.zeros(nfeat), "b": jnp.zeros(())}
+        losses = []
+        batch = _dense_batch(rng, 256, nfeat, w_true)
+        for _ in range(100):
+            params, velocity, m = step(params, velocity, batch)
+            losses.append(float(m["loss_sum"]) / float(m["weight_sum"]))
+        assert losses[-1] < losses[0] * 0.5, losses[-1]
+
+    @pytest.mark.parametrize("objective", ["squared", "hinge"])
+    def test_objectives_decrease(self, objective):
+        rng = np.random.RandomState(1)
+        nfeat = 8
+        w_true = rng.randn(nfeat).astype(np.float32)
+        step = make_linear_train_step(
+            None, objective=objective, learning_rate=0.1
+        )
+        params = init_linear_params(nfeat)
+        velocity = {"w": jnp.zeros(nfeat), "b": jnp.zeros(())}
+        batch = _dense_batch(rng, 128, nfeat, w_true)
+        first = last = None
+        for i in range(40):
+            params, velocity, m = step(params, velocity, batch)
+            loss = float(m["loss_sum"]) / float(m["weight_sum"])
+            first = loss if first is None else first
+            last = loss
+        assert last < first
+
+
+class TestLinearMeshParity:
+    def test_dense_mesh_matches_single(self):
+        rng = np.random.RandomState(2)
+        nfeat = 12
+        w_true = rng.randn(nfeat).astype(np.float32)
+        batch = _dense_batch(rng, 64, nfeat, w_true)
+        mesh = data_parallel_mesh()
+
+        single = make_linear_train_step(None, learning_rate=0.3)
+        sharded = make_linear_train_step(mesh, learning_rate=0.3)
+
+        p1 = init_linear_params(nfeat)
+        v1 = {"w": jnp.zeros(nfeat), "b": jnp.zeros(())}
+        p2 = init_linear_params(nfeat)
+        v2 = {"w": jnp.zeros(nfeat), "b": jnp.zeros(())}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        b2 = {
+            "x": jax.device_put(batch["x"], NamedSharding(mesh, P("dp"))),
+            "label": jax.device_put(batch["label"], NamedSharding(mesh, P("dp"))),
+            "weight": jax.device_put(batch["weight"], NamedSharding(mesh, P("dp"))),
+        }
+        for _ in range(5):
+            p1, v1, m1 = single(p1, v1, batch)
+            p2, v2, m2 = sharded(p2, v2, b2)
+        np.testing.assert_allclose(
+            np.asarray(p1["w"]), np.asarray(p2["w"]), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(m1["loss_sum"]), float(m2["loss_sum"]), rtol=1e-5
+        )
+
+    def test_csr_mesh_matches_single(self):
+        from dmlc_tpu.data.row_block import RowBlockContainer
+        from dmlc_tpu.device.csr import pad_to_bucket
+
+        rng = np.random.RandomState(3)
+        nfeat = 40
+        cont = RowBlockContainer()
+        for i in range(32):
+            feats = sorted(rng.choice(nfeat, size=5, replace=False))
+            cont.push_row(
+                float(rng.randint(0, 2)), feats, value=rng.rand(5).astype(np.float32)
+            )
+        dev = pad_to_bucket(cont.to_block(), 32, nnz_bucket=256)
+        batch = {
+            "label": jnp.asarray(dev.labels),
+            "weight": jnp.asarray(dev.weights),
+            "indices": jnp.asarray(dev.indices),
+            "values": jnp.asarray(dev.values),
+            "row_ids": jnp.asarray(dev.row_ids),
+        }
+        mesh = data_parallel_mesh()
+        single = make_linear_train_step(
+            None, layout="csr", num_features=nfeat, learning_rate=0.2
+        )
+        sharded = make_linear_train_step(
+            mesh, layout="csr", num_features=nfeat, learning_rate=0.2
+        )
+        p1 = init_linear_params(nfeat)
+        v1 = {"w": jnp.zeros(nfeat), "b": jnp.zeros(())}
+        p2 = jax.tree.map(jnp.copy, p1)
+        v2 = jax.tree.map(jnp.copy, v1)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        b2 = dict(batch)
+        for key in ("label", "weight"):
+            b2[key] = jax.device_put(batch[key], NamedSharding(mesh, P("dp")))
+        for _ in range(3):
+            p1, v1, _ = single(p1, v1, batch)
+            p2, v2, _ = sharded(p2, v2, b2)
+        np.testing.assert_allclose(
+            np.asarray(p1["w"]), np.asarray(p2["w"]), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestFM:
+    def test_fm_converges_and_mesh_parity(self):
+        from dmlc_tpu.data.row_block import RowBlockContainer
+        from dmlc_tpu.device.csr import pad_to_bucket
+
+        rng = np.random.RandomState(4)
+        nfeat = 24
+        cont = RowBlockContainer()
+        for i in range(64):
+            feats = sorted(rng.choice(nfeat, size=4, replace=False))
+            label = float((feats[0] % 2) == 0)
+            cont.push_row(label, feats, value=np.ones(4, dtype=np.float32))
+        dev = pad_to_bucket(cont.to_block(), 64, nnz_bucket=512)
+        batch = {
+            "label": jnp.asarray(dev.labels),
+            "weight": jnp.asarray(dev.weights),
+            "indices": jnp.asarray(dev.indices),
+            "values": jnp.asarray(dev.values),
+            "row_ids": jnp.asarray(dev.row_ids),
+        }
+        single = make_fm_train_step(None, nfeat, learning_rate=0.2)
+        p1 = init_fm_params(nfeat, 4)
+        losses = []
+        for _ in range(30):
+            p1, m = single(p1, batch)
+            losses.append(float(m["loss_sum"]) / float(m["weight_sum"]))
+        assert losses[-1] < losses[0]
+
+        mesh = data_parallel_mesh()
+        sharded = make_fm_train_step(mesh, nfeat, learning_rate=0.2)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        p2 = init_fm_params(nfeat, 4)
+        b2 = dict(batch)
+        for key in ("label", "weight"):
+            b2[key] = jax.device_put(batch[key], NamedSharding(mesh, P("dp")))
+        p1b = init_fm_params(nfeat, 4)
+        for _ in range(3):
+            p1b, _ = single(p1b, batch)
+            p2, _ = sharded(p2, b2)
+        np.testing.assert_allclose(
+            np.asarray(p1b["v"]), np.asarray(p2["v"]), rtol=1e-4, atol=1e-6
+        )
+
+
+class TestLearnerEndToEnd:
+    def test_fit_feed_and_checkpoint(self, tmp_path):
+        from dmlc_tpu.data import create_parser
+        from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+        rng = np.random.RandomState(5)
+        nfeat = 10
+        w_true = rng.randn(nfeat)
+        path = tmp_path / "train.svm"
+        with open(path, "w") as fh:
+            for _ in range(400):
+                x = rng.rand(nfeat)
+                y = int(x @ w_true > 0)
+                fh.write(
+                    f"{y} " + " ".join(f"{j}:{x[j]:.5f}" for j in range(nfeat)) + "\n"
+                )
+        feed = DeviceFeed(
+            create_parser(str(path)),
+            BatchSpec(batch_size=64, layout="dense", num_features=nfeat,
+                      drop_remainder=True),
+        )
+        learner = LinearLearner(learning_rate=0.5)
+        history = learner.fit_feed(feed, epochs=3)
+        assert history[-1] < history[0]
+
+        ckpt = tmp_path / "model.bin"
+        learner.save(str(ckpt))
+        other = LinearLearner()
+        other.load(str(ckpt))
+        x = rng.rand(8, nfeat).astype(np.float32)
+        np.testing.assert_allclose(
+            learner.predict(x), other.predict(x), rtol=1e-6
+        )
+
+
+class TestGraftEntry:
+    def test_entry_and_dryrun(self):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (256,)
+        ge.dryrun_multichip(8)

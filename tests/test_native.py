@@ -1,0 +1,122 @@
+"""Native core (cpp/libdmlc_tpu.so) vs pure-Python parser parity.
+
+Skipped when the .so has not been built (`make -C cpp`).
+"""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import native
+from dmlc_tpu.data.parsers import CSVParser, LibFMParser, LibSVMParser
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built"
+)
+
+
+class _FakeSource:
+    def __init__(self):
+        self.closed = False
+
+    def next_chunk(self):
+        return None
+
+    def before_first(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+def _parse_both(parser_cls, chunk, monkeypatch, **kwargs):
+    src1, src2 = _FakeSource(), _FakeSource()
+    native_block = parser_cls(src1, **kwargs).parse_chunk(chunk).to_block()
+    monkeypatch.setenv("DMLC_TPU_NATIVE", "0")
+    python_block = parser_cls(src2, **kwargs).parse_chunk(chunk).to_block()
+    return native_block, python_block
+
+
+def _assert_blocks_equal(a, b):
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_allclose(a.label, b.label, rtol=1e-6)
+    np.testing.assert_array_equal(a.index, b.index)
+    for field in ("value", "weight"):
+        av, bv = getattr(a, field), getattr(b, field)
+        assert (av is None) == (bv is None), field
+        if av is not None:
+            np.testing.assert_allclose(av, bv, rtol=1e-5, atol=1e-7)
+    assert (a.qid is None) == (b.qid is None)
+    if a.qid is not None:
+        np.testing.assert_array_equal(a.qid, b.qid)
+
+
+class TestLibSVMParity:
+    def test_plain(self, monkeypatch):
+        chunk = b"1 1:0.5 7:2.25\n0 3:1e-3 4:-2.5e2\n1 2:0.125\n"
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+        assert a.num_nonzero == 5
+
+    def test_weights_mixed(self, monkeypatch):
+        chunk = b"1:5.0 1:1 2:2\n0 3:3\n"
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+        assert a.weight is not None
+        np.testing.assert_allclose(a.weight, [5.0, 1.0])
+
+    def test_qid_and_bare_indices(self, monkeypatch):
+        chunk = b"2 qid:7 1:0.5 4\n1 qid:8 2\n"
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+        assert list(a.qid) == [7, 8]
+        # bare index -> value 1.0
+        np.testing.assert_allclose(a.value, [0.5, 1.0, 1.0])
+
+    def test_blank_lines_and_crlf(self, monkeypatch):
+        chunk = b"1 1:2\r\n\r\n0 2:3\n\n"
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+        assert len(a) == 2
+
+    def test_malformed_raises(self):
+        src = _FakeSource()
+        with pytest.raises(Exception):
+            LibSVMParser(src).parse_chunk(b"notanumber 1:2\n")
+
+    def test_random_roundtrip(self, monkeypatch):
+        rng = np.random.RandomState(3)
+        lines = []
+        for i in range(200):
+            feats = sorted(rng.choice(1000, size=rng.randint(1, 20), replace=False))
+            lines.append(
+                f"{rng.randint(0, 2)} "
+                + " ".join(f"{j}:{rng.rand() * 100:.6g}" for j in feats)
+            )
+        chunk = ("\n".join(lines) + "\n").encode()
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+
+
+class TestLibFMParity:
+    def test_triples(self, monkeypatch):
+        chunk = b"1 0:1:0.5 3:7:2.5\n0 1:2:-1.5\n"
+        a, b = _parse_both(LibFMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+        np.testing.assert_array_equal(a.field, b.field)
+
+
+class TestCSVParity:
+    def test_dense(self, monkeypatch):
+        chunk = b"1,0.5,2.5\n0,1.5,-3.5\n"
+        a, b = _parse_both(
+            CSVParser, chunk, monkeypatch, args={"label_column": "0"}
+        )
+        _assert_blocks_equal(a, b)
+        np.testing.assert_allclose(a.label, [1.0, 0.0])
+
+    def test_empty_cells(self, monkeypatch):
+        chunk = b"1,,2\n0,3,\n"
+        a, b = _parse_both(
+            CSVParser, chunk, monkeypatch, args={"label_column": "0"}
+        )
+        _assert_blocks_equal(a, b)
