@@ -72,6 +72,54 @@ def _bind(lib) -> None:
     lib.dmlc_tpu_abi_version.argtypes = []
 
 
+_build_attempted = False
+
+
+def _try_build() -> None:
+    """`make -C cpp` so fresh checkouts get the native core (the .so is a
+    build artifact, not committed). Cross-process safe: holds an exclusive
+    flock for the build so concurrent workers don't dlopen a half-written
+    .so, and runs at most once per process."""
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    import subprocess
+
+    cpp_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "cpp",
+    )
+    if not os.path.exists(os.path.join(cpp_dir, "Makefile")):
+        return
+    lock_path = os.path.join(cpp_dir, ".build.lock")
+    try:
+        import fcntl
+
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            subprocess.run(
+                ["make", "-C", cpp_dir],
+                capture_output=True, timeout=120, check=False,
+            )
+    except (OSError, subprocess.TimeoutExpired, ImportError):
+        pass
+
+
+def _load(path: str):
+    """dlopen+bind, or None when the file is unloadable (e.g. another
+    process is mid-link; the Makefile links to a temp then renames, but a
+    stale/corrupt artifact must not crash the caller)."""
+    try:
+        lib = ctypes.CDLL(path)
+        _bind(lib)
+    except OSError:
+        return None
+    if lib.dmlc_tpu_abi_version() != 1:
+        raise DMLCError(f"native ABI mismatch in {path}")
+    return lib
+
+
 def get_lib():
     """The loaded native library, or None (per the DMLC_TPU_NATIVE policy)."""
     global _lib, _tried
@@ -83,14 +131,15 @@ def get_lib():
     if _tried and mode != "1":
         return None
     _tried = True
-    for path in _candidate_paths():
-        if os.path.exists(path):
-            lib = ctypes.CDLL(path)
-            _bind(lib)
-            if lib.dmlc_tpu_abi_version() != 1:
-                raise DMLCError(f"native ABI mismatch in {path}")
-            _lib = lib
-            return _lib
+    for attempt in range(2):
+        for path in _candidate_paths():
+            if os.path.exists(path):
+                lib = _load(path)
+                if lib is not None:
+                    _lib = lib
+                    return _lib
+        if attempt == 0:
+            _try_build()
     if mode == "1":
         raise DMLCError(
             "DMLC_TPU_NATIVE=1 but libdmlc_tpu.so not found; run `make -C cpp`"

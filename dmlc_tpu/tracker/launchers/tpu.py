@@ -1,0 +1,137 @@
+"""TPU launcher: ``dmlc-submit --cluster=tpu`` (SURVEY §2.8 "TPU mapping").
+
+New in this framework (no reference analog — the reference predates TPUs):
+discovers the TPU pod topology, spawns ONE worker process per TPU host, and
+exports both the classic ``DMLC_*`` contract (so rabit-style control-plane
+code keeps working) and the jax.distributed coordination contract:
+
+- ``DMLC_TPU_COORDINATOR``  host0:port for jax.distributed.initialize
+- ``DMLC_TPU_NUM_PROC``     number of TPU hosts (processes)
+- ``DMLC_TPU_PROC_ID``      this host's process index (== DMLC_TASK_ID)
+
+Workers call :func:`dmlc_tpu.parallel.initialize_from_env` which turns these
+into ``jax.distributed.initialize(...)``; after that ``jax.devices()`` spans
+the pod and collectives ride ICI (the socket tree/ring of the reference
+tracker is replaced by XLA AllReduce — SURVEY §5.8). The tracker's
+``recover`` path maps to per-host restart (retry loop below) + elastic
+jax.distributed re-init + checkpoint restore.
+
+Host discovery order: --tpu-hosts, --host-file, ``TPU_WORKER_HOSTNAMES``
+(set by Cloud TPU runtimes), else single-host localhost.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import threading
+from typing import Dict, List, Tuple
+
+from dmlc_tpu.tracker.launchers.common import export_prefix, task_env
+from dmlc_tpu.tracker.launchers.ssh import parse_hostfile
+from dmlc_tpu.tracker.rendezvous import submit_with_tracker
+
+LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+def discover_hosts(args) -> List[Tuple[str, int]]:
+    """[(host, ssh_port)] for every TPU host in the pod."""
+    if getattr(args, "tpu_hosts", None):
+        return [(h.strip(), 22) for h in args.tpu_hosts.split(",") if h.strip()]
+    if getattr(args, "host_file", None):
+        return parse_hostfile(args.host_file)
+    env_hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if env_hosts:
+        return [(h.strip(), 22) for h in env_hosts.split(",") if h.strip()]
+    return [("localhost", 22)]
+
+
+def coordination_env(
+    hosts: List[Tuple[str, int]], proc_id: int, port: int
+) -> Dict[str, str]:
+    """The jax.distributed bootstrap triple for one host."""
+    coord_host = hosts[0][0]
+    if coord_host in LOCAL_HOSTS:
+        coord_host = "127.0.0.1"
+    return {
+        "DMLC_TPU_COORDINATOR": f"{coord_host}:{port}",
+        "DMLC_TPU_NUM_PROC": str(len(hosts)),
+        "DMLC_TPU_PROC_ID": str(proc_id),
+    }
+
+
+def plan(args, nworker: int, nserver: int, envs: Dict[str, object]):
+    """[(host, ssh_port, task_id, env, argv_or_none)] — argv None ⇒ local."""
+    if nserver != 0:
+        raise ValueError(
+            "--cluster=tpu does not run parameter servers: sharded state "
+            "lives in pjit-partitioned arrays on the chips (use "
+            "--num-servers=0; see SURVEY §2.9 PS mapping)"
+        )
+    hosts = discover_hosts(args)
+    if nworker != len(hosts):
+        # one process per TPU host is the contract; mismatch is an error the
+        # user should see early, not a silent reshard
+        raise ValueError(
+            f"--cluster=tpu launches one worker per TPU host: "
+            f"--num-workers={nworker} but {len(hosts)} hosts discovered "
+            f"({[h for h, _ in hosts]})"
+        )
+    out = []
+    for i, (host, port) in enumerate(hosts):
+        env = task_env(envs, i, "worker", "tpu", extra=args.env_map)
+        env.update(coordination_env(hosts, i, args.tpu_coordinator_port))
+        if host in LOCAL_HOSTS:
+            out.append((host, port, i, env, None))
+        else:
+            argv = ssh_argv(host, port, env, " ".join(args.command))
+            out.append((host, port, i, env, argv))
+    return out
+
+
+def ssh_argv(host: str, port: int, env: Dict[str, str], cmd: str) -> List[str]:
+    remote = f"{export_prefix(env)} cd {shlex.quote(os.getcwd())}; {cmd}"
+    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(port), host,
+            remote]
+
+
+def submit(args) -> None:
+    attempts_per_task = max(1, args.max_attempts or 1)
+    cmd = " ".join(args.command)
+    threads: List[threading.Thread] = []
+
+    def run_task(host: str, port: int, env: Dict[str, str], local: bool) -> None:
+        remaining = attempts_per_task
+        while remaining > 0:
+            env = dict(env)
+            env["DMLC_NUM_ATTEMPT"] = str(attempts_per_task - remaining)
+            if local:
+                full = os.environ.copy()
+                full.update(env)
+                code = subprocess.Popen(cmd, env=full, shell=True).wait()
+            else:
+                # rebuild per attempt so the remote sees the attempt counter
+                code = subprocess.Popen(ssh_argv(host, port, env, cmd)).wait()
+            if code == 0:
+                return
+            remaining -= 1
+            if remaining > 0:
+                print(f"tpu host task exited {code}; restarting "
+                      f"({remaining} attempts left)")
+
+    def fun_submit(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        for host, port, tid, env, argv in plan(args, nworker, nserver, envs):
+            t = threading.Thread(
+                target=run_task, args=(host, port, env, argv is None),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+
+    submit_with_tracker(
+        args.num_workers, args.num_servers, fun_submit,
+        host_ip=args.host_ip or "auto",
+    )
+    for t in threads:
+        t.join()

@@ -1,0 +1,41 @@
+"""dmlc-submit dispatch (tracker/dmlc_tracker/submit.py).
+
+Configures logging (submit.py:13-36) and routes the parsed options to the
+per-cluster launcher's ``submit(args)`` (submit.py:43-56).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from dmlc_tpu.tracker.launchers import get_launcher
+from dmlc_tpu.tracker.opts import get_opts
+
+
+def config_logger(args) -> None:
+    fmt = "%(asctime)-15s %(message)s"
+    level = logging.DEBUG if args.log_level == "DEBUG" else logging.INFO
+    logging.basicConfig(format=fmt, level=level)
+    if args.log_file:
+        handler = logging.FileHandler(args.log_file)
+        handler.setFormatter(logging.Formatter(fmt))
+        logging.getLogger().addHandler(handler)
+
+
+def submit(args) -> None:
+    get_launcher(args.cluster).submit(args)
+
+
+def main(argv=None) -> None:
+    try:
+        args = get_opts(argv)
+    except ValueError as err:
+        print(f"dmlc-submit: {err}", file=sys.stderr)
+        raise SystemExit(2)
+    config_logger(args)
+    submit(args)
+
+
+if __name__ == "__main__":
+    main()
