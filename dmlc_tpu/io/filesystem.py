@@ -8,8 +8,9 @@ dispatch of ``src/io.cc:31-72``, ``Stream::Create`` (src/io.cc:133-139) with
 stdin/stdout support (src/io/local_filesys.cc:144-151), and the reference's
 plugin backends: local FS, HTTP read (the reference's HttpReadStream,
 s3_filesys.cc:539-555). A MemoryFileSystem ("mem://") is TPU-new: the
-in-process fake FS the reference lacks (SURVEY §4). GCS (the reference's S3
-role) lives in dmlc_tpu.io.gcs and registers itself here.
+in-process fake FS the reference lacks (SURVEY §4). GCS and S3 (the
+reference's S3 client role) live in dmlc_tpu.io.object_store, lazily
+imported and self-registered for gs:// gcs:// s3://.
 """
 
 from __future__ import annotations
@@ -256,6 +257,84 @@ class MemoryFileSystem(FileSystem):
 # ---------------------------------------------------------------------------
 
 
+class RangedReadStream(SeekStream):
+    """Lazy-seek reconnecting range-GET reader — the CURLReadStreamBase
+    shape (s3_filesys.cc:219-445): seek only stores the offset; a connection
+    opens at first read from that offset; short reads AND reconnect failures
+    both retry (≤ ``max_retry`` with ``retry_sleep_s`` backoff, mirroring
+    the reference's ≤50×100ms loop at s3_filesys.cc:319-342).
+
+    ``open_ranged(start) -> readable response`` is the backend hook; used by
+    HTTPFileSystem and both object-store backends.
+    """
+
+    def __init__(self, open_ranged, size: int, display: str,
+                 max_retry: int = 50, retry_sleep_s: float = 0.1):
+        self._open_ranged = open_ranged
+        self._size = size
+        self._display = display
+        self._max_retry = max_retry
+        self._retry_sleep_s = retry_sleep_s
+        self._pos = 0
+        self._resp = None
+        self._resp_pos = -1
+
+    def seek(self, pos: int) -> None:
+        check(0 <= pos <= self._size, "seek out of range: %d", pos)
+        self._pos = pos  # lazy: next read reconnects with Range
+
+    def tell(self) -> int:
+        return self._pos
+
+    def write(self, data: bytes) -> None:
+        raise IOError("read-only stream")
+
+    def _drop(self) -> None:
+        if self._resp is not None:
+            try:
+                self._resp.close()
+            except Exception:
+                pass
+            self._resp = None
+
+    def read(self, nbytes: int) -> bytes:
+        import time as _time
+
+        if self._pos >= self._size:
+            return b""
+        nbytes = min(nbytes, self._size - self._pos)
+        out = bytearray()
+        retries = self._max_retry
+        last_err: Optional[Exception] = None
+        while len(out) < nbytes:
+            try:
+                if self._resp is None or self._resp_pos != self._pos:
+                    self._drop()
+                    self._resp = self._open_ranged(self._pos)
+                    self._resp_pos = self._pos
+                chunk = self._resp.read(nbytes - len(out))
+            except Exception as err:  # noqa: BLE001 — reconnect like the reference
+                last_err = err
+                chunk = b""
+            if chunk:
+                out.extend(chunk)
+                self._pos += len(chunk)
+                self._resp_pos = self._pos
+            else:
+                self._drop()
+                retries -= 1
+                if retries <= 0:
+                    raise DMLCError(
+                        f"read failed after {self._max_retry} reconnects at "
+                        f"offset {self._pos} of {self._display}: {last_err}"
+                    )
+                _time.sleep(self._retry_sleep_s)
+        return bytes(out)
+
+    def close(self) -> None:
+        self._drop()
+
+
 class HTTPFileSystem(FileSystem):
     """Read-only; supports range reads when the server does."""
 
@@ -273,60 +352,6 @@ class HTTPFileSystem(FileSystem):
     def list_directory(self, path: URI) -> List[FileInfo]:
         raise DMLCError("HTTP filesystem does not support listing")
 
-    class _HttpReadStream(SeekStream):
-        """Lazy range-GET reader with reconnect — the shape of the reference's
-        CURLReadStreamBase (s3_filesys.cc:219-445): seek is lazy, the
-        connection opens at first read from the current offset, short reads
-        reconnect and continue."""
-
-        MAX_RETRY = 10
-
-        def __init__(self, url: str, size: int):
-            self._url = url
-            self._size = size
-            self._pos = 0
-            self._resp = None
-            self._resp_pos = -1
-
-        def _ensure(self) -> None:
-            import urllib.request
-
-            if self._resp is not None and self._resp_pos == self._pos:
-                return
-            if self._resp is not None:
-                try:
-                    self._resp.close()
-                except Exception:
-                    pass
-            req = urllib.request.Request(self._url)
-            if self._pos > 0:
-                req.add_header("Range", f"bytes={self._pos}-")
-            self._resp = urllib.request.urlopen(req, timeout=60)
-            self._resp_pos = self._pos
-
-        def read(self, nbytes: int) -> bytes:
-            last_err: Optional[Exception] = None
-            for _ in range(self.MAX_RETRY):
-                try:
-                    self._ensure()
-                    data = self._resp.read(nbytes)  # type: ignore[union-attr]
-                    self._pos += len(data)
-                    self._resp_pos = self._pos
-                    return data
-                except Exception as err:  # noqa: BLE001 — reconnect like the reference
-                    last_err = err
-                    self._resp = None
-            raise DMLCError(f"HTTP read failed after retries: {last_err}")
-
-        def write(self, data: bytes) -> None:
-            raise IOError("read-only stream")
-
-        def seek(self, pos: int) -> None:
-            self._pos = pos  # lazy: next read reconnects with Range
-
-        def tell(self) -> int:
-            return self._pos
-
     def open(self, path: URI, flag: str) -> Stream:
         check(flag == "r", "HTTP filesystem is read-only")
         stream = self.open_for_read(path)
@@ -340,7 +365,17 @@ class HTTPFileSystem(FileSystem):
             if allow_null:
                 return None
             raise
-        return self._HttpReadStream(self._url(path), size)
+        url = self._url(path)
+
+        def open_ranged(start: int):
+            import urllib.request
+
+            req = urllib.request.Request(url)
+            if start > 0:
+                req.add_header("Range", f"bytes={start}-")
+            return urllib.request.urlopen(req, timeout=60)
+
+        return RangedReadStream(open_ranged, size, url)
 
 
 # ---------------------------------------------------------------------------
@@ -362,6 +397,8 @@ def register_filesystem(protocol: str, factory: Callable[[URI], FileSystem]) -> 
 
 def get_filesystem(path: URI) -> FileSystem:
     proto = path.protocol
+    if proto in ("s3://", "gs://", "gcs://") and proto not in _fs_factories:
+        import dmlc_tpu.io.object_store  # noqa: F401  (self-registers)
     with _fs_lock:
         inst = _fs_instances.get(proto)
         if inst is None:
@@ -376,10 +413,36 @@ def get_filesystem(path: URI) -> FileSystem:
     return inst
 
 
+def _gated_backend(proto: str, hint: str):
+    """The reference compile-gates hdfs/azure (DMLC_USE_HDFS/AZURE,
+    src/io.cc:36-72) and errors at dispatch when absent; same contract."""
+
+    def factory(uri: URI) -> FileSystem:
+        raise DMLCError(
+            f"{proto} support is not enabled in this build: {hint}"
+        )
+
+    return factory
+
+
 register_filesystem("file://", lambda uri: LocalFileSystem())
 register_filesystem("mem://", lambda uri: MemoryFileSystem())
 register_filesystem("http://", lambda uri: HTTPFileSystem())
 register_filesystem("https://", lambda uri: HTTPFileSystem())
+register_filesystem(
+    "hdfs://",
+    _gated_backend("hdfs://", "mount the cluster via an hdfs NFS/fuse "
+                   "gateway and use file://, or gs://-migrate the data"),
+)
+register_filesystem(
+    "viewfs://",
+    _gated_backend("viewfs://", "use an hdfs gateway mount via file://"),
+)
+register_filesystem(
+    "azure://",
+    _gated_backend("azure://", "use gs:// or s3:// (Azure Blob's S3-"
+                   "compatible gateways work with s3:// + S3_ENDPOINT)"),
+)
 
 
 def create_stream(uri: str, flag: str, allow_null: bool = False) -> Optional[Stream]:

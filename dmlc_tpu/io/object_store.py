@@ -1,0 +1,614 @@
+"""Object-store filesystems: GCS (``gs://``) and S3 (``s3://``).
+
+GCS plays the role the reference's hand-rolled S3 client plays
+(src/io/s3_filesys.{h,cc}) — SURVEY §2.3 "TPU note" — and both backends
+reproduce that client's behavior shape:
+
+- lazy-seek range-GET read streams that reconnect and continue on short
+  reads/dropped connections, ≤50 retries with 100 ms backoff
+  (CURLReadStreamBase, s3_filesys.cc:219-445, retry loop :319-342)
+- buffered multi-part upload writers: S3 multipart (Init ?uploads /
+  Upload part+ETag / CompleteMultipartUpload, s3_filesys.cc:760-806) and
+  the GCS equivalent, resumable upload sessions; per-REST-call retry ≤3
+  (s3_filesys.cc:577,712-751); write buffer size via
+  ``DMLC_S3_WRITE_BUFFER_MB`` / ``DMLC_GCS_WRITE_BUFFER_MB`` (default 64,
+  s3_filesys.cc:569-576)
+- ListObjects with prefix+delimiter mapped to list_directory
+  (s3_filesys.cc:814-906)
+- credentials from env: ``S3_ACCESS_KEY``/``S3_SECRET_KEY`` or
+  ``AWS_ACCESS_KEY_ID``/``AWS_SECRET_ACCESS_KEY`` (+ session token,
+  region, endpoint — s3_filesys.cc:909-962); GCS bearer token from
+  ``GCS_OAUTH_TOKEN``. Anonymous (unsigned) access when unset, so public
+  buckets and test fakes work without credentials.
+
+Request signing is AWS Signature V4 (the modern replacement for the
+reference's V2 HMAC-SHA1 signing, s3_filesys.cc:90-122). Endpoints are
+overridable (``S3_ENDPOINT``/``AWS_ENDPOINT_URL``, ``GCS_ENDPOINT_URL``)
+so the suite tests against an in-process fake server — the hermetic
+coverage the reference lacked (SURVEY §4: live-service-only testing).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import hmac
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Optional, Tuple
+
+from dmlc_tpu.io.filesystem import (
+    FILE_TYPE_DIR,
+    FILE_TYPE_FILE,
+    FileInfo,
+    FileSystem,
+    RangedReadStream,
+    URI,
+    register_filesystem,
+)
+from dmlc_tpu.io.stream import SeekStream, Stream
+from dmlc_tpu.utils.logging import DMLCError, check, log_info
+
+READ_MAX_RETRY = 50          # s3_filesys.cc:319-342
+READ_RETRY_SLEEP_S = 0.1
+WRITE_MAX_RETRY = 3          # s3_filesys.cc:577
+DEFAULT_WRITE_BUFFER_MB = 64  # s3_filesys.cc:573-575
+
+
+def _http(req: urllib.request.Request, timeout: float = 60,
+          verify_ssl: bool = True):
+    if not verify_ssl:
+        import ssl
+
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return urllib.request.urlopen(req, timeout=timeout, context=ctx)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# AWS Signature V4
+# ---------------------------------------------------------------------------
+
+
+def _sigv4_headers(
+    method: str,
+    url: str,
+    region: str,
+    access_key: str,
+    secret_key: str,
+    payload: bytes = b"",
+    session_token: Optional[str] = None,
+    now: Optional[_dt.datetime] = None,
+) -> Dict[str, str]:
+    """AWS SigV4 signing headers for one S3 request (public spec; replaces
+    the reference's V2 `Sign`, s3_filesys.cc:90-122)."""
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+    now = now or _dt.datetime.now(_dt.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest()
+
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed_names = ";".join(sorted(headers))
+    canonical_headers = "".join(
+        f"{k}:{headers[k].strip()}\n" for k in sorted(headers)
+    )
+    # canonical query: sorted by key, values URL-encoded
+    query_pairs = urllib.parse.parse_qsl(
+        parsed.query, keep_blank_values=True
+    )
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(query_pairs)
+    )
+    # parsed.path is already percent-encoded as sent on the wire (the
+    # builders quote keys before signing); re-quoting would double-encode
+    # and break the signature for keys with special characters
+    canonical_path = parsed.path or "/"
+    canonical_request = "\n".join([
+        method, canonical_path, canonical_query, canonical_headers,
+        signed_names, payload_hash,
+    ])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest(),
+    ])
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k_date = _hmac(b"AWS4" + secret_key.encode(), datestamp)
+    k_region = _hmac(k_date, region)
+    k_service = _hmac(k_region, "s3")
+    k_signing = _hmac(k_service, "aws4_request")
+    signature = hmac.new(
+        k_signing, string_to_sign.encode(), hashlib.sha256
+    ).hexdigest()
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={signature}"
+    )
+    del headers["host"]  # urllib sets it
+    return headers
+
+
+# ---------------------------------------------------------------------------
+# Shared read stream: lazy seek + reconnecting range-GET
+# ---------------------------------------------------------------------------
+
+
+class ObjectWriteStream(Stream):
+    """Buffered part-upload writer (WriteStream, s3_filesys.cc:557-812):
+    buffer until the part size, upload parts as they fill, finalize on
+    close. Subclasses implement the three REST steps."""
+
+    def __init__(self, part_bytes: int):
+        self._buf = bytearray()
+        self._part_bytes = part_bytes
+        self._closed = False
+
+    def read(self, nbytes: int) -> bytes:
+        raise IOError("write-only stream")
+
+    def write(self, data: bytes) -> None:
+        check(not self._closed, "stream closed")
+        self._buf.extend(data)
+        while len(self._buf) >= self._part_bytes:
+            part = bytes(self._buf[: self._part_bytes])
+            del self._buf[: self._part_bytes]
+            self._upload_part(part, last=False)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._upload_part(bytes(self._buf), last=True)
+        self._buf.clear()
+        self._finalize()
+
+    def __del__(self):  # reference WriteStream uploads on destruction
+        try:
+            self.close()
+        except Exception as err:  # pragma: no cover - GC-time path
+            # an exception can't propagate from __del__, but a failed
+            # finalize means the object was never created — say so loudly
+            log_info("ERROR: object upload lost in destructor: %s", err)
+
+    def _upload_part(self, data: bytes, last: bool) -> None:
+        raise NotImplementedError
+
+    def _finalize(self) -> None:
+        raise NotImplementedError
+
+
+def _retry_call(fn, what: str):
+    """Retry a REST call ≤3 times (s3_filesys.cc:712-751)."""
+    last = None
+    for attempt in range(WRITE_MAX_RETRY):
+        try:
+            return fn()
+        except (urllib.error.URLError, OSError, DMLCError) as err:
+            if isinstance(err, urllib.error.HTTPError) and err.code < 500:
+                raise  # 4xx: not transient
+            last = err
+            time.sleep(READ_RETRY_SLEEP_S * (attempt + 1))
+    raise DMLCError(f"{what} failed after {WRITE_MAX_RETRY} retries: {last}")
+
+
+# ---------------------------------------------------------------------------
+# Base class: bucket/key plumbing shared by GCS and S3
+# ---------------------------------------------------------------------------
+
+
+class _ObjectStoreBase(FileSystem):
+    def _bucket_key(self, path: URI) -> Tuple[str, str]:
+        return path.host, path.name.lstrip("/")
+
+    def _display(self, path: URI) -> str:
+        return path.str_full()
+
+    def _open_ranged(self, path: URI, start: int):
+        raise NotImplementedError
+
+    def _stat_object(self, path: URI) -> Optional[int]:
+        """size, or None when no such object."""
+        raise NotImplementedError
+
+    def _list(self, bucket: str, prefix: str, delimiter: str):
+        """→ (files: [(key, size)], prefixes: [str])."""
+        raise NotImplementedError
+
+    # ---- FileSystem interface ----------------------------------------
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        size = self._stat_object(path)
+        if size is not None:
+            return FileInfo(path=path, size=size, type=FILE_TYPE_FILE)
+        # directory probe: any key under the prefix? (TryGetPathInfo,
+        # s3_filesys.cc:970-989 lists with the path as prefix)
+        bucket, key = self._bucket_key(path)
+        prefix = key.rstrip("/") + "/" if key else ""
+        files, prefixes = self._list(bucket, prefix, "/")
+        if files or prefixes:
+            return FileInfo(path=path, size=0, type=FILE_TYPE_DIR)
+        raise FileNotFoundError(self._display(path))
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        bucket, key = self._bucket_key(path)
+        prefix = key.rstrip("/") + "/" if key else ""
+        files, prefixes = self._list(bucket, prefix, "/")
+        out: List[FileInfo] = []
+        for sub_key, size in files:
+            if sub_key == prefix:  # the directory marker object itself
+                continue
+            sub = URI(path.protocol, path.host, "/" + sub_key)
+            out.append(FileInfo(path=sub, size=size, type=FILE_TYPE_FILE))
+        for p in prefixes:
+            sub = URI(path.protocol, path.host, "/" + p.rstrip("/"))
+            out.append(FileInfo(path=sub, size=0, type=FILE_TYPE_DIR))
+        out.sort(key=lambda fi: fi.path.name)
+        return out
+
+    def open_for_read(self, path: URI, allow_null: bool = False) -> Optional[SeekStream]:
+        size = self._stat_object(path)
+        if size is None:
+            if allow_null:
+                return None
+            raise FileNotFoundError(self._display(path))
+        return RangedReadStream(
+            lambda start: self._open_ranged(path, start), size,
+            self._display(path),
+            max_retry=READ_MAX_RETRY, retry_sleep_s=READ_RETRY_SLEEP_S,
+        )
+
+    def open(self, path: URI, flag: str) -> Stream:
+        check(flag in ("r", "w"), "object stores support flags r/w, not %s", flag)
+        if flag == "r":
+            stream = self.open_for_read(path)
+            assert stream is not None
+            return stream
+        return self._open_write(path)
+
+    def _open_write(self, path: URI) -> Stream:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# S3
+# ---------------------------------------------------------------------------
+
+
+class S3FileSystem(_ObjectStoreBase):
+    """``s3://bucket/key`` via path-style REST + SigV4."""
+
+    def __init__(self):
+        env = os.environ
+        # credential env precedence mirrors s3_filesys.cc:909-962
+        self.access_key = env.get("S3_ACCESS_KEY") or env.get("AWS_ACCESS_KEY_ID")
+        self.secret_key = env.get("S3_SECRET_KEY") or env.get(
+            "AWS_SECRET_ACCESS_KEY"
+        )
+        self.session_token = env.get("S3_SESSION_TOKEN") or env.get(
+            "AWS_SESSION_TOKEN"
+        )
+        self.region = env.get("S3_REGION") or env.get("AWS_REGION", "us-east-1")
+        endpoint = env.get("S3_ENDPOINT") or env.get("AWS_ENDPOINT_URL")
+        self.endpoint = (endpoint or f"https://s3.{self.region}.amazonaws.com").rstrip("/")
+        self.verify_ssl = env.get("S3_VERIFY_SSL", "1") != "0"
+        self.part_bytes = (
+            int(env.get("DMLC_S3_WRITE_BUFFER_MB", DEFAULT_WRITE_BUFFER_MB))
+            << 20
+        )
+
+    def _url(self, bucket: str, key: str, query: str = "") -> str:
+        path = f"/{bucket}/{urllib.parse.quote(key)}"
+        return self.endpoint + path + (f"?{query}" if query else "")
+
+    def _request(
+        self, method: str, url: str, payload: bytes = b"",
+        headers: Optional[Dict[str, str]] = None, timeout: float = 60,
+    ):
+        hdrs = dict(headers or {})
+        if self.access_key and self.secret_key:
+            hdrs.update(_sigv4_headers(
+                method, url, self.region, self.access_key, self.secret_key,
+                payload, self.session_token,
+            ))
+        req = urllib.request.Request(
+            url, data=payload if payload else None, headers=hdrs, method=method
+        )
+        return _http(req, timeout=timeout, verify_ssl=self.verify_ssl)
+
+    # ---- reads -------------------------------------------------------
+
+    def _open_ranged(self, path: URI, start: int):
+        bucket, key = self._bucket_key(path)
+        url = self._url(bucket, key)
+        hdrs = {"Range": f"bytes={start}-"}
+        if self.access_key and self.secret_key:
+            hdrs.update(_sigv4_headers(
+                "GET", url, self.region, self.access_key, self.secret_key,
+                b"", self.session_token,
+            ))
+        req = urllib.request.Request(url, headers=hdrs)
+        return _http(req, verify_ssl=self.verify_ssl)
+
+    def _stat_object(self, path: URI) -> Optional[int]:
+        bucket, key = self._bucket_key(path)
+        if not key:
+            return None
+        try:
+            with self._request("HEAD", self._url(bucket, key)) as resp:
+                return int(resp.headers.get("Content-Length", 0))
+        except urllib.error.HTTPError as err:
+            if err.code in (404, 403):
+                return None
+            raise
+
+    def _list(self, bucket: str, prefix: str, delimiter: str):
+        files: List[Tuple[str, int]] = []
+        prefixes: List[str] = []
+        token = None
+        while True:
+            q = [
+                ("list-type", "2"),
+                ("prefix", prefix),
+                ("delimiter", delimiter),
+            ]
+            if token:
+                q.append(("continuation-token", token))
+            query = urllib.parse.urlencode(q)
+            url = f"{self.endpoint}/{bucket}?{query}"
+            with self._request("GET", url) as resp:
+                tree = ET.fromstring(resp.read())
+            ns = ""
+            if tree.tag.startswith("{"):
+                ns = tree.tag[: tree.tag.index("}") + 1]
+            for item in tree.findall(f"{ns}Contents"):
+                files.append((
+                    item.findtext(f"{ns}Key"),
+                    int(item.findtext(f"{ns}Size", "0")),
+                ))
+            for item in tree.findall(f"{ns}CommonPrefixes"):
+                prefixes.append(item.findtext(f"{ns}Prefix"))
+            token = tree.findtext(f"{ns}NextContinuationToken")
+            if not token:
+                break
+        return files, prefixes
+
+    # ---- writes: multipart upload (s3_filesys.cc:760-806) ------------
+
+    class _S3WriteStream(ObjectWriteStream):
+        def __init__(self, fs: "S3FileSystem", path: URI):
+            super().__init__(fs.part_bytes)
+            self._fs = fs
+            self._path = path
+            self._upload_id: Optional[str] = None
+            self._etags: List[str] = []
+            self._part_no = 0
+
+        def _init_upload(self) -> None:
+            fs, (bucket, key) = self._fs, self._fs._bucket_key(self._path)
+            url = fs._url(bucket, key, "uploads")
+
+            def call():
+                with fs._request("POST", url) as resp:
+                    tree = ET.fromstring(resp.read())
+                ns = tree.tag[: tree.tag.index("}") + 1] if tree.tag.startswith("{") else ""
+                return tree.findtext(f"{ns}UploadId")
+
+            self._upload_id = _retry_call(call, "InitiateMultipartUpload")
+            check(self._upload_id, "no UploadId in InitiateMultipartUpload reply")
+
+        def _upload_part(self, data: bytes, last: bool) -> None:
+            fs, (bucket, key) = self._fs, self._fs._bucket_key(self._path)
+            if self._upload_id is None and last and self._part_no == 0:
+                # whole object fits one buffer: plain PUT
+                url = fs._url(bucket, key)
+
+                def put():
+                    with fs._request("PUT", url, payload=data):
+                        pass
+
+                _retry_call(put, "PutObject")
+                self._part_no = -1  # mark single-shot done
+                return
+            if self._upload_id is None:
+                self._init_upload()
+            self._part_no += 1
+            n = self._part_no
+            url = fs._url(
+                bucket, key, f"partNumber={n}&uploadId={self._upload_id}"
+            )
+
+            def call():
+                with fs._request("PUT", url, payload=data) as resp:
+                    return resp.headers.get("ETag", "")
+
+            self._etags.append(_retry_call(call, f"UploadPart {n}"))
+
+        def _finalize(self) -> None:
+            if self._part_no <= 0:  # single-shot PUT already complete
+                return
+            fs, (bucket, key) = self._fs, self._fs._bucket_key(self._path)
+            url = fs._url(bucket, key, f"uploadId={self._upload_id}")
+            parts = "".join(
+                f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{etag}</ETag></Part>"
+                for i, etag in enumerate(self._etags)
+            )
+            body = (
+                f"<CompleteMultipartUpload>{parts}</CompleteMultipartUpload>"
+            ).encode()
+
+            def call():
+                with fs._request("POST", url, payload=body):
+                    pass
+
+            _retry_call(call, "CompleteMultipartUpload")
+
+    def _open_write(self, path: URI) -> Stream:
+        return self._S3WriteStream(self, path)
+
+
+# ---------------------------------------------------------------------------
+# GCS
+# ---------------------------------------------------------------------------
+
+
+class GCSFileSystem(_ObjectStoreBase):
+    """``gs://bucket/object`` via the XML API for data + JSON API for
+    listing, resumable uploads for writes."""
+
+    def __init__(self):
+        env = os.environ
+        self.endpoint = env.get(
+            "GCS_ENDPOINT_URL", "https://storage.googleapis.com"
+        ).rstrip("/")
+        self.token = env.get("GCS_OAUTH_TOKEN")
+        self.part_bytes = (
+            int(env.get("DMLC_GCS_WRITE_BUFFER_MB", DEFAULT_WRITE_BUFFER_MB))
+            << 20
+        )
+        # resumable chunks must be 256 KiB aligned (and nonzero)
+        self.part_bytes = max(256 << 10,
+                              self.part_bytes - self.part_bytes % (256 << 10))
+
+    def _headers(self, extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        hdrs = dict(extra or {})
+        if self.token:
+            hdrs["Authorization"] = f"Bearer {self.token}"
+        return hdrs
+
+    def _media_url(self, bucket: str, key: str) -> str:
+        return f"{self.endpoint}/{bucket}/{urllib.parse.quote(key)}"
+
+    def _open_ranged(self, path: URI, start: int):
+        bucket, key = self._bucket_key(path)
+        req = urllib.request.Request(
+            self._media_url(bucket, key),
+            headers=self._headers({"Range": f"bytes={start}-"}),
+        )
+        return _http(req)
+
+    def _stat_object(self, path: URI) -> Optional[int]:
+        bucket, key = self._bucket_key(path)
+        if not key:
+            return None
+        req = urllib.request.Request(
+            self._media_url(bucket, key), headers=self._headers(),
+            method="HEAD",
+        )
+        try:
+            with _http(req) as resp:
+                return int(resp.headers.get("Content-Length", 0))
+        except urllib.error.HTTPError as err:
+            if err.code in (404, 403):
+                return None
+            raise
+
+    def _list(self, bucket: str, prefix: str, delimiter: str):
+        files: List[Tuple[str, int]] = []
+        prefixes: List[str] = []
+        page_token = None
+        while True:
+            q = [("prefix", prefix), ("delimiter", delimiter)]
+            if page_token:
+                q.append(("pageToken", page_token))
+            url = (
+                f"{self.endpoint}/storage/v1/b/{bucket}/o?"
+                + urllib.parse.urlencode(q)
+            )
+            req = urllib.request.Request(url, headers=self._headers())
+            with _http(req) as resp:
+                doc = json.loads(resp.read())
+            for item in doc.get("items", []):
+                files.append((item["name"], int(item.get("size", 0))))
+            prefixes.extend(doc.get("prefixes", []))
+            page_token = doc.get("nextPageToken")
+            if not page_token:
+                break
+        return files, prefixes
+
+    # ---- writes: resumable upload session ----------------------------
+
+    class _GCSWriteStream(ObjectWriteStream):
+        def __init__(self, fs: "GCSFileSystem", path: URI):
+            super().__init__(fs.part_bytes)
+            self._fs = fs
+            self._path = path
+            self._session: Optional[str] = None
+            self._offset = 0
+
+        def _start_session(self) -> None:
+            fs, (bucket, key) = self._fs, self._fs._bucket_key(self._path)
+            url = (
+                f"{fs.endpoint}/upload/storage/v1/b/{bucket}/o?"
+                + urllib.parse.urlencode(
+                    [("uploadType", "resumable"), ("name", key)]
+                )
+            )
+
+            def call():
+                req = urllib.request.Request(
+                    url, data=b"", headers=fs._headers(), method="POST"
+                )
+                with _http(req) as resp:
+                    return resp.headers.get("Location") or resp.headers.get(
+                        "X-GUploader-UploadID"
+                    )
+
+            self._session = _retry_call(call, "start resumable upload")
+            check(self._session, "no session URI from resumable upload start")
+
+        def _upload_part(self, data: bytes, last: bool) -> None:
+            if self._session is None:
+                self._start_session()
+            start = self._offset
+            end = start + len(data) - 1
+            total = str(start + len(data)) if last else "*"
+            if data:
+                content_range = f"bytes {start}-{end}/{total}"
+            else:
+                content_range = f"bytes */{total}"
+            fs = self._fs
+
+            def call():
+                req = urllib.request.Request(
+                    self._session, data=data,
+                    headers=fs._headers({"Content-Range": content_range}),
+                    method="PUT",
+                )
+                try:
+                    with _http(req):
+                        pass
+                except urllib.error.HTTPError as err:
+                    if err.code != 308:  # 308 = resume incomplete (expected)
+                        raise
+            _retry_call(call, "resumable upload chunk")
+            self._offset += len(data)
+
+        def _finalize(self) -> None:
+            pass  # the final chunk (total != "*") completes the session
+
+    def _open_write(self, path: URI) -> Stream:
+        return self._GCSWriteStream(self, path)
+
+
+register_filesystem("s3://", lambda uri: S3FileSystem())
+register_filesystem("gs://", lambda uri: GCSFileSystem())
+register_filesystem("gcs://", lambda uri: GCSFileSystem())

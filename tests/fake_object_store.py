@@ -1,0 +1,221 @@
+"""In-process fake S3/GCS server for hermetic object-store tests.
+
+Implements just enough of both REST dialects for dmlc_tpu.io.object_store:
+range GET, HEAD, S3 ListObjectsV2 XML, GCS JSON listing, S3 multipart
+upload, GCS resumable upload — plus fault injection (drop connections after
+N bytes) to exercise the reconnect path the reference tuned by hand
+(s3_filesys.cc:319-342).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class FakeStore:
+    def __init__(self):
+        self.objects: Dict[Tuple[str, str], bytes] = {}
+        self.uploads: Dict[str, Dict[int, bytes]] = {}  # multipart
+        self.sessions: Dict[str, bytearray] = {}  # resumable
+        self.session_target: Dict[str, Tuple[str, str]] = {}
+        self.fail_after_bytes: Optional[int] = None  # fault injection
+        self.request_count = 0
+        self._id = 0
+        self.lock = threading.Lock()
+
+    def next_id(self) -> str:
+        with self.lock:
+            self._id += 1
+            return f"id{self._id}"
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: FakeStore = None  # set by serve()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    # ---- helpers -----------------------------------------------------
+
+    def _parts(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        segs = parsed.path.lstrip("/").split("/", 1)
+        bucket = segs[0] if segs and segs[0] else ""
+        key = urllib.parse.unquote(segs[1]) if len(segs) > 1 else ""
+        return parsed, q, bucket, key
+
+    def _send(self, code: int, body: bytes = b"",
+              headers: Optional[Dict[str, str]] = None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    # ---- GET: media (ranged), listings -------------------------------
+
+    def do_GET(self):
+        st = self.store
+        st.request_count += 1
+        parsed, q, bucket, key = self._parts()
+        # GCS JSON list: /storage/v1/b/<bucket>/o
+        m = re.match(r"^/storage/v1/b/([^/]+)/o$", parsed.path)
+        if m:
+            return self._gcs_list(m.group(1), q)
+        # S3 list: /<bucket>?list-type=2
+        if "list-type" in q:
+            return self._s3_list(bucket, q)
+        data = st.objects.get((bucket, key))
+        if data is None:
+            return self._send(404)
+        start = 0
+        rng = self.headers.get("Range")
+        if rng:
+            m = re.match(r"bytes=(\d+)-(\d*)", rng)
+            start = int(m.group(1))
+        body = data[start:]
+        if st.fail_after_bytes is not None and len(body) > st.fail_after_bytes:
+            # send a truncated response then drop the connection
+            self.send_response(206 if rng else 200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body[: st.fail_after_bytes])
+            self.close_connection = True
+            return
+        self._send(206 if rng else 200, body)
+
+    def _s3_list(self, bucket: str, q: Dict[str, str]):
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        files, prefixes = [], set()
+        for (b, k), data in sorted(self.store.objects.items()):
+            if b != bucket or not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            if delim and delim in rest:
+                prefixes.add(prefix + rest.split(delim, 1)[0] + delim)
+            else:
+                files.append((k, len(data)))
+        items = "".join(
+            f"<Contents><Key>{k}</Key><Size>{n}</Size></Contents>"
+            for k, n in files
+        ) + "".join(
+            f"<CommonPrefixes><Prefix>{p}</Prefix></CommonPrefixes>"
+            for p in sorted(prefixes)
+        )
+        body = (
+            "<?xml version='1.0'?><ListBucketResult>" + items +
+            "</ListBucketResult>"
+        ).encode()
+        self._send(200, body, {"Content-Type": "application/xml"})
+
+    def _gcs_list(self, bucket: str, q: Dict[str, str]):
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        items, prefixes = [], set()
+        for (b, k), data in sorted(self.store.objects.items()):
+            if b != bucket or not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            if delim and delim in rest:
+                prefixes.add(prefix + rest.split(delim, 1)[0] + delim)
+            else:
+                items.append({"name": k, "size": str(len(data))})
+        body = json.dumps(
+            {"items": items, "prefixes": sorted(prefixes)}
+        ).encode()
+        self._send(200, body, {"Content-Type": "application/json"})
+
+    # ---- HEAD --------------------------------------------------------
+
+    def do_HEAD(self):
+        _, _, bucket, key = self._parts()
+        data = self.store.objects.get((bucket, key))
+        if data is None:
+            return self._send(404)
+        self._send(200, b"", {"Content-Length": str(len(data))})
+
+    # ---- POST: multipart init/complete, resumable session start ------
+
+    def do_POST(self):
+        st = self.store
+        st.request_count += 1
+        parsed, q, bucket, key = self._parts()
+        body = self._read_body()
+        # GCS resumable session start
+        m = re.match(r"^/upload/storage/v1/b/([^/]+)/o$", parsed.path)
+        if m and q.get("uploadType") == "resumable":
+            sid = st.next_id()
+            st.sessions[sid] = bytearray()
+            st.session_target[sid] = (m.group(1), q["name"])
+            host = self.headers.get("Host", "localhost")
+            return self._send(200, b"", {
+                "Location": f"http://{host}/resumable/{sid}"
+            })
+        # S3 multipart init
+        if "uploads" in q:
+            uid = st.next_id()
+            st.uploads[uid] = {}
+            xml = (f"<?xml version='1.0'?><InitiateMultipartUploadResult>"
+                   f"<UploadId>{uid}</UploadId>"
+                   f"</InitiateMultipartUploadResult>").encode()
+            return self._send(200, xml)
+        # S3 multipart complete
+        if "uploadId" in q:
+            uid = q["uploadId"]
+            parts = st.uploads.pop(uid, {})
+            st.objects[(bucket, key)] = b"".join(
+                parts[i] for i in sorted(parts)
+            )
+            return self._send(200, b"<?xml version='1.0'?><Done/>")
+        self._send(400)
+
+    # ---- PUT: object, part, resumable chunk --------------------------
+
+    def do_PUT(self):
+        st = self.store
+        st.request_count += 1
+        parsed, q, bucket, key = self._parts()
+        body = self._read_body()
+        m = re.match(r"^/resumable/(.+)$", parsed.path)
+        if m:
+            sid = m.group(1)
+            if sid not in st.sessions:
+                return self._send(404)
+            crange = self.headers.get("Content-Range", "")
+            st.sessions[sid].extend(body)
+            if crange.endswith("/*"):
+                return self._send(308)  # more chunks expected
+            b, k = st.session_target[sid]
+            st.objects[(b, k)] = bytes(st.sessions.pop(sid))
+            del st.session_target[sid]
+            return self._send(200)
+        if "partNumber" in q:
+            uid = q["uploadId"]
+            st.uploads[uid][int(q["partNumber"])] = body
+            return self._send(200, b"", {"ETag": f'"etag{q["partNumber"]}"'})
+        st.objects[(bucket, key)] = body
+        self._send(200)
+
+
+def serve():
+    """→ (server, store, base_url); caller must server.shutdown()."""
+    store = FakeStore()
+    handler = type("BoundHandler", (Handler,), {"store": store})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, store, f"http://127.0.0.1:{server.server_address[1]}"

@@ -1,0 +1,182 @@
+"""Object-store backend tests against the in-process fake server — the
+hermetic coverage SURVEY §4 notes the reference lacked (it tested S3 against
+the live service only, test/README.md:3-31)."""
+
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io.filesystem import (
+    URI,
+    create_stream,
+    create_stream_for_read,
+    get_filesystem,
+    register_filesystem,
+)
+from dmlc_tpu.io.object_store import (
+    GCSFileSystem,
+    S3FileSystem,
+    _sigv4_headers,
+)
+from tests.fake_object_store import serve
+
+
+@pytest.fixture()
+def s3(monkeypatch):
+    server, store, base = serve()
+    monkeypatch.setenv("S3_ENDPOINT", base)
+    monkeypatch.setenv("DMLC_S3_WRITE_BUFFER_MB", "1")
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    register_filesystem("s3://", lambda uri: S3FileSystem())  # drop cache
+    yield store
+    server.shutdown()
+
+
+@pytest.fixture()
+def gcs(monkeypatch):
+    server, store, base = serve()
+    monkeypatch.setenv("GCS_ENDPOINT_URL", base)
+    monkeypatch.setenv("DMLC_GCS_WRITE_BUFFER_MB", "1")
+    register_filesystem("gs://", lambda uri: GCSFileSystem())
+    yield store
+    server.shutdown()
+
+
+class TestSigV4:
+    def test_known_vector(self):
+        """AWS's documented get-vanilla-query example (public test suite)."""
+        import datetime
+
+        now = datetime.datetime(2015, 8, 30, 12, 36, 0,
+                                tzinfo=datetime.timezone.utc)
+        hdrs = _sigv4_headers(
+            "GET", "https://example.amazonaws.com/", "us-east-1",
+            "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+            b"", None, now,
+        )
+        # derived per the documented algorithm; stable regression anchor
+        assert hdrs["x-amz-date"] == "20150830T123600Z"
+        assert hdrs["Authorization"].startswith(
+            "AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20150830/us-east-1/s3/"
+            "aws4_request, SignedHeaders=host;x-amz-content-sha256;x-amz-date,"
+        )
+
+    def test_signature_changes_with_key(self):
+        a = _sigv4_headers("GET", "https://h/x", "r", "ak", "sk1")
+        b = _sigv4_headers("GET", "https://h/x", "r", "ak", "sk2")
+        assert a["Authorization"] != b["Authorization"]
+
+
+class TestS3:
+    def test_roundtrip_small(self, s3):
+        with create_stream("s3://bkt/dir/a.bin", "w") as w:
+            w.write(b"hello object world")
+        assert s3.objects[("bkt", "dir/a.bin")] == b"hello object world"
+        r = create_stream_for_read("s3://bkt/dir/a.bin")
+        assert r.read(5) == b"hello"
+        r.seek(6)
+        assert r.read(100) == b"object world"
+
+    def test_multipart_upload(self, s3):
+        payload = bytes(range(256)) * 4096 * 5  # 5 MB > 1 MB part size
+        with create_stream("s3://bkt/big.bin", "w") as w:
+            w.write(payload)
+        assert s3.objects[("bkt", "big.bin")] == payload
+        assert not s3.uploads  # completed + cleaned up
+
+    def test_list_directory(self, s3):
+        for k in ("d/x.txt", "d/y.txt", "d/sub/z.txt", "other.txt"):
+            s3.objects[("bkt", k)] = b"123"
+        fs = get_filesystem(URI.parse("s3://bkt/d"))
+        infos = fs.list_directory(URI.parse("s3://bkt/d"))
+        names = [(i.path.name, i.type) for i in infos]
+        assert ("/d/sub", 1) in names
+        assert ("/d/x.txt", 0) in names and ("/d/y.txt", 0) in names
+        assert all(not n.startswith("/other") for n, _ in names)
+
+    def test_stat_and_missing(self, s3):
+        s3.objects[("bkt", "f")] = b"12345"
+        fs = get_filesystem(URI.parse("s3://bkt/f"))
+        assert fs.get_path_info(URI.parse("s3://bkt/f")).size == 5
+        with pytest.raises(FileNotFoundError):
+            fs.get_path_info(URI.parse("s3://bkt/nope"))
+        assert create_stream_for_read("s3://bkt/nope", allow_null=True) is None
+
+    def test_reconnect_on_short_reads(self, s3):
+        data = os.urandom(64 << 10)
+        s3.objects[("bkt", "r")] = data
+        s3.fail_after_bytes = 8 << 10  # server drops after 8 KiB every time
+        r = create_stream_for_read("s3://bkt/r")
+        got = r.read(len(data))
+        assert got == data
+
+    def test_signed_request_has_auth_header(self, s3, monkeypatch):
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "ak")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "sk")
+        register_filesystem("s3://", lambda uri: S3FileSystem())
+        s3.objects[("bkt", "f")] = b"x"
+        r = create_stream_for_read("s3://bkt/f")
+        assert r.read(1) == b"x"  # fake ignores auth; just exercises signing
+
+
+class TestGCS:
+    def test_roundtrip_small(self, gcs):
+        with create_stream("gs://bkt/obj.txt", "w") as w:
+            w.write(b"gcs payload")
+        assert gcs.objects[("bkt", "obj.txt")] == b"gcs payload"
+        r = create_stream_for_read("gs://bkt/obj.txt")
+        assert r.read(3) == b"gcs"
+
+    def test_resumable_multi_chunk(self, gcs):
+        payload = os.urandom(3 << 20)  # 3 MB > 1 MB chunks
+        with create_stream("gs://bkt/big", "w") as w:
+            w.write(payload)
+        assert gcs.objects[("bkt", "big")] == payload
+        assert not gcs.sessions  # session finalized
+
+    def test_list_directory(self, gcs):
+        for k in ("p/a", "p/b", "p/q/c"):
+            gcs.objects[("bkt", k)] = b"1"
+        fs = get_filesystem(URI.parse("gs://bkt/p"))
+        infos = fs.list_directory(URI.parse("gs://bkt/p"))
+        names = [(i.path.name, i.type) for i in infos]
+        assert ("/p/a", 0) in names and ("/p/q", 1) in names
+
+    def test_ranged_read(self, gcs):
+        gcs.objects[("bkt", "r")] = b"0123456789"
+        r = create_stream_for_read("gs://bkt/r")
+        r.seek(4)
+        assert r.read(3) == b"456"
+        r.seek(0)
+        assert r.read(2) == b"01"
+
+
+class TestIngestOverObjectStore:
+    def test_input_split_over_s3(self, s3):
+        """Sharded text ingest straight off the object store: the BASELINE
+        'sharded ingest into TPU' path with s3:// URIs."""
+        from dmlc_tpu.io.input_split import create_input_split
+
+        lines = [f"line{i:04d}" for i in range(100)]
+        blob = ("\n".join(lines) + "\n").encode()
+        s3.objects[("bkt", "data/part0.txt")] = blob[: len(blob) // 2]
+        # split cleanly at a line boundary for file 2
+        head = blob[: len(blob) // 2]
+        cut = head.rfind(b"\n") + 1
+        s3.objects[("bkt", "data/part0.txt")] = blob[:cut]
+        s3.objects[("bkt", "data/part1.txt")] = blob[cut:]
+        seen = []
+        for part in range(3):
+            split = create_input_split(
+                "s3://bkt/data/part0.txt;s3://bkt/data/part1.txt",
+                part, 3, "text",
+            )
+            while True:
+                rec = split.next_record()
+                if rec is None:
+                    break
+                seen.append(bytes(rec).decode())
+        assert sorted(seen) == lines
