@@ -68,6 +68,25 @@ def _bind(lib) -> None:
     lib.count_tokens.argtypes = [
         ctypes.c_char_p, i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
     ]
+    lib.recordio_pack_bound.restype = i64
+    lib.recordio_pack_bound.argtypes = [ctypes.c_char_p, i64]
+    lib.recordio_pack.restype = i64
+    lib.recordio_pack.argtypes = [ctypes.c_char_p, i64, ctypes.c_void_p]
+    lib.recordio_pack_batch.restype = i64
+    lib.recordio_pack_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, i64, ctypes.c_void_p,
+    ]
+    lib.recordio_pack_batch_bound.restype = i64
+    lib.recordio_pack_batch_bound.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, i64,
+    ]
+    lib.recordio_unpack.restype = ctypes.c_int
+    lib.recordio_unpack.argtypes = [
+        ctypes.c_char_p, i64, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(i64), ctypes.POINTER(i64), ctypes.POINTER(i64),
+    ]
+    lib.recordio_find_head.restype = i64
+    lib.recordio_find_head.argtypes = [ctypes.c_char_p, i64, i64]
     lib.dmlc_tpu_abi_version.restype = ctypes.c_int
     lib.dmlc_tpu_abi_version.argtypes = []
 
@@ -107,13 +126,13 @@ def _try_build() -> None:
 
 
 def _load(path: str):
-    """dlopen+bind, or None when the file is unloadable (e.g. another
-    process is mid-link; the Makefile links to a temp then renames, but a
-    stale/corrupt artifact must not crash the caller)."""
+    """dlopen+bind, or None when the file is unloadable — corrupt artifact,
+    or a stale build missing newly added symbols (AttributeError): returning
+    None lets the caller rebuild and retry."""
     try:
         lib = ctypes.CDLL(path)
         _bind(lib)
-    except OSError:
+    except (OSError, AttributeError):
         return None
     if lib.dmlc_tpu_abi_version() != 1:
         raise DMLCError(f"native ABI mismatch in {path}")
@@ -270,3 +289,69 @@ def parse_csv_chunk(chunk: bytes, expect_cols: int = 0) -> Optional[tuple]:
     if rc != _OK:
         raise DMLCError(f"native csv parse failed rc={rc}")
     return out[: out_rows.value, : out_cols.value]
+
+
+# ---------------------------------------------------------------------------
+# RecordIO framing (cpp/recordio.cc — reference src/recordio.cc semantics)
+# ---------------------------------------------------------------------------
+
+
+def recordio_pack_records(records) -> Optional[bytes]:
+    """Frame a batch of payloads into RecordIO bytes, or None (no native).
+    Accepts any iterable of bytes-likes."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    records = list(records)
+    offsets = np.zeros(len(records) + 1, dtype=np.int64)
+    for i, r in enumerate(records):
+        offsets[i + 1] = offsets[i] + len(r)
+    data = b"".join(bytes(r) for r in records)
+    bound = lib.recordio_pack_batch_bound(data, _ptr(offsets), len(records))
+    out = np.empty(int(bound), dtype=np.uint8)
+    n = lib.recordio_pack_batch(data, _ptr(offsets), len(records), _ptr(out))
+    if n < 0:
+        raise DMLCError("RecordIO only accepts records < 2^29 bytes")
+    return out[:n].tobytes()
+
+
+def recordio_unpack_chunk(chunk: bytes) -> Optional[tuple]:
+    """Decode all complete records in a chunk that starts at a record head.
+
+    → (payloads: bytes, offsets: i64[n+1], consumed: int) or None (no
+    native). Raises DMLCError on corrupt framing.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    # reassembly re-inserts elided magics: output can exceed the input
+    # payload bytes but never the input length plus one magic per frame
+    cap = len(chunk) + 4
+    out_data = np.empty(cap, dtype=np.uint8)
+    max_rec = len(chunk) // 8 + 2
+    out_offsets = np.zeros(max_rec + 1, dtype=np.int64)
+    nrec = ctypes.c_int64()
+    dlen = ctypes.c_int64()
+    consumed = ctypes.c_int64()
+    rc = lib.recordio_unpack(
+        chunk, len(chunk), _ptr(out_data), _ptr(out_offsets),
+        ctypes.byref(nrec), ctypes.byref(dlen), ctypes.byref(consumed),
+    )
+    if rc != _OK:
+        raise DMLCError("Invalid RecordIO format (native unpack)")
+    n = nrec.value
+    return (
+        out_data[: dlen.value].tobytes(),
+        out_offsets[: n + 1].copy(),
+        consumed.value,
+    )
+
+
+def recordio_find_head(buf: bytes, start: int = 0) -> Optional[int]:
+    """First plausible record-head offset ≥ start: -1 when none exists, or
+    None when the native library is unavailable (callers fall back to the
+    numpy scan)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    return int(lib.recordio_find_head(buf, len(buf), start))

@@ -97,3 +97,96 @@ def test_reader_rejects_garbage():
     bad = b"\x01\x02\x03\x04\x05\x06\x07\x08"
     with pytest.raises(Exception):
         RecordIOReader(MemoryStream(bad)).next_record()
+
+
+class TestNativeRecordIO:
+    """Native (cpp/recordio.cc) vs pure-Python framing parity."""
+
+    def _adversarial_records(self):
+        import struct
+        magic = struct.pack("<I", 0xCED7230A)
+        rng = np.random.RandomState(3)
+        recs = [b"", magic, magic * 5, b"x" + magic, magic + b"y",
+                b"ab" + magic + b"cd"]
+        for n in (1, 3, 4, 7, 64, 1000):
+            recs.append(rng.bytes(n))
+        recs.append(b"pad" + magic * 3 + b"tail" + magic)
+        return recs
+
+    def test_write_records_batch_matches_loop(self, tmp_path):
+        from dmlc_tpu.io.filesystem import create_stream
+        from dmlc_tpu import native
+
+        recs = self._adversarial_records()
+        a, b = tmp_path / "a.rec", tmp_path / "b.rec"
+        with create_stream(str(a), "w") as s:
+            w = RecordIOWriter(s)
+            for r in recs:
+                w.write_record(r)
+            count_loop = w.except_counter
+        with create_stream(str(b), "w") as s:
+            w = RecordIOWriter(s)
+            w.write_records(recs)
+            count_batch = w.except_counter
+        assert a.read_bytes() == b.read_bytes()
+        assert count_loop == count_batch
+        if native.available():
+            assert native.recordio_pack_records(recs) == a.read_bytes()
+
+    def test_chunk_reader_native_path(self, tmp_path):
+        import io as pyio
+        from dmlc_tpu.io.stream import FileObjStream
+
+        recs = self._adversarial_records()
+        buf = pyio.BytesIO()
+        w = RecordIOWriter(FileObjStream(buf))
+        w.write_records(recs)
+        data = buf.getvalue()
+        # whole chunk and subdivided parts must both reproduce the records
+        assert list(RecordIOChunkReader(data)) == recs
+        for nsplit in (2, 3, 5):
+            out = []
+            for part in range(nsplit):
+                out.extend(RecordIOChunkReader(data, part, nsplit))
+            assert out == recs
+
+    def test_native_python_parity(self, tmp_path, monkeypatch):
+        import io as pyio
+        from dmlc_tpu.io.stream import FileObjStream
+
+        recs = self._adversarial_records()
+        buf = pyio.BytesIO()
+        RecordIOWriter(FileObjStream(buf)).write_records(recs)
+        data = buf.getvalue()
+        native_out = list(RecordIOChunkReader(data))
+        monkeypatch.setenv("DMLC_TPU_NATIVE", "0")
+        python_out = list(RecordIOChunkReader(data))
+        assert native_out == python_out == recs
+
+    def test_unpack_rejects_corrupt(self):
+        from dmlc_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        with pytest.raises(Exception):
+            native.recordio_unpack_chunk(b"\x01\x02\x03\x04\x05\x06\x07\x08")
+
+
+    def test_truncated_multipart_detected(self):
+        """A chunk ending mid multi-part record must raise, native or not
+        (the reference's reader CHECKs the same way, recordio.cc:53-82)."""
+        import io as pyio
+        from dmlc_tpu.io.stream import FileObjStream
+        from dmlc_tpu import native
+
+        magic = struct.pack("<I", 0xCED7230A)
+        buf = pyio.BytesIO()
+        RecordIOWriter(FileObjStream(buf)).write_record(b"ab" + magic + b"cd")
+        data = buf.getvalue()
+        truncated = data[:12]  # ends exactly after the first (start) frame
+        with pytest.raises(Exception):
+            list(RecordIOChunkReader(truncated))
+        if native.available():
+            res = native.recordio_unpack_chunk(truncated)
+            payloads, offsets, consumed = res
+            assert consumed == 0 and len(offsets) == 1  # nothing complete
