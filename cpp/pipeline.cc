@@ -1,0 +1,680 @@
+// Native ingest pipeline: reader thread -> parse workers -> ordered queue.
+//
+// TPU-build equivalent of the reference's threaded ingest composition:
+// ThreadedInputSplit's chunk prefetch thread (src/io/threaded_input_split.h),
+// ThreadedParser's parse producer (src/data/parser.h:70-126) and the OpenMP
+// chunk parse team (src/data/text_parser.h:94-134) — rebuilt as one native
+// pipeline so the Python layer only sees finished CSR blocks. Design differs
+// from the reference: chunk-level (not intra-chunk) parallelism across a
+// worker pool, sequence-numbered ordered delivery, and recycled chunk
+// buffers (the ThreadedIter free-cell idea, threadediter.h:442-454) so
+// steady state does no allocation on the reader side.
+//
+// Partitioning semantics are the reference's exactly-once contract
+// (src/io/input_split_base.cc:30-64): part k of n covers global bytes
+// [adj(k*step), adj((k+1)*step)) over the concatenated file sequence, where
+// adj(x) scans forward from x to just past the next end-of-line run
+// (line_split.cc:9-26) and adj(0) = 0. Every record lands in exactly one
+// part for any n.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <new>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// parse.cc hot loops (same translation unit boundary as the ctypes ABI).
+int parse_libsvm(const char* data, int64_t len, float* labels, float* weights,
+                 int64_t* qids, int64_t* row_nnz, uint64_t* indices,
+                 float* values, int64_t max_rows, int64_t max_nnz,
+                 int64_t* out_rows, int64_t* out_nnz, int* out_flags);
+int parse_libfm(const char* data, int64_t len, float* labels, int64_t* row_nnz,
+                uint64_t* fields, uint64_t* indices, float* values,
+                int64_t max_rows, int64_t max_nnz, int64_t* out_rows,
+                int64_t* out_nnz);
+int parse_csv(const char* data, int64_t len, float* out, int64_t max_rows,
+              int64_t expect_cols, int64_t* out_rows, int64_t* out_cols);
+void count_tokens(const char* data, int64_t len, int64_t* out_rows,
+                  int64_t* out_tokens);
+}
+
+namespace {
+
+enum Format { kLibsvm = 0, kLibfm = 1, kCsv = 2 };
+
+enum {
+  kOk = 0,
+  kEOverflow = -1,
+  kEParse = -2,
+  kEIo = -3,
+  kEOom = -4,
+};
+
+inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
+
+struct Chunk {
+  std::string data;
+  int64_t seq = 0;
+};
+
+// One parsed CSR batch. Buffers are malloc'd to a generous bound derived
+// from the chunk length (every row and every token is >= 2 bytes, so
+// len/2+2 bounds both) — untouched slack pages are virtual-only, which
+// beats pre-scanning the chunk to size exactly. Indices/fields are written
+// as u64 by the parse then narrowed to u32 in place (forward pass: the
+// write offset never passes the read offset).
+struct Block {
+  float* labels = nullptr;
+  float* weights = nullptr;
+  float* values = nullptr;
+  int64_t* qids = nullptr;
+  int64_t* offsets = nullptr;
+  uint64_t* indices = nullptr;  // u32-packed after NarrowIndices
+  uint64_t* fields = nullptr;   // u32-packed after NarrowIndices
+  int64_t rows = 0, nnz = 0, ncols = 0;
+  int flags = 0;
+  int64_t seq = 0;
+
+  ~Block() {
+    std::free(labels);
+    std::free(weights);
+    std::free(values);
+    std::free(qids);
+    std::free(offsets);
+    std::free(indices);
+    std::free(fields);
+  }
+};
+
+inline void NarrowU64ToU32(uint64_t* buf, int64_t n) {
+  uint32_t* dst = reinterpret_cast<uint32_t*>(buf);
+  for (int64_t i = 0; i < n; ++i) dst[i] = static_cast<uint32_t>(buf[i]);
+}
+
+template <typename T>
+T* AllocArray(int64_t n) {
+  return static_cast<T*>(std::malloc(static_cast<size_t>(n) * sizeof(T) + 1));
+}
+
+// Sequential reader over the concatenated file list, restricted to a global
+// byte range (the reference's InputSplitBase::Read loop spanning file
+// boundaries, input_split_base.cc:177-209).
+class RangeReader {
+ public:
+  RangeReader(const std::vector<std::string>& paths,
+              const std::vector<int64_t>& sizes)
+      : paths_(paths), sizes_(sizes) {
+    offsets_.push_back(0);
+    for (int64_t s : sizes_) offsets_.push_back(offsets_.back() + s);
+  }
+
+  ~RangeReader() { CloseFile(); }
+
+  int64_t total() const { return offsets_.back(); }
+
+  bool SeekGlobal(int64_t pos) {
+    CloseFile();
+    pos_ = pos;
+    if (pos >= total()) return true;
+    file_idx_ = FileIndexFor(pos);
+    if (!OpenFile(file_idx_)) return false;
+    int64_t local = pos - offsets_[file_idx_];
+    if (local != 0 && std::fseek(file_, static_cast<long>(local), SEEK_SET)) {
+      return false;
+    }
+    return true;
+  }
+
+  // Read up to n bytes at the current position; 0 at end of file list,
+  // -1 on I/O error.
+  int64_t Read(char* buf, int64_t n) {
+    int64_t got = 0;
+    while (got < n) {
+      if (file_ == nullptr) {
+        if (pos_ >= total()) break;
+        file_idx_ = FileIndexFor(pos_);
+        if (!OpenFile(file_idx_)) return -1;
+      }
+      // never read past this file's declared size: a file that grew after
+      // listing must not shift the global offset<->file mapping
+      int64_t want = std::min<int64_t>(n - got, offsets_[file_idx_ + 1] - pos_);
+      if (want <= 0) {
+        CloseFile();
+        if (file_idx_ + 1 >= static_cast<int64_t>(paths_.size())) break;
+        continue;
+      }
+      size_t r = std::fread(buf + got, 1, static_cast<size_t>(want), file_);
+      if (r > 0) {
+        got += static_cast<int64_t>(r);
+        pos_ += static_cast<int64_t>(r);
+        continue;
+      }
+      if (std::ferror(file_)) return -1;
+      // end of this file: advance to the next one
+      CloseFile();
+      if (pos_ != offsets_[file_idx_ + 1]) return -1;  // size changed underfoot
+      if (file_idx_ + 1 >= static_cast<int64_t>(paths_.size())) break;
+    }
+    return got;
+  }
+
+  int64_t pos() const { return pos_; }
+
+ private:
+  int64_t FileIndexFor(int64_t pos) const {
+    int64_t lo = 0, hi = static_cast<int64_t>(sizes_.size()) - 1;
+    while (lo < hi) {
+      int64_t mid = (lo + hi + 1) / 2;
+      if (offsets_[mid] <= pos) lo = mid;
+      else hi = mid - 1;
+    }
+    return lo;
+  }
+
+  bool OpenFile(int64_t idx) {
+    CloseFile();
+    file_ = std::fopen(paths_[idx].c_str(), "rb");
+    return file_ != nullptr;
+  }
+
+  void CloseFile() {
+    if (file_ != nullptr) {
+      std::fclose(file_);
+      file_ = nullptr;
+    }
+  }
+
+  const std::vector<std::string> paths_;
+  const std::vector<int64_t> sizes_;
+  std::vector<int64_t> offsets_;
+  FILE* file_ = nullptr;
+  int64_t file_idx_ = 0;
+  int64_t pos_ = 0;
+};
+
+class Pipeline {
+ public:
+  Pipeline(std::vector<std::string> paths, std::vector<int64_t> sizes,
+           int format, int part, int nparts, int nthread, int64_t chunk_bytes,
+           int capacity, int64_t csv_expect_cols)
+      : paths_(std::move(paths)),
+        sizes_(std::move(sizes)),
+        format_(format),
+        part_(part),
+        nparts_(nparts),
+        nthread_(nthread < 1 ? 1 : nthread),
+        chunk_bytes_(chunk_bytes < (1 << 16) ? (1 << 16) : chunk_bytes),
+        out_capacity_(capacity < 2 ? 2 : capacity),
+        csv_expect_cols_(csv_expect_cols) {}
+
+  ~Pipeline() { Close(); }
+
+  void Start() {
+    reader_ = std::thread([this] {
+      try {
+        ReaderMain();
+      } catch (const std::bad_alloc&) {
+        Fail(kEOom);
+      }
+    });
+    for (int i = 0; i < nthread_; ++i) {
+      workers_.emplace_back([this] { WorkerMain(); });
+    }
+  }
+
+  // Wait for the next in-order block without consuming it.
+  // 1 = block staged (sizes via *out), 0 = end of stream, <0 = error.
+  int Peek(Block** out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      if (error_ != 0) return error_;
+      if (current_ != nullptr) {
+        *out = current_;
+        return 1;
+      }
+      auto it = done_.find(next_seq_out_);
+      if (it != done_.end()) {
+        current_ = it->second;
+        done_.erase(it);
+        ++next_seq_out_;
+        cv_out_space_.notify_all();
+        *out = current_;
+        return 1;
+      }
+      if (reader_done_ && next_seq_out_ >= total_chunks_) return 0;
+      cv_out_.wait(lk);
+    }
+  }
+
+  // Consume the staged block, copying into caller-owned buffers (any may be
+  // null to skip). Returns 1, or 0 when nothing is staged.
+  int Fetch(float* labels, float* weights, int64_t* qids, int64_t* offsets,
+            uint32_t* indices, float* values, uint32_t* fields) {
+    Block* b;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      b = current_;
+      if (b == nullptr) return 0;
+      current_ = nullptr;
+    }
+    size_t n = static_cast<size_t>(b->rows);
+    size_t z = static_cast<size_t>(b->nnz);
+    if (labels != nullptr) std::memcpy(labels, b->labels, n * 4);
+    if (weights != nullptr) std::memcpy(weights, b->weights, n * 4);
+    if (qids != nullptr) std::memcpy(qids, b->qids, n * 8);
+    if (offsets != nullptr) std::memcpy(offsets, b->offsets, (n + 1) * 8);
+    if (indices != nullptr) std::memcpy(indices, b->indices, z * 4);
+    if (values != nullptr) std::memcpy(values, b->values, z * 4);
+    if (fields != nullptr) std::memcpy(fields, b->fields, z * 4);
+    delete b;
+    return 1;
+  }
+
+  int64_t BytesRead() const { return bytes_read_.load(); }
+
+  void Close() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stop_) return;
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    cv_work_space_.notify_all();
+    cv_out_.notify_all();
+    cv_out_space_.notify_all();
+    if (reader_.joinable()) reader_.join();
+    for (auto& w : workers_)
+      if (w.joinable()) w.join();
+    for (auto& kv : done_) delete kv.second;
+    done_.clear();
+    for (Chunk* c : work_) delete c;
+    work_.clear();
+    for (Chunk* c : free_chunks_) delete c;
+    free_chunks_.clear();
+    if (current_ != nullptr) {
+      delete current_;
+      current_ = nullptr;
+    }
+  }
+
+ private:
+  // ---- reader side ----------------------------------------------------
+  // adj(x): first record-begin at global offset >= x (0 stays 0). Scans to
+  // the first EOL char then consumes the whole EOL run, the LineSplitter
+  // SeekRecordBegin contract (line_split.cc:9-26).
+  int64_t AdjustBoundary(RangeReader* rd, int64_t x) {
+    if (x <= 0) return 0;
+    if (x >= rd->total()) return rd->total();
+    if (!rd->SeekGlobal(x)) return -1;
+    char buf[4096];
+    bool seen_eol = false;
+    int64_t pos = x;
+    for (;;) {
+      int64_t n = rd->Read(buf, sizeof(buf));
+      if (n < 0) return -1;
+      if (n == 0) return pos;
+      for (int64_t i = 0; i < n; ++i) {
+        if (is_eol(buf[i])) {
+          seen_eol = true;
+        } else if (seen_eol) {
+          return pos + i;
+        }
+      }
+      pos += n;
+    }
+  }
+
+  void ReaderMain() {
+    RangeReader rd(paths_, sizes_);
+    int64_t total = rd.total();
+    // ceil-div step, matching input_split_base.cc:30-40 with align=1
+    int64_t nstep = (total + nparts_ - 1) / nparts_;
+    int64_t raw_begin = std::min<int64_t>(nstep * part_, total);
+    int64_t raw_end = std::min<int64_t>(nstep * (part_ + 1), total);
+    if (raw_begin >= raw_end) {
+      FinishReader(0);
+      return;
+    }
+    int64_t begin = AdjustBoundary(&rd, raw_begin);
+    int64_t end = AdjustBoundary(&rd, raw_end);
+    if (begin < 0 || end < 0 || !rd.SeekGlobal(begin)) {
+      Fail(kEIo);
+      return;
+    }
+    int64_t seq = 0;
+    std::string tail;
+    while (rd.pos() < end || !tail.empty()) {
+      Chunk* chunk = AcquireChunk();
+      if (chunk == nullptr) {  // stopped
+        FinishReader(seq);
+        return;
+      }
+      chunk->data.swap(tail);
+      tail.clear();
+      int64_t target = chunk_bytes_;
+      bool final_chunk = false;
+      for (;;) {
+        int64_t want =
+            std::min<int64_t>(target - static_cast<int64_t>(chunk->data.size()),
+                              end - rd.pos());
+        if (want > 0) {
+          size_t base = chunk->data.size();
+          chunk->data.resize(base + static_cast<size_t>(want));
+          int64_t got = rd.Read(&chunk->data[base], want);
+          if (got < 0) {
+            delete chunk;
+            Fail(kEIo);
+            return;
+          }
+          chunk->data.resize(base + static_cast<size_t>(got));
+          if (got < want) {
+            // file list exhausted early (sizes changed): treat as final
+            final_chunk = true;
+            break;
+          }
+        }
+        if (rd.pos() >= end) {
+          final_chunk = true;
+          break;
+        }
+        // cut at the last record begin inside the buffer
+        int64_t cut = LastRecordBegin(chunk->data);
+        if (cut > 0) {
+          tail.assign(chunk->data, static_cast<size_t>(cut),
+                      chunk->data.size() - static_cast<size_t>(cut));
+          chunk->data.resize(static_cast<size_t>(cut));
+          break;
+        }
+        // no boundary inside: grow and keep reading (Chunk::Load doubling,
+        // input_split_base.cc:241-258)
+        target *= 2;
+      }
+      if (chunk->data.empty()) {
+        ReleaseChunk(chunk);
+        if (final_chunk) break;
+        continue;
+      }
+      chunk->seq = seq++;
+      if (!PushWork(chunk)) {
+        FinishReader(seq);
+        return;
+      }
+      if (final_chunk) break;
+    }
+    FinishReader(seq);
+  }
+
+  // offset just past the last EOL char at index >= 1, or 0 when none
+  // (line_split.cc FindLastRecordBegin semantics).
+  static int64_t LastRecordBegin(const std::string& buf) {
+    for (int64_t i = static_cast<int64_t>(buf.size()) - 1; i >= 1; --i) {
+      if (is_eol(buf[static_cast<size_t>(i)])) return i + 1;
+    }
+    return 0;
+  }
+
+  Chunk* AcquireChunk() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_work_space_.wait(lk, [this] {
+      return stop_ || static_cast<int>(work_.size()) < nthread_ * 2;
+    });
+    if (stop_) return nullptr;
+    if (!free_chunks_.empty()) {
+      Chunk* c = free_chunks_.back();
+      free_chunks_.pop_back();
+      c->data.clear();
+      return c;
+    }
+    return new Chunk();
+  }
+
+  void ReleaseChunk(Chunk* c) {
+    std::lock_guard<std::mutex> lk(mu_);
+    free_chunks_.push_back(c);
+  }
+
+  bool PushWork(Chunk* chunk) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (stop_) {
+      delete chunk;
+      return false;
+    }
+    work_.push_back(chunk);
+    cv_work_.notify_one();
+    return true;
+  }
+
+  void FinishReader(int64_t nchunks) {
+    std::lock_guard<std::mutex> lk(mu_);
+    total_chunks_ = nchunks;
+    reader_done_ = true;
+    cv_work_.notify_all();
+    cv_out_.notify_all();
+  }
+
+  void Fail(int code) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (error_ == 0) error_ = code;
+    reader_done_ = true;
+    cv_work_.notify_all();
+    cv_out_.notify_all();
+    cv_out_space_.notify_all();
+    cv_work_space_.notify_all();
+  }
+
+  // ---- worker side ----------------------------------------------------
+  void WorkerMain() {
+    for (;;) {
+      Chunk* chunk = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_work_.wait(lk, [this] {
+          return stop_ || error_ != 0 || !work_.empty() || reader_done_;
+        });
+        if (stop_ || error_ != 0) return;
+        if (work_.empty()) {
+          if (reader_done_) return;
+          continue;
+        }
+        chunk = work_.front();
+        work_.pop_front();
+        cv_work_space_.notify_one();
+      }
+      Block* block = nullptr;
+      int rc;
+      try {
+        block = new Block();
+        block->seq = chunk->seq;
+        rc = ParseChunk(chunk->data, block);
+      } catch (const std::bad_alloc&) {
+        rc = kEOom;
+      }
+      bytes_read_.fetch_add(static_cast<int64_t>(chunk->data.size()));
+      ReleaseChunk(chunk);
+      if (rc != kOk) {
+        delete block;
+        Fail(rc);
+        return;
+      }
+      std::unique_lock<std::mutex> lk(mu_);
+      // the block the consumer is waiting for bypasses the capacity bound
+      // so ordered delivery can never deadlock; an error or stop releases
+      // every waiter
+      cv_out_space_.wait(lk, [this, block] {
+        return stop_ || error_ != 0 ||
+               static_cast<int>(done_.size()) < out_capacity_ ||
+               block->seq == next_seq_out_;
+      });
+      if (stop_ || error_ != 0) {
+        delete block;
+        return;
+      }
+      done_.emplace(block->seq, block);
+      cv_out_.notify_all();
+    }
+  }
+
+  int ParseChunk(const std::string& data, Block* b) {
+    const char* p = data.data();
+    int64_t len = static_cast<int64_t>(data.size());
+    if (format_ == kCsv) return ParseCsvChunk(p, len, b);
+    int64_t bound = len / 2 + 2;  // rows and nnz are both >= 2 bytes each
+    b->labels = AllocArray<float>(bound);
+    b->offsets = AllocArray<int64_t>(bound + 1);
+    b->indices = AllocArray<uint64_t>(bound);
+    b->values = AllocArray<float>(bound);
+    if (b->labels == nullptr || b->offsets == nullptr ||
+        b->indices == nullptr || b->values == nullptr) {
+      return kEOom;
+    }
+    int64_t rows = 0, nnz = 0;
+    int rc;
+    if (format_ == kLibsvm) {
+      b->weights = AllocArray<float>(bound);
+      b->qids = AllocArray<int64_t>(bound);
+      if (b->weights == nullptr || b->qids == nullptr) return kEOom;
+      rc = parse_libsvm(p, len, b->labels, b->weights, b->qids,
+                        b->offsets + 1, b->indices, b->values, bound, bound,
+                        &rows, &nnz, &b->flags);
+    } else {
+      b->fields = AllocArray<uint64_t>(bound);
+      if (b->fields == nullptr) return kEOom;
+      rc = parse_libfm(p, len, b->labels, b->offsets + 1, b->fields,
+                       b->indices, b->values, bound, bound, &rows, &nnz);
+    }
+    if (rc != kOk) return rc;
+    b->rows = rows;
+    b->nnz = nnz;
+    // counts -> offsets prefix sum in place
+    b->offsets[0] = 0;
+    for (int64_t i = 1; i <= rows; ++i) b->offsets[i] += b->offsets[i - 1];
+    NarrowU64ToU32(b->indices, nnz);
+    if (b->fields != nullptr) NarrowU64ToU32(b->fields, nnz);
+    return kOk;
+  }
+
+  int ParseCsvChunk(const char* p, int64_t len, Block* b) {
+    int64_t max_rows = 2;
+    for (const char* q = p; (q = static_cast<const char*>(std::memchr(
+                                 q, '\n', static_cast<size_t>(p + len - q)))) !=
+                            nullptr;
+         ++q)
+      ++max_rows;
+    int64_t cols = csv_expect_cols_;
+    if (cols <= 0) {
+      // infer from the first line of this chunk
+      cols = 1;
+      for (int64_t i = 0; i < len && !is_eol(p[i]); ++i)
+        if (p[i] == ',') ++cols;
+    }
+    b->values = AllocArray<float>(max_rows * cols);
+    if (b->values == nullptr) return kEOom;
+    int64_t rows = 0, out_cols = 0;
+    int rc = parse_csv(p, len, b->values, max_rows, cols, &rows, &out_cols);
+    if (rc != kOk) return rc;
+    b->rows = rows;
+    b->ncols = out_cols;
+    b->nnz = rows * out_cols;
+    return kOk;
+  }
+
+  // ---- state ----------------------------------------------------------
+  const std::vector<std::string> paths_;
+  const std::vector<int64_t> sizes_;
+  const int format_;
+  const int part_, nparts_;
+  const int nthread_;
+  const int64_t chunk_bytes_;
+  const int out_capacity_;
+  const int64_t csv_expect_cols_;
+
+  std::thread reader_;
+  std::vector<std::thread> workers_;
+
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_work_space_, cv_out_, cv_out_space_;
+  std::deque<Chunk*> work_;
+  std::vector<Chunk*> free_chunks_;
+  std::map<int64_t, Block*> done_;
+  int64_t next_seq_out_ = 0;
+  int64_t total_chunks_ = -1;
+  bool reader_done_ = false;
+  bool stop_ = false;
+  int error_ = 0;
+  std::atomic<int64_t> bytes_read_{0};
+  Block* current_ = nullptr;
+};
+
+}  // namespace
+
+extern "C" {
+
+// paths: '\0'-joined (nfiles entries); sizes: byte size per file.
+// format: 0=libsvm 1=libfm 2=csv. Returns NULL on bad args.
+void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
+                  int32_t format, int32_t part, int32_t nparts,
+                  int32_t nthread, int64_t chunk_bytes, int32_t capacity,
+                  int64_t csv_expect_cols) {
+  if (nfiles <= 0 || part < 0 || nparts <= 0 || part >= nparts) return nullptr;
+  if (format < 0 || format > 2) return nullptr;
+  std::vector<std::string> path_vec;
+  const char* p = paths;
+  for (int32_t i = 0; i < nfiles; ++i) {
+    path_vec.emplace_back(p);
+    p += path_vec.back().size() + 1;
+  }
+  std::vector<int64_t> size_vec(sizes, sizes + nfiles);
+  Pipeline* pl =
+      new Pipeline(std::move(path_vec), std::move(size_vec), format, part,
+                   nparts, nthread, chunk_bytes, capacity, csv_expect_cols);
+  pl->Start();
+  return pl;
+}
+
+// Wait for the next in-order block and report its sizes without consuming
+// it. Returns 1 (sizes filled), 0 at end of stream, <0 on error. Idempotent
+// until ingest_fetch consumes the staged block.
+int ingest_peek(void* handle, int64_t* rows, int64_t* nnz, int64_t* ncols,
+                int32_t* flags) {
+  Pipeline* pl = static_cast<Pipeline*>(handle);
+  Block* b = nullptr;
+  int rc = pl->Peek(&b);
+  if (rc != 1) return rc;
+  *rows = b->rows;
+  *nnz = b->nnz;
+  *ncols = b->ncols;
+  *flags = b->flags;
+  return 1;
+}
+
+// Copy the staged block into caller-owned buffers (sized per ingest_peek;
+// any pointer may be NULL to skip that array; indices/fields receive u32)
+// and consume it. Returns 1, or 0 when no block is staged.
+int ingest_fetch(void* handle, float* labels, float* weights, int64_t* qids,
+                 int64_t* offsets, uint32_t* indices, float* values,
+                 uint32_t* fields) {
+  return static_cast<Pipeline*>(handle)->Fetch(labels, weights, qids, offsets,
+                                               indices, values, fields);
+}
+
+int64_t ingest_bytes_read(void* handle) {
+  return static_cast<Pipeline*>(handle)->BytesRead();
+}
+
+void ingest_close(void* handle) {
+  Pipeline* pl = static_cast<Pipeline*>(handle);
+  pl->Close();
+  delete pl;
+}
+
+}  // extern "C"

@@ -87,6 +87,24 @@ def _bind(lib) -> None:
     ]
     lib.recordio_find_head.restype = i64
     lib.recordio_find_head.argtypes = [ctypes.c_char_p, i64, i64]
+    lib.ingest_open.restype = ctypes.c_void_p
+    lib.ingest_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, i64, ctypes.c_int32, i64,
+    ]
+    lib.ingest_peek.restype = ctypes.c_int
+    lib.ingest_peek.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(i64), ctypes.POINTER(i64), ctypes.POINTER(i64),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ingest_fetch.restype = ctypes.c_int
+    lib.ingest_fetch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 7
+    lib.ingest_bytes_read.restype = i64
+    lib.ingest_bytes_read.argtypes = [ctypes.c_void_p]
+    lib.ingest_close.restype = None
+    lib.ingest_close.argtypes = [ctypes.c_void_p]
     lib.dmlc_tpu_abi_version.restype = ctypes.c_int
     lib.dmlc_tpu_abi_version.argtypes = []
 
@@ -345,6 +363,127 @@ def recordio_unpack_chunk(chunk: bytes) -> Optional[tuple]:
         out_offsets[: n + 1].copy(),
         consumed.value,
     )
+
+
+# ---------------------------------------------------------------------------
+# Native ingest pipeline (cpp/pipeline.cc): reader thread + parse workers +
+# ordered output queue, all in C++ — the ThreadedInputSplit/ThreadedParser
+# composition of the reference as one native unit.
+# ---------------------------------------------------------------------------
+
+INGEST_LIBSVM = 0
+INGEST_LIBFM = 1
+INGEST_CSV = 2
+
+
+class IngestPipeline:
+    """Handle over the native pipeline; yields dicts of *copied* arrays.
+
+    ``next_block()`` returns None at end of stream; raises DMLCError on a
+    parse/IO error inside the pipeline (the cross-thread exception
+    propagation contract of threadediter.h:456-466).
+    """
+
+    def __init__(
+        self,
+        paths,
+        sizes,
+        fmt: int,
+        part: int,
+        nparts: int,
+        nthread: int = 2,
+        chunk_bytes: int = (2 << 20) * 4,
+        capacity: int = 8,
+        csv_expect_cols: int = 0,
+    ):
+        lib = get_lib()
+        if lib is None:
+            raise DMLCError("native library unavailable")
+        self._lib = lib
+        path_blob = b"".join(
+            (p.encode() if isinstance(p, str) else bytes(p)) + b"\0"
+            for p in paths
+        )
+        size_arr = np.asarray(sizes, dtype=np.int64)
+        self._fmt = fmt
+        self._handle = lib.ingest_open(
+            path_blob, _ptr(size_arr), len(paths),
+            fmt, part, nparts, nthread, chunk_bytes, capacity,
+            csv_expect_cols,
+        )
+        if not self._handle:
+            raise DMLCError("ingest_open failed (bad arguments)")
+
+    def next_block(self) -> Optional[dict]:
+        rows = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        ncols = ctypes.c_int64()
+        flags = ctypes.c_int32()
+        rc = self._lib.ingest_peek(
+            self._handle,
+            ctypes.byref(rows), ctypes.byref(nnz), ctypes.byref(ncols),
+            ctypes.byref(flags),
+        )
+        if rc == 0:
+            return None
+        if rc < 0:
+            raise DMLCError(f"native ingest pipeline failed rc={rc}")
+        n, z = rows.value, nnz.value
+        fl = flags.value
+
+        if self._fmt == INGEST_CSV:
+            table = np.empty((n, ncols.value), dtype=np.float32)
+            rc = self._lib.ingest_fetch(
+                self._handle, None, None, None, None, None, _ptr(table), None
+            )
+            if rc != 1:
+                raise DMLCError("ingest_fetch with no staged block")
+            return {"table": table}
+
+        is_svm = self._fmt == INGEST_LIBSVM
+        out = {
+            "labels": np.empty(n, dtype=np.float32),
+            "offsets": np.empty(n + 1, dtype=np.int64),
+            "indices": np.empty(z, dtype=np.uint32),
+            "values": np.empty(z, dtype=np.float32),
+            "flags": fl,
+        }
+        weights = qids = fields = None
+        if is_svm:
+            if fl & HAS_WEIGHT:
+                weights = out["weights"] = np.empty(n, dtype=np.float32)
+            if fl & HAS_QID:
+                qids = out["qids"] = np.empty(n, dtype=np.int64)
+        else:
+            fields = out["fields"] = np.empty(z, dtype=np.uint32)
+        rc = self._lib.ingest_fetch(
+            self._handle,
+            _ptr(out["labels"]),
+            None if weights is None else _ptr(weights),
+            None if qids is None else _ptr(qids),
+            _ptr(out["offsets"]),
+            _ptr(out["indices"]),
+            _ptr(out["values"]),
+            None if fields is None else _ptr(fields),
+        )
+        if rc != 1:
+            raise DMLCError("ingest_fetch with no staged block")
+        return out
+
+    @property
+    def bytes_read(self) -> int:
+        return int(self._lib.ingest_bytes_read(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ingest_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def recordio_find_head(buf: bytes, start: int = 0) -> Optional[int]:

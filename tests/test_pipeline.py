@@ -1,0 +1,197 @@
+"""Native ingest pipeline tests (cpp/pipeline.cc).
+
+Covers the exactly-once partition contract (input_split_base.cc:30-64
+semantics), agreement with the Python parser stack, epoch restart, csv
+label/weight column splitting, and error propagation out of the worker
+threads — the TPU-build analog of split_read_test.cc +
+libsvm_parser_test.cc run as unit tests instead of manual CLI harnesses.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import native
+from dmlc_tpu.data import create_parser
+from dmlc_tpu.data.parsers import NativePipelineParser
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built"
+)
+
+
+def _collect(parser):
+    labels, indices, values = [], [], []
+    rows = 0
+    for block in parser:
+        rows += len(block)
+        labels.append(block.label)
+        indices.append(block.index)
+        values.append(
+            block.value
+            if block.value is not None
+            else np.ones(block.num_nonzero, dtype=np.float32)
+        )
+    return (
+        rows,
+        np.concatenate(labels) if labels else np.empty(0),
+        np.concatenate(indices) if indices else np.empty(0),
+        np.concatenate(values) if values else np.empty(0),
+    )
+
+
+@pytest.fixture
+def svm_file(tmp_path):
+    rng = np.random.RandomState(7)
+    path = tmp_path / "data.svm"
+    lines = []
+    for i in range(997):  # prime count, ragged widths
+        nfeat = 1 + (i * 7) % 5
+        feats = " ".join(
+            f"{j + 1}:{rng.rand():.4f}" for j in range(nfeat)
+        )
+        lines.append(f"{i % 2} {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_routes_to_native_pipeline(svm_file):
+    parser = create_parser(svm_file, 0, 1)
+    assert isinstance(parser, NativePipelineParser)
+    parser.close()
+
+
+def test_matches_python_stack(svm_file):
+    rows_n, lab_n, idx_n, val_n = _collect(create_parser(svm_file, 0, 1))
+    os.environ["DMLC_TPU_NATIVE"] = "0"
+    try:
+        py = create_parser(svm_file, 0, 1)
+        assert not isinstance(py, NativePipelineParser)
+        rows_p, lab_p, idx_p, val_p = _collect(py)
+    finally:
+        del os.environ["DMLC_TPU_NATIVE"]
+    assert rows_n == rows_p == 997
+    np.testing.assert_array_equal(lab_n, lab_p)
+    np.testing.assert_array_equal(idx_n, idx_p)
+    np.testing.assert_allclose(val_n, val_p, rtol=1e-6)
+
+
+@pytest.mark.parametrize("nparts", [1, 2, 3, 7, 64])
+def test_exactly_once_partitions(svm_file, nparts):
+    """Every record lands in exactly one part, for adversarial part counts."""
+    whole_rows, whole_lab, _, _ = _collect(create_parser(svm_file, 0, 1))
+    rows = 0
+    labs = []
+    for part in range(nparts):
+        r, lab, _, _ = _collect(create_parser(svm_file, part, nparts))
+        rows += r
+        labs.append(lab)
+    assert rows == whole_rows
+    np.testing.assert_array_equal(np.concatenate(labs), whole_lab)
+
+
+def test_partitions_with_tiny_chunks(svm_file):
+    """Chunk boundaries inside records: grow-and-cut logic (Chunk::Load)."""
+    parser = NativePipelineParser(
+        [svm_file], [os.path.getsize(svm_file)], "libsvm", 0, 1, nthread=2
+    )
+    pipe_args = parser._open_args
+    parser.close()
+    from dmlc_tpu.native import IngestPipeline
+
+    pipe = IngestPipeline(
+        pipe_args[0], pipe_args[1], native.INGEST_LIBSVM, 0, 1,
+        nthread=2, chunk_bytes=1 << 16,
+    )
+    rows = 0
+    while True:
+        blk = pipe.next_block()
+        if blk is None:
+            break
+        rows += len(blk["labels"])
+    pipe.close()
+    assert rows == 997
+
+
+def test_multi_file(tmp_path):
+    a = tmp_path / "a.svm"
+    b = tmp_path / "b.svm"
+    a.write_text("1 1:1.0\n0 2:2.0\n")
+    b.write_text("1 3:3.0\n")
+    uri = f"{a};{b}"
+    rows, lab, idx, val = _collect(create_parser(uri, 0, 1))
+    assert rows == 3
+    np.testing.assert_array_equal(lab, [1, 0, 1])
+    np.testing.assert_array_equal(idx, [1, 2, 3])
+
+
+def test_before_first_rereads(svm_file):
+    parser = create_parser(svm_file, 0, 1)
+    assert isinstance(parser, NativePipelineParser)
+    r1, lab1, _, _ = _collect(parser)
+    parser.before_first()
+    r2, lab2, _, _ = _collect(parser)
+    parser.close()
+    assert r1 == r2 == 997
+    np.testing.assert_array_equal(lab1, lab2)
+    assert parser.bytes_read > 0
+
+
+def test_weights_and_qid(tmp_path):
+    path = tmp_path / "w.svm"
+    path.write_text("1:0.5 qid:3 1:1.0 2:2.0\n0:2.0 qid:4 3:4.0\n")
+    block = create_parser(str(path), 0, 1).next_block()
+    np.testing.assert_array_equal(block.label, [1, 0])
+    np.testing.assert_allclose(block.weight, [0.5, 2.0])
+    np.testing.assert_array_equal(block.qid, [3, 4])
+
+
+def test_libfm(tmp_path):
+    path = tmp_path / "d.libfm"
+    path.write_text("1 0:1:0.5 2:7:1.5\n0 1:3:2.5\n")
+    parser = create_parser(str(path), 0, 1, data_format="libfm")
+    assert isinstance(parser, NativePipelineParser)
+    block = parser.next_block()
+    parser.close()
+    np.testing.assert_array_equal(block.label, [1, 0])
+    np.testing.assert_array_equal(block.field, [0, 2, 1])
+    np.testing.assert_array_equal(block.index, [1, 7, 3])
+    np.testing.assert_allclose(block.value, [0.5, 1.5, 2.5])
+
+
+def test_csv_label_column(tmp_path):
+    path = tmp_path / "d.csv"
+    path.write_text("1.0,2.0,3.0\n4.0,5.0,6.0\n")
+    parser = create_parser(
+        str(path) + "?format=csv&label_column=0", 0, 1
+    )
+    assert isinstance(parser, NativePipelineParser)
+    block = parser.next_block()
+    parser.close()
+    np.testing.assert_array_equal(block.label, [1.0, 4.0])
+    np.testing.assert_allclose(
+        block.to_dense(), [[2.0, 3.0], [5.0, 6.0]]
+    )
+
+
+def test_parse_error_propagates(tmp_path):
+    path = tmp_path / "bad.svm"
+    path.write_text("1 1:1.0\nnot-a-row at:all\n")
+    parser = create_parser(str(path), 0, 1)
+    assert isinstance(parser, NativePipelineParser)
+    from dmlc_tpu.utils.logging import DMLCError
+
+    with pytest.raises(DMLCError):
+        _collect(parser)
+    parser.close()
+
+
+def test_empty_parts_beyond_data(tmp_path):
+    path = tmp_path / "tiny.svm"
+    path.write_text("1 1:1.0\n")
+    total = 0
+    for part in range(8):
+        r, _, _, _ = _collect(create_parser(str(path), part, 8))
+        total += r
+    assert total == 1
