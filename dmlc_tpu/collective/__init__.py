@@ -36,6 +36,7 @@ from dmlc_tpu.collective.device import (
     psum,
     ppermute_next,
 )
+from dmlc_tpu.collective.checkpoint import CheckpointManager
 from dmlc_tpu.collective.socket_engine import SocketEngine
 from dmlc_tpu.io.serializer import load_obj, save_obj
 from dmlc_tpu.io.stream import MemoryStream
@@ -222,6 +223,7 @@ __all__ = [
     "all_gather",
     "ppermute_next",
     "make_allreduce_step",
+    "CheckpointManager",
     "DeviceEngine",
     "SocketEngine",
     "device_collectives",

@@ -1,0 +1,202 @@
+"""Rabit-style checkpoint / resume over the Stream-to-URI surface.
+
+The reference provides the *building blocks* for checkpointing —
+``Serializable`` Load/Save over any ``Stream::Create`` URI (io.h:112-126),
+STL serialization (serializer.h), ``Parameter::Save/Load`` — while the
+checkpoint *policy* (rabit's CheckPoint/LoadCheckPoint/version_number used
+for fault recovery with the tracker's ``recover`` re-entry,
+tracker.py:279-291) lives downstream. The TPU build owes that policy: this
+module implements it against any filesystem backend (file://, gs://, s3://,
+mem://), so a restarted worker re-joins with ``cmd='recover'`` and restores
+the last committed global state.
+
+Layout under the checkpoint URI directory::
+
+    ckpt_v{N}.bin          global state, written by rank 0 (or all ranks
+                           when ``per_rank=True``: ckpt_v{N}.rank{R}.bin)
+    LATEST                 text pointer "N" — committed last, so a torn
+                           write of the state file is never visible
+
+jax arrays in the state tree are converted to host numpy on save (the
+device-buffer (de)serialization path SURVEY §5.4 calls for).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from dmlc_tpu.io.filesystem import URI, create_stream, get_filesystem
+from dmlc_tpu.io.serializer import load_obj, save_obj
+from dmlc_tpu.utils.logging import DMLCError, check
+
+
+def _to_host(tree: Any) -> Any:
+    """Device arrays → numpy, recursively, without requiring jax."""
+    if isinstance(tree, dict):
+        return {k: _to_host(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        mapped = [_to_host(v) for v in tree]
+        if isinstance(tree, tuple):
+            # NamedTuples (e.g. optax optimizer states) take *fields
+            if hasattr(type(tree), "_fields"):
+                return type(tree)(*mapped)
+            return tuple(mapped)
+        return mapped
+    if hasattr(tree, "__array__") and not isinstance(tree, np.ndarray):
+        return np.asarray(tree)
+    return tree
+
+
+class CheckpointManager:
+    """CheckPoint / LoadCheckPoint / version_number (rabit API surface).
+
+    ``rank`` selects the writer: by default only rank 0 commits the global
+    state (every rank calls ``checkpoint`` — non-writers just bump their
+    version, mirroring rabit where the global model is logically one).
+    ``per_rank=True`` writes one state file per rank (rabit's local model)
+    and loads this rank's own file.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        rank: int = 0,
+        world_size: int = 1,
+        per_rank: bool = False,
+        keep: int = 2,
+    ):
+        check(keep >= 1, "keep must be >= 1")
+        self.uri = uri.rstrip("/")
+        self.rank = rank
+        self.world_size = world_size
+        self.per_rank = per_rank
+        self.keep = keep
+        parsed = URI.parse(self.uri)
+        if parsed.protocol in ("file://", ""):
+            import os
+
+            os.makedirs(parsed.name, exist_ok=True)
+        self._version = 0
+        latest = self._read_latest()
+        if latest is not None:
+            self._version = latest
+
+    # ---- rabit surface -------------------------------------------------
+    @property
+    def version_number(self) -> int:
+        """Number of committed checkpoints (rabit VersionNumber)."""
+        return self._version
+
+    def checkpoint(self, state: Any) -> int:
+        """Commit ``state`` as version ``version_number + 1``; returns it."""
+        version = self._version + 1
+        if self.per_rank or self.rank == 0:
+            stream = create_stream(self._state_uri(version, self.rank), "w")
+            try:
+                save_obj(stream, _to_host(state))
+            finally:
+                stream.close()
+        if self.rank == 0:
+            self._write_latest(version)
+        self._version = version
+        if self.rank == 0:
+            self._prune(version)
+        return version
+
+    def load_checkpoint(self) -> Tuple[int, Optional[Any]]:
+        """(version, state) of the newest committed checkpoint, or (0, None).
+
+        After a worker restart this re-reads LATEST, so a manager built
+        fresh in the recovered process resumes from the last commit (the
+        tracker keeps the rank stable across ``recover``,
+        tracker.py:279-291). In ``per_rank`` mode the commit point (rank
+        0's LATEST) cannot guarantee every rank's file landed, so a missing
+        state file falls back version by version through the retained
+        window before failing.
+        """
+        latest = self._read_latest()
+        if not latest:
+            return 0, None
+        rank = self.rank if self.per_rank else 0
+        floor = max(1, latest - self.keep + 1) if self.per_rank else latest
+        for version in range(latest, floor - 1, -1):
+            stream = create_stream(
+                self._state_uri(version, rank), "r", allow_null=True
+            )
+            if stream is None:
+                continue
+            try:
+                state = load_obj(stream)
+            finally:
+                stream.close()
+            self._version = version
+            return version, state
+        raise DMLCError(
+            f"checkpoint LATEST points at v{latest} but no readable state "
+            f"file exists in {self.uri} (rank {rank})"
+        )
+
+    # ---- internals -----------------------------------------------------
+    def _state_uri(self, version: int, rank: int) -> str:
+        if self.per_rank:
+            return f"{self.uri}/ckpt_v{version}.rank{rank}.bin"
+        return f"{self.uri}/ckpt_v{version}.bin"
+
+    def _write_latest(self, version: int) -> None:
+        """Commit the LATEST pointer atomically.
+
+        Local files go through write-temp-then-rename (a crash mid-write
+        must never leave a truncated LATEST); object stores materialize the
+        object only when the upload completes, which is already atomic
+        (mem:// is a single-process test backend where this cannot race).
+        """
+        uri = f"{self.uri}/LATEST"
+        parsed = URI.parse(uri)
+        payload = str(version).encode()
+        if parsed.protocol in ("file://", ""):
+            import os
+
+            tmp = parsed.name + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, parsed.name)
+            return
+        stream = create_stream(uri, "w")
+        try:
+            stream.write(payload)
+        finally:
+            stream.close()
+
+    def _read_latest(self) -> Optional[int]:
+        stream = create_stream(f"{self.uri}/LATEST", "r", allow_null=True)
+        if stream is None:
+            return None
+        try:
+            parts = []
+            while True:
+                piece = stream.read(4096)
+                if not piece:
+                    break
+                parts.append(piece)
+            text = b"".join(parts).decode().strip()
+        finally:
+            stream.close()
+        return int(text) if text else None
+
+    def _prune(self, newest: int) -> None:
+        """Best-effort removal of checkpoints older than the ``keep`` window."""
+        fs = get_filesystem(URI.parse(self.uri))
+        delete = getattr(fs, "delete", None)
+        if delete is None:
+            return
+        ranks = range(self.world_size) if self.per_rank else (0,)
+        for version in range(max(1, newest - self.keep * 4), newest - self.keep + 1):
+            for rank in ranks:
+                try:
+                    delete(URI.parse(self._state_uri(version, rank)))
+                except Exception:
+                    pass

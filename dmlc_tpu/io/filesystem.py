@@ -100,6 +100,14 @@ class FileSystem:
                     out.append(info)
         return out
 
+    def delete(self, path: URI) -> None:
+        """Remove one object/file (not part of the reference surface — its
+        cache/checkpoint files were cleaned out-of-band; the checkpoint
+        manager needs pruning in-band)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support delete"
+        )
+
     def exists(self, path: URI) -> bool:
         try:
             self.get_path_info(path)
@@ -142,6 +150,9 @@ class LocalFileSystem(FileSystem):
             if allow_null:
                 return None
             raise
+
+    def delete(self, path: URI) -> None:
+        os.remove(path.name)
 
 
 # ---------------------------------------------------------------------------
@@ -239,6 +250,10 @@ class MemoryFileSystem(FileSystem):
             assert stream is not None
             return stream
         return self._MemWriteStream(self._files, self._lock, key, append=(flag == "a"))
+
+    def delete(self, path: URI) -> None:
+        with self._lock:
+            self._files.pop(self._key(path), None)
 
     def open_for_read(self, path: URI, allow_null: bool = False) -> Optional[SeekStream]:
         key = self._key(path)

@@ -464,6 +464,15 @@ class S3FileSystem(_ObjectStoreBase):
     def _open_write(self, path: URI) -> Stream:
         return self._S3WriteStream(self, path)
 
+    def delete(self, path: URI) -> None:
+        bucket, key = self._bucket_key(path)
+
+        def call():
+            with self._request("DELETE", self._url(bucket, key)):
+                pass
+
+        _retry_call(call, "DeleteObject")
+
 
 # ---------------------------------------------------------------------------
 # GCS
@@ -520,6 +529,20 @@ class GCSFileSystem(_ObjectStoreBase):
             if err.code in (404, 403):
                 return None
             raise
+
+    def delete(self, path: URI) -> None:
+        bucket, key = self._bucket_key(path)
+
+        def call():
+            req = urllib.request.Request(
+                self._media_url(bucket, key),
+                headers=self._headers(),
+                method="DELETE",
+            )
+            with _http(req):
+                pass
+
+        _retry_call(call, "gcs DeleteObject")
 
     def _list(self, bucket: str, prefix: str, delimiter: str):
         files: List[Tuple[str, int]] = []

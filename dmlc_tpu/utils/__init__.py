@@ -25,6 +25,12 @@ from dmlc_tpu.utils.logging import (
 )
 from dmlc_tpu.utils.timer import get_time, Timer
 from dmlc_tpu.utils.common import split_string, hash_combine
+from dmlc_tpu.utils.thread_group import (
+    BlockingQueueThread,
+    ManualEvent,
+    ThreadGroup,
+    TimerThread,
+)
 
 __all__ = [
     "DMLCError",
@@ -47,4 +53,8 @@ __all__ = [
     "Timer",
     "split_string",
     "hash_combine",
+    "BlockingQueueThread",
+    "ManualEvent",
+    "ThreadGroup",
+    "TimerThread",
 ]

@@ -1,0 +1,139 @@
+"""CheckpointManager tests: the rabit CheckPoint/LoadCheckPoint/version
+policy over the Stream-to-URI surface (SURVEY §5.4), including the
+restart-and-recover path the tracker's cmd='recover' enables."""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.collective import CheckpointManager
+from dmlc_tpu.io.filesystem import MemoryFileSystem
+from dmlc_tpu.utils.logging import DMLCError
+
+
+@pytest.fixture(autouse=True)
+def _clean_memfs():
+    MemoryFileSystem.reset()
+    yield
+    MemoryFileSystem.reset()
+
+
+def test_roundtrip_and_versions(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.version_number == 0
+    assert mgr.load_checkpoint() == (0, None)
+    state = {"w": np.arange(4, dtype=np.float32), "step": 7}
+    assert mgr.checkpoint(state) == 1
+    assert mgr.checkpoint({"w": state["w"] * 2, "step": 8}) == 2
+    version, loaded = mgr.load_checkpoint()
+    assert version == 2
+    np.testing.assert_array_equal(loaded["w"], state["w"] * 2)
+    assert loaded["step"] == 8
+
+
+def test_restart_recovers_latest(tmp_path):
+    uri = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(uri)
+    mgr.checkpoint({"step": 1})
+    mgr.checkpoint({"step": 2})
+    # a fresh manager (restarted worker) resumes from the last commit
+    recovered = CheckpointManager(uri)
+    assert recovered.version_number == 2
+    version, state = recovered.load_checkpoint()
+    assert (version, state["step"]) == (2, 2)
+    assert recovered.checkpoint({"step": 3}) == 3
+
+
+def test_memfs_backend():
+    mgr = CheckpointManager("mem://ckpt/run1")
+    mgr.checkpoint([1, 2, 3])
+    version, state = CheckpointManager("mem://ckpt/run1").load_checkpoint()
+    assert (version, state) == (1, [1, 2, 3])
+
+
+def test_non_writer_ranks_do_not_write(tmp_path):
+    uri = str(tmp_path / "ckpt")
+    w0 = CheckpointManager(uri, rank=0, world_size=2)
+    w1 = CheckpointManager(uri, rank=1, world_size=2)
+    assert w1.checkpoint({"step": 1}) == 1
+    # rank 1 bumped its local version but committed nothing
+    assert CheckpointManager(uri).version_number == 0
+    assert w0.checkpoint({"step": 1}) == 1
+    assert CheckpointManager(uri).version_number == 1
+
+
+def test_per_rank_local_state(tmp_path):
+    uri = str(tmp_path / "ckpt")
+    w0 = CheckpointManager(uri, rank=0, world_size=2, per_rank=True)
+    w1 = CheckpointManager(uri, rank=1, world_size=2, per_rank=True)
+    w1.checkpoint({"rank": 1})
+    w0.checkpoint({"rank": 0})
+    assert CheckpointManager(uri, rank=1, per_rank=True).load_checkpoint()[1] == {
+        "rank": 1
+    }
+    assert CheckpointManager(uri, rank=0, per_rank=True).load_checkpoint()[1] == {
+        "rank": 0
+    }
+
+
+def test_prune_keeps_window(tmp_path):
+    uri = tmp_path / "ckpt"
+    mgr = CheckpointManager(str(uri), keep=2)
+    for step in range(6):
+        mgr.checkpoint({"step": step})
+    names = sorted(p.name for p in uri.iterdir())
+    assert "LATEST" in names
+    ckpts = [n for n in names if n.startswith("ckpt_v")]
+    assert ckpts == ["ckpt_v5.bin", "ckpt_v6.bin"]
+    assert mgr.load_checkpoint()[1]["step"] == 5
+
+
+def test_missing_state_file_raises(tmp_path):
+    uri = tmp_path / "ckpt"
+    mgr = CheckpointManager(str(uri))
+    mgr.checkpoint({"step": 1})
+    (uri / "ckpt_v1.bin").unlink()
+    with pytest.raises(DMLCError):
+        CheckpointManager(str(uri)).load_checkpoint()
+
+
+def test_per_rank_missing_file_falls_back(tmp_path):
+    """Rank 0 committed LATEST=2 but this rank's v2 file never landed:
+    recovery falls back to v1 instead of failing."""
+    uri = str(tmp_path / "ckpt")
+    w0 = CheckpointManager(uri, rank=0, world_size=2, per_rank=True, keep=3)
+    w1 = CheckpointManager(uri, rank=1, world_size=2, per_rank=True, keep=3)
+    w1.checkpoint({"step": 1})
+    w0.checkpoint({"step": 1})
+    w0.checkpoint({"step": 2})  # rank 1 crashed before its v2 write
+    recovered = CheckpointManager(uri, rank=1, world_size=2, per_rank=True, keep=3)
+    version, state = recovered.load_checkpoint()
+    assert (version, state["step"]) == (1, 1)
+
+
+def test_namedtuple_state_roundtrips(tmp_path):
+    import collections
+
+    Opt = collections.namedtuple("Opt", ["mu", "nu"])
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.checkpoint({"opt": Opt(mu=np.ones(2), nu=np.zeros(2))})
+    _, state = mgr.load_checkpoint()
+    mu, nu = state["opt"]
+    np.testing.assert_array_equal(mu, np.ones(2))
+    np.testing.assert_array_equal(nu, np.zeros(2))
+
+
+def test_empty_latest_treated_as_no_checkpoint(tmp_path):
+    uri = tmp_path / "ckpt"
+    uri.mkdir()
+    (uri / "LATEST").write_bytes(b"")  # torn write remnant
+    assert CheckpointManager(str(uri)).load_checkpoint() == (0, None)
+
+
+def test_jax_arrays_become_numpy(tmp_path):
+    jax = pytest.importorskip("jax")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.checkpoint({"w": jax.numpy.ones((3,)), "nested": [jax.numpy.zeros(2)]})
+    _, state = mgr.load_checkpoint()
+    assert isinstance(state["w"], np.ndarray)
+    np.testing.assert_array_equal(state["w"], np.ones(3))
+    assert isinstance(state["nested"][0], np.ndarray)

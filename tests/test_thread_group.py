@@ -1,0 +1,108 @@
+"""ThreadGroup / ManualEvent / queue+timer thread tests (the reference's
+unittest_thread_group.cc coverage: named lifecycle, start gates via
+ManualEvent, queue pumping, periodic timers)."""
+
+import threading
+import time
+
+import pytest
+
+from dmlc_tpu.utils import (
+    BlockingQueueThread,
+    DMLCError,
+    ManualEvent,
+    ThreadGroup,
+    TimerThread,
+)
+
+
+class TestManualEvent:
+    def test_set_wakes_all_and_stays_signaled(self):
+        ev = ManualEvent()
+        results = []
+
+        def waiter():
+            ev.wait()
+            results.append(1)
+
+        threads = [threading.Thread(target=waiter) for _ in range(4)]
+        for t in threads:
+            t.start()
+        ev.set()
+        for t in threads:
+            t.join(5)
+        assert results == [1, 1, 1, 1]
+        assert ev.wait(0)  # still signaled
+        ev.reset()
+        assert not ev.wait(0)
+
+
+class TestThreadGroup:
+    def test_named_lifecycle_auto_remove(self):
+        group = ThreadGroup()
+        done = ManualEvent()
+        t = group.create("worker", lambda th: done.wait())
+        assert group.size() == 1
+        assert group.get("worker") is t
+        done.set()
+        assert t.join(5)
+        deadline = time.monotonic() + 5
+        while group.size() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert group.size() == 0  # auto-removed
+
+    def test_duplicate_name_rejected(self):
+        group = ThreadGroup()
+        gate = ManualEvent()
+        group.create("x", lambda th: gate.wait())
+        with pytest.raises(DMLCError):
+            group.create("x", lambda th: None)
+        gate.set()
+        assert group.join_all(5)
+
+    def test_join_all_requests_shutdown(self):
+        group = ThreadGroup()
+        observed = []
+
+        def loop(th):
+            while not th.wait_for_shutdown(0.01):
+                pass
+            observed.append(th.name)
+
+        for i in range(3):
+            group.create(f"w{i}", loop)
+        assert group.join_all(5)
+        assert sorted(observed) == ["w0", "w1", "w2"]
+
+
+class TestBlockingQueueThread:
+    def test_pumps_in_order_then_drains_on_shutdown(self):
+        got = []
+        pump = BlockingQueueThread("pump", got.append)
+        for i in range(100):
+            pump.enqueue(i)
+        assert pump.shutdown(5)
+        assert got == list(range(100))
+
+
+    def test_group_shutdown_terminates_pump(self):
+        group = ThreadGroup()
+        pump = BlockingQueueThread("pump", lambda item: None, group=group)
+        assert group.join_all(5)  # must not hang without a sentinel
+        assert not pump._thread.is_alive()
+
+
+class TestTimerThread:
+    def test_fires_periodically_until_stopped(self):
+        hits = []
+        timer = TimerThread("tick", 0.01, lambda: hits.append(1))
+        time.sleep(0.2)
+        assert timer.stop(5)
+        count = len(hits)
+        assert count >= 3
+        time.sleep(0.05)
+        assert len(hits) == count  # no post-stop firings
+
+    def test_bad_interval(self):
+        with pytest.raises(DMLCError):
+            TimerThread("bad", 0.0, lambda: None)
