@@ -12,7 +12,6 @@
 
 #include <cstdint>
 #include <cstring>
-#include <cmath>
 
 namespace {
 
@@ -25,27 +24,121 @@ inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
 
 inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
 
+// Exact powers of ten: 10^k is representable exactly in a double for
+// k <= 22, so mantissa*10^k / mantissa/10^k round once — the classic fast
+// strtod fast path.
+const double kPow10[23] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10, 1e11,
+    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+inline double ApplyExp10(double val, int64_t exp10) {
+  if (exp10 == 0) return val;
+  // |exp10| beyond ±350 already saturates to ±inf / ±0 for any mantissa the
+  // scan can produce (<= 1e19); clamping bounds the loop for adversarial
+  // exponents like 1e-999999999. The clamp happens HERE, after the explicit
+  // exponent has been folded in, so compensating pairs (long zero run +
+  // large positive exponent) stay exact.
+  if (exp10 > 350) exp10 = 350;
+  else if (exp10 < -350) exp10 = -350;
+  if (exp10 > 0) {
+    while (exp10 > 22) { val *= 1e22; exp10 -= 22; }
+    return val * kPow10[exp10];
+  }
+  exp10 = -exp10;
+  while (exp10 > 22) { val /= 1e22; exp10 -= 22; }
+  return val / kPow10[exp10];
+}
+
+// SWAR helpers for the fraction hot path: classify 8 bytes at once and
+// convert a full 8-digit group with a multiply tree instead of a serial
+// per-digit loop. `y` is the chunk XOR 0x30..30, so digit bytes are 0..9.
+// Returns the count of leading (lowest-address-first) digit bytes and masks
+// *digits down to them. Carry-free: the add is done on 7-bit bytes.
+inline int CountDigits8(uint64_t y, uint64_t* digits) {
+  uint64_t y7 = y & 0x7F7F7F7F7F7F7F7FULL;
+  uint64_t nondigit =
+      (((y7 + 0x7676767676767676ULL) | y) & 0x8080808080808080ULL);
+  if (nondigit == 0) {
+    *digits = y;
+    return 8;
+  }
+  int k = __builtin_ctzll(nondigit) >> 3;
+  *digits = y & ((1ULL << (k * 8)) - 1);
+  return k;
+}
+
+// 8 ascii-stripped digit bytes (lowest address = most significant digit,
+// little-endian load) -> the 8-digit number. Three multiplies total.
+inline uint32_t Swar8Digits(uint64_t y) {
+  const uint64_t mask = 0x000000FF000000FFULL;
+  const uint64_t mul1 = 0x000F424000000064ULL;  // 100 + (1000000 << 32)
+  const uint64_t mul2 = 0x0000271000000001ULL;  // 1 + (10000 << 32)
+  y = (y * 10) + (y >> 8);
+  return static_cast<uint32_t>(
+      (((y & mask) * mul1) + (((y >> 16) & mask) * mul2)) >> 32);
+}
+
 // Fast float scan: sign, integer part, fraction, optional exponent.
 // Handles the common data-file cases inline; no INF/NAN/hex (same contract
 // as the reference's strtonum.h:37, by design: data files don't contain
-// them, and rejecting keeps the loop branch-light).
+// them, and rejecting keeps the loop branch-light). Digits accumulate into
+// an integer mantissa (pipelinable integer ops, no serial FP chain); the
+// decimal exponent is applied once at the end via exact powers of ten.
 inline const char* scan_double(const char* p, const char* end, double* out) {
   if (p == end) return nullptr;
   bool neg = false;
   if (*p == '-') { neg = true; ++p; }
   else if (*p == '+') { ++p; }
   if (p == end || (!is_digit(*p) && *p != '.')) return nullptr;
-  double val = 0.0;
+  uint64_t mant = 0;
+  int ndig = 0;   // significant digits folded into mant (19 max: fits uint64)
+  // int64: bounded by the input length, so digit/zero runs can't overflow
+  // it; saturation is applied once in ApplyExp10 after the explicit
+  // exponent is added (a mid-scan cap would corrupt compensating pairs
+  // like "0.<420 zeros>5e450").
+  int64_t exp10 = 0;
+  // ndig += (mant != 0) keeps leading zeros mantissa-budget-free without a
+  // branch in the hot loop (folding a 0 into mant==0 is a numeric no-op).
   while (p != end && is_digit(*p)) {
-    val = val * 10.0 + (*p - '0');
+    if (ndig < 19) {
+      mant = mant * 10 + static_cast<uint64_t>(*p - '0');
+      ndig += static_cast<int>(mant != 0);
+    } else {
+      ++exp10;
+    }
     ++p;
   }
   if (p != end && *p == '.') {
     ++p;
-    double scale = 0.1;
+    // 8-wide groups while the mantissa has room (mant*1e8 + 8 digits must
+    // fit uint64: safe while ndig <= 11). A short group (k < 8) appends
+    // 8-k virtual zero digits — value-preserving for a fraction tail, and
+    // the byte at p+k is a real non-digit so the scalar loop below exits
+    // immediately. An all-zero group before any significant digit shifts
+    // the decimal point but costs no mantissa budget, so long zero runs
+    // ("0.<420 zeros>5") skip 8 bytes at a time with their significant
+    // digits preserved.
+    while (end - p >= 8 && ndig <= 11) {
+      uint64_t chunk;
+      std::memcpy(&chunk, p, 8);
+      uint64_t digs;
+      int k = CountDigits8(chunk ^ 0x3030303030303030ULL, &digs);
+      if (k == 0) break;
+      // branchless: folding an all-zero group into a zero mantissa is a
+      // numeric no-op, and ndig charges 8 only once a significant digit
+      // has appeared
+      mant = mant * 100000000ULL + Swar8Digits(digs);
+      ndig += static_cast<int>(mant != 0) << 3;
+      exp10 -= 8;
+      p += k;
+      if (k < 8) break;
+    }
     while (p != end && is_digit(*p)) {
-      val += (*p - '0') * scale;
-      scale *= 0.1;
+      if (ndig < 19) {
+        mant = mant * 10 + static_cast<uint64_t>(*p - '0');
+        ndig += static_cast<int>(mant != 0);
+        --exp10;
+      }
       ++p;
     }
   }
@@ -54,10 +147,15 @@ inline const char* scan_double(const char* p, const char* end, double* out) {
     bool eneg = false;
     if (p != end && (*p == '-' || *p == '+')) { eneg = (*p == '-'); ++p; }
     int ex = 0;
-    while (p != end && is_digit(*p)) { ex = ex * 10 + (*p - '0'); ++p; }
-    val *= std::pow(10.0, eneg ? -ex : ex);
+    while (p != end && is_digit(*p)) {
+      if (ex < 100000000) ex = ex * 10 + (*p - '0');
+      ++p;
+    }
+    exp10 += eneg ? -ex : ex;
   }
-  *out = neg ? -val : val;
+  *out = ApplyExp10(neg ? -static_cast<double>(mant)
+                        : static_cast<double>(mant),
+                    exp10);
   return p;
 }
 
@@ -129,7 +227,7 @@ int parse_libsvm(const char* data, int64_t len,
         if (p != end) ++p;
         break;
       }
-      if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
+      if (*p == 'q' && end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
         uint64_t qv;
         q = scan_u64(p + 4, end, &qv);
         if (q == nullptr) return DMLC_TPU_EPARSE;

@@ -62,8 +62,39 @@ enum {
 
 inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
 
+// Growable byte buffer without value-initialization: std::string/vector
+// resize() zero-fills bytes that fread is about to overwrite — a full extra
+// memory pass at ingest rates. Reserve leaves new capacity uninitialized.
+struct Buf {
+  char* p = nullptr;
+  int64_t cap = 0;
+  int64_t size = 0;
+
+  ~Buf() { std::free(p); }
+  Buf() = default;
+  Buf(const Buf&) = delete;
+  Buf& operator=(const Buf&) = delete;
+
+  // false on allocation failure
+  bool Reserve(int64_t n) {
+    if (n <= cap) return true;
+    int64_t want = std::max<int64_t>(n, cap * 2);
+    char* np = static_cast<char*>(std::realloc(p, static_cast<size_t>(want)));
+    if (np == nullptr) return false;
+    p = np;
+    cap = want;
+    return true;
+  }
+
+  void Swap(Buf& other) {
+    std::swap(p, other.p);
+    std::swap(cap, other.cap);
+    std::swap(size, other.size);
+  }
+};
+
 struct Chunk {
-  std::string data;
+  Buf data;
   int64_t seq = 0;
 };
 
@@ -280,6 +311,15 @@ class Pipeline {
     return 1;
   }
 
+  // Consume the staged block, transferring ownership to the caller
+  // (zero-copy handoff; the caller frees it via ingest_block_free).
+  Block* FetchOwn() {
+    std::lock_guard<std::mutex> lk(mu_);
+    Block* b = current_;
+    current_ = nullptr;
+    return b;
+  }
+
   int64_t BytesRead() const { return bytes_read_.load(); }
 
   void Close() {
@@ -352,31 +392,34 @@ class Pipeline {
       return;
     }
     int64_t seq = 0;
-    std::string tail;
-    while (rd.pos() < end || !tail.empty()) {
+    Buf tail;
+    while (rd.pos() < end || tail.size > 0) {
       Chunk* chunk = AcquireChunk();
       if (chunk == nullptr) {  // stopped
         FinishReader(seq);
         return;
       }
-      chunk->data.swap(tail);
-      tail.clear();
+      chunk->data.Swap(tail);
+      tail.size = 0;
       int64_t target = chunk_bytes_;
       bool final_chunk = false;
       for (;;) {
-        int64_t want =
-            std::min<int64_t>(target - static_cast<int64_t>(chunk->data.size()),
-                              end - rd.pos());
+        int64_t want = std::min<int64_t>(target - chunk->data.size,
+                                         end - rd.pos());
         if (want > 0) {
-          size_t base = chunk->data.size();
-          chunk->data.resize(base + static_cast<size_t>(want));
-          int64_t got = rd.Read(&chunk->data[base], want);
+          int64_t base = chunk->data.size;
+          if (!chunk->data.Reserve(base + want)) {
+            delete chunk;
+            Fail(kEOom);
+            return;
+          }
+          int64_t got = rd.Read(chunk->data.p + base, want);
           if (got < 0) {
             delete chunk;
             Fail(kEIo);
             return;
           }
-          chunk->data.resize(base + static_cast<size_t>(got));
+          chunk->data.size = base + got;
           if (got < want) {
             // file list exhausted early (sizes changed): treat as final
             final_chunk = true;
@@ -390,16 +433,25 @@ class Pipeline {
         // cut at the last record begin inside the buffer
         int64_t cut = LastRecordBegin(chunk->data);
         if (cut > 0) {
-          tail.assign(chunk->data, static_cast<size_t>(cut),
-                      chunk->data.size() - static_cast<size_t>(cut));
-          chunk->data.resize(static_cast<size_t>(cut));
+          int64_t rest = chunk->data.size - cut;
+          if (rest > 0) {
+            if (!tail.Reserve(rest)) {
+              delete chunk;
+              Fail(kEOom);
+              return;
+            }
+            std::memcpy(tail.p, chunk->data.p + cut,
+                        static_cast<size_t>(rest));
+          }
+          tail.size = rest;
+          chunk->data.size = cut;
           break;
         }
         // no boundary inside: grow and keep reading (Chunk::Load doubling,
         // input_split_base.cc:241-258)
         target *= 2;
       }
-      if (chunk->data.empty()) {
+      if (chunk->data.size == 0) {
         ReleaseChunk(chunk);
         if (final_chunk) break;
         continue;
@@ -416,9 +468,9 @@ class Pipeline {
 
   // offset just past the last EOL char at index >= 1, or 0 when none
   // (line_split.cc FindLastRecordBegin semantics).
-  static int64_t LastRecordBegin(const std::string& buf) {
-    for (int64_t i = static_cast<int64_t>(buf.size()) - 1; i >= 1; --i) {
-      if (is_eol(buf[static_cast<size_t>(i)])) return i + 1;
+  static int64_t LastRecordBegin(const Buf& buf) {
+    for (int64_t i = buf.size - 1; i >= 1; --i) {
+      if (is_eol(buf.p[i])) return i + 1;
     }
     return 0;
   }
@@ -432,7 +484,7 @@ class Pipeline {
     if (!free_chunks_.empty()) {
       Chunk* c = free_chunks_.back();
       free_chunks_.pop_back();
-      c->data.clear();
+      c->data.size = 0;
       return c;
     }
     return new Chunk();
@@ -499,7 +551,7 @@ class Pipeline {
       } catch (const std::bad_alloc&) {
         rc = kEOom;
       }
-      bytes_read_.fetch_add(static_cast<int64_t>(chunk->data.size()));
+      bytes_read_.fetch_add(chunk->data.size);
       ReleaseChunk(chunk);
       if (rc != kOk) {
         delete block;
@@ -524,9 +576,9 @@ class Pipeline {
     }
   }
 
-  int ParseChunk(const std::string& data, Block* b) {
-    const char* p = data.data();
-    int64_t len = static_cast<int64_t>(data.size());
+  int ParseChunk(const Buf& data, Block* b) {
+    const char* p = data.p;
+    int64_t len = data.size;
     if (format_ == kCsv) return ParseCsvChunk(p, len, b);
     int64_t bound = len / 2 + 2;  // rows and nnz are both >= 2 bytes each
     b->labels = AllocArray<float>(bound);
@@ -666,6 +718,31 @@ int ingest_fetch(void* handle, float* labels, float* weights, int64_t* qids,
   return static_cast<Pipeline*>(handle)->Fetch(labels, weights, qids, offsets,
                                                indices, values, fields);
 }
+
+// Zero-copy variant of ingest_fetch: transfers ownership of the staged
+// block. Fills the output array pointers (indices/fields point at
+// u32-packed data; pointers not populated by the format are NULL, but for
+// libsvm the weights/qids arrays are always allocated with their defaults —
+// presence of *explicit* weights/qids is signaled by the flags from
+// ingest_peek, not by pointer nullness) and returns an opaque block handle
+// the caller must release with ingest_block_free once the arrays are no
+// longer referenced. Returns NULL when no block is staged.
+void* ingest_fetch_view(void* handle, float** labels, float** weights,
+                        int64_t** qids, int64_t** offsets, uint32_t** indices,
+                        float** values, uint32_t** fields) {
+  Block* b = static_cast<Pipeline*>(handle)->FetchOwn();
+  if (b == nullptr) return nullptr;
+  *labels = b->labels;
+  *weights = b->weights;
+  *qids = b->qids;
+  *offsets = b->offsets;
+  *indices = reinterpret_cast<uint32_t*>(b->indices);
+  *values = b->values;
+  *fields = reinterpret_cast<uint32_t*>(b->fields);
+  return b;
+}
+
+void ingest_block_free(void* block) { delete static_cast<Block*>(block); }
 
 int64_t ingest_bytes_read(void* handle) {
   return static_cast<Pipeline*>(handle)->BytesRead();

@@ -101,6 +101,12 @@ def _bind(lib) -> None:
     ]
     lib.ingest_fetch.restype = ctypes.c_int
     lib.ingest_fetch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 7
+    lib.ingest_fetch_view.restype = ctypes.c_void_p
+    lib.ingest_fetch_view.argtypes = [ctypes.c_void_p] + [
+        ctypes.POINTER(ctypes.c_void_p)
+    ] * 7
+    lib.ingest_block_free.restype = None
+    lib.ingest_block_free.argtypes = [ctypes.c_void_p]
     lib.ingest_bytes_read.restype = i64
     lib.ingest_bytes_read.argtypes = [ctypes.c_void_p]
     lib.ingest_close.restype = None
@@ -376,12 +382,46 @@ INGEST_LIBFM = 1
 INGEST_CSV = 2
 
 
+class _NativeBlock:
+    """Owner of a native block handed off by ingest_fetch_view.
+
+    Every numpy view created over the block's arrays keeps a reference to
+    this owner (via the ctypes buffer object in its base chain), so the
+    native buffers are freed exactly when the last view is collected.
+    """
+
+    __slots__ = ("_lib", "_ptr")
+
+    def __init__(self, lib, ptr):
+        self._lib = lib
+        self._ptr = ptr
+
+    def __del__(self):
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            try:
+                self._lib.ingest_block_free(ptr)
+            except Exception:
+                pass
+
+
+def _block_view(owner, addr, n, ctype, dtype):
+    """Zero-copy numpy view over `n` elements of native memory at `addr`."""
+    if n == 0 or not addr:
+        return np.empty(0, dtype=dtype)
+    cbuf = (ctype * n).from_address(addr)
+    cbuf._dmlc_block = owner  # lifetime: array.base -> cbuf -> owner
+    return np.frombuffer(cbuf, dtype=dtype)
+
+
 class IngestPipeline:
-    """Handle over the native pipeline; yields dicts of *copied* arrays.
+    """Handle over the native pipeline; yields dicts of zero-copy arrays.
 
     ``next_block()`` returns None at end of stream; raises DMLCError on a
     parse/IO error inside the pipeline (the cross-thread exception
-    propagation contract of threadediter.h:456-466).
+    propagation contract of threadediter.h:456-466). The returned arrays
+    view native memory owned by a ``_NativeBlock`` in their base chain — no
+    copy on the handoff; the block is freed when the last view dies.
     """
 
     def __init__(
@@ -431,43 +471,47 @@ class IngestPipeline:
         n, z = rows.value, nnz.value
         fl = flags.value
 
+        ptrs = [ctypes.c_void_p() for _ in range(7)]
+        block = self._lib.ingest_fetch_view(
+            self._handle, *[ctypes.byref(q) for q in ptrs]
+        )
+        if not block:
+            raise DMLCError("ingest_fetch_view with no staged block")
+        owner = _NativeBlock(self._lib, block)
+        (labels_p, weights_p, qids_p, offsets_p, indices_p, values_p,
+         fields_p) = (q.value for q in ptrs)
+
         if self._fmt == INGEST_CSV:
-            table = np.empty((n, ncols.value), dtype=np.float32)
-            rc = self._lib.ingest_fetch(
-                self._handle, None, None, None, None, None, _ptr(table), None
-            )
-            if rc != 1:
-                raise DMLCError("ingest_fetch with no staged block")
+            table = _block_view(
+                owner, values_p, n * ncols.value, ctypes.c_float, np.float32
+            ).reshape(n, ncols.value)
             return {"table": table}
 
         is_svm = self._fmt == INGEST_LIBSVM
         out = {
-            "labels": np.empty(n, dtype=np.float32),
-            "offsets": np.empty(n + 1, dtype=np.int64),
-            "indices": np.empty(z, dtype=np.uint32),
-            "values": np.empty(z, dtype=np.float32),
+            "labels": _block_view(owner, labels_p, n, ctypes.c_float,
+                                  np.float32),
+            "offsets": _block_view(owner, offsets_p, n + 1, ctypes.c_int64,
+                                   np.int64),
+            "indices": _block_view(owner, indices_p, z, ctypes.c_uint32,
+                                   np.uint32),
+            "values": _block_view(owner, values_p, z, ctypes.c_float,
+                                  np.float32),
             "flags": fl,
         }
-        weights = qids = fields = None
         if is_svm:
             if fl & HAS_WEIGHT:
-                weights = out["weights"] = np.empty(n, dtype=np.float32)
+                out["weights"] = _block_view(
+                    owner, weights_p, n, ctypes.c_float, np.float32
+                )
             if fl & HAS_QID:
-                qids = out["qids"] = np.empty(n, dtype=np.int64)
+                out["qids"] = _block_view(
+                    owner, qids_p, n, ctypes.c_int64, np.int64
+                )
         else:
-            fields = out["fields"] = np.empty(z, dtype=np.uint32)
-        rc = self._lib.ingest_fetch(
-            self._handle,
-            _ptr(out["labels"]),
-            None if weights is None else _ptr(weights),
-            None if qids is None else _ptr(qids),
-            _ptr(out["offsets"]),
-            _ptr(out["indices"]),
-            _ptr(out["values"]),
-            None if fields is None else _ptr(fields),
-        )
-        if rc != 1:
-            raise DMLCError("ingest_fetch with no staged block")
+            out["fields"] = _block_view(
+                owner, fields_p, z, ctypes.c_uint32, np.uint32
+            )
         return out
 
     @property

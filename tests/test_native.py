@@ -97,6 +97,64 @@ class TestLibSVMParity:
         _assert_blocks_equal(a, b)
 
 
+class TestAdversarialNumerics:
+    def test_huge_exponents_fast_and_saturating(self):
+        """Exponents like 1e-999999999 must saturate (±0/±inf) in bounded
+        time — the clamp in ApplyExp10 (cpp/parse.cc), not an O(|exp|)
+        loop."""
+        import time
+
+        src = _FakeSource()
+        chunk = b"1 1:1e-999999999 2:1e999999999 3:-4.5e-400 4:2e400\n"
+        t0 = time.process_time()
+        block = LibSVMParser(src).parse_chunk(chunk).to_block()
+        # CPU time, not wall time: immune to CI load; an O(|exp|) loop
+        # would burn >=0.2s/token of CPU here (measured 206ms at 45M iters)
+        assert time.process_time() - t0 < 0.25
+        vals = block.value
+        assert vals[0] == 0.0
+        assert np.isinf(vals[1]) and vals[1] > 0
+        assert vals[2] == 0.0
+        assert np.isinf(vals[3]) and vals[3] > 0
+
+    def test_leading_zero_runs_parity(self, monkeypatch):
+        """Leading zeros must not consume the 19-significant-digit mantissa
+        budget: tiny values with long zero prefixes and zero-padded ints
+        match the pure-Python parser."""
+        chunk = (
+            b"1 1:0.000000000000000000123 2:0.0000000000000000001\n"
+            b"0 1:0000000000000000000123 2:0.0000000000000000000000000005\n"
+        )
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+        assert a.value[0] > 0 and a.value[1] > 0  # not flushed to zero
+        assert a.value[2] == 123.0
+
+    def test_compensating_exponent_parity(self, monkeypatch):
+        """A long zero run (or dropped-digit run) compensated by an explicit
+        exponent must stay finite/exact: saturation applies only to the
+        final combined exponent (ApplyExp10), never mid-scan."""
+        big = b"123" + b"0" * 497  # 500-digit integer ~1.23e499
+        chunk = (
+            b"1 1:0." + b"0" * 420 + b"5e450 2:1e9\n"
+            b"0 1:" + big + b"e-480 2:2.5\n"
+        )
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+        assert np.isfinite(a.value[0]) and a.value[0] > 0  # 5e29
+        assert np.isfinite(a.value[2]) and a.value[2] > 0  # ~1.23e19
+
+    def test_long_fraction_swar_parity(self, monkeypatch):
+        """Fraction runs longer than one 8-wide SWAR group round-trip to the
+        same float32 as the pure-Python parser."""
+        chunk = (
+            b"1 1:0.1234567890123456789 2:3.14159265358979 3:0.5\n"
+            b"0 1:123456789.123456789 2:0.000000001\n"
+        )
+        a, b = _parse_both(LibSVMParser, chunk, monkeypatch)
+        _assert_blocks_equal(a, b)
+
+
 class TestLibFMParity:
     def test_triples(self, monkeypatch):
         chunk = b"1 0:1:0.5 3:7:2.5\n0 1:2:-1.5\n"
