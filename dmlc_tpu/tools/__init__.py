@@ -1,0 +1,58 @@
+"""Tier-2 CLI harnesses.
+
+The reference ships 15 URI-driven CLI binaries under ``test/`` (built by
+``test/dmlc_test.mk:1-24``, SURVEY §4 tier 2) that double as integration
+tests and throughput benchmarks — they take URIs/params on argv and print
+MB/s telemetry. This package is their equivalent surface:
+
+| reference binary              | here                                  |
+|-------------------------------|---------------------------------------|
+| split_read_test.cc            | ``python -m dmlc_tpu.tools split_read``   |
+| split_repeat_read_test.cc     | ``split_read --repeat N``             |
+| split_test.cc                 | ``split_read --count-only``           |
+| libsvm_parser_test.cc         | ``python -m dmlc_tpu.tools parse``    |
+| libfm_parser_test.cc          | ``parse --format libfm``              |
+| csv_parser_test.cc            | ``parse --format csv``                |
+| strtonum_test.cc              | ``python -m dmlc_tpu.tools strtonum`` |
+| recordio_test.cc              | ``python -m dmlc_tpu.tools recordio`` |
+| filesys_test.cc (ls/cat/cp)   | ``python -m dmlc_tpu.tools filesys``  |
+| stream_read_test.cc           | ``python -m dmlc_tpu.tools stream_read`` |
+| iostream_test.cc              | ``stream_read --rw``                  |
+| dataiter_test.cc              | ``python -m dmlc_tpu.tools dataiter`` |
+| logging/parameter/registry_test.cc | unit-tier (tests/test_params.py, tests/test_utils.py) |
+
+Each sub-tool is also importable (``main(argv) -> int``) so the test suite
+drives them in-process.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+_COMMANDS = {
+    "split_read": "dmlc_tpu.tools.split_read",
+    "parse": "dmlc_tpu.tools.parse",
+    "recordio": "dmlc_tpu.tools.recordio",
+    "filesys": "dmlc_tpu.tools.filesys",
+    "stream_read": "dmlc_tpu.tools.stream_read",
+    "dataiter": "dmlc_tpu.tools.dataiter",
+    "strtonum": "dmlc_tpu.tools.strtonum",
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("commands:", " ".join(sorted(_COMMANDS)))
+        return 0 if argv else 2
+    cmd = argv[0]
+    if cmd not in _COMMANDS:
+        print(f"unknown command {cmd!r}; one of: {' '.join(sorted(_COMMANDS))}",
+              file=sys.stderr)
+        return 2
+    import importlib
+
+    mod = importlib.import_module(_COMMANDS[cmd])
+    return mod.main(argv[1:])

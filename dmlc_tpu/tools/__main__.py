@@ -1,0 +1,6 @@
+import sys
+
+from dmlc_tpu.tools import main
+
+if __name__ == "__main__":
+    sys.exit(main())

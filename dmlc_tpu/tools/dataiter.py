@@ -1,0 +1,62 @@
+"""RowBlockIter epoch-loop harness (in-memory or external-memory cache).
+
+Reference: ``test/dataiter_test.cc`` — iterate a dataset for several epochs
+through RowBlockIter (optionally with a ``#cachefile`` external-memory
+cache) and report per-epoch row counts and MB/s.
+
+Usage::
+
+    python -m dmlc_tpu.tools dataiter <uri> [part] [nparts] \
+        [--format auto|libsvm|libfm|csv] [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from dmlc_tpu.data import create_row_block_iter
+from dmlc_tpu.utils.timer import get_time
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="dataiter", description=__doc__)
+    ap.add_argument("uri")
+    ap.add_argument("part", type=int, nargs="?", default=0)
+    ap.add_argument("nparts", type=int, nargs="?", default=1)
+    ap.add_argument("--format", default="auto",
+                    choices=["auto", "libsvm", "libfm", "csv"])
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    it = create_row_block_iter(args.uri, args.part, args.nparts, args.format)
+    base = None
+    try:
+        for epoch in range(max(1, args.epochs)):
+            if epoch > 0:
+                it.before_first()
+            rows = 0
+            nnz = 0
+            t0 = get_time()
+            for block in it:
+                rows += len(block)
+                nnz += block.num_nonzero
+            dt = max(get_time() - t0, 1e-9)
+            print(f"epoch {epoch}: {rows} rows, {nnz} nnz, "
+                  f"{rows / dt:.0f} rows/sec, num_col={it.num_col()}")
+            if base is None:
+                base = (rows, nnz)
+            elif (rows, nnz) != base:
+                print(f"ERROR: epoch {epoch} saw {(rows, nnz)}, "
+                      f"epoch 0 saw {base}", file=sys.stderr)
+                return 1
+    finally:
+        close = getattr(it, "close", None)
+        if close:
+            close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Parser throughput harness (libsvm / libfm / csv).
+
+Reference: ``test/libsvm_parser_test.cc:19-36`` (bytes parsed, examples
+count, MB/s), ``test/libfm_parser_test.cc``, ``test/csv_parser_test.cc``.
+
+Usage::
+
+    python -m dmlc_tpu.tools parse <uri> [part] [nparts] \
+        [--format auto|libsvm|libfm|csv] [--nthread N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from dmlc_tpu.data import create_parser
+from dmlc_tpu.utils.timer import get_time
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="parse", description=__doc__)
+    ap.add_argument("uri")
+    ap.add_argument("part", type=int, nargs="?", default=0)
+    ap.add_argument("nparts", type=int, nargs="?", default=1)
+    ap.add_argument("--format", default="auto",
+                    choices=["auto", "libsvm", "libfm", "csv"])
+    ap.add_argument("--nthread", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    parser = create_parser(
+        args.uri, args.part, args.nparts, args.format, nthread=args.nthread
+    )
+    rows = 0
+    nnz = 0
+    t0 = get_time()
+    try:
+        for block in parser:
+            rows += len(block)
+            nnz += block.num_nonzero
+        dt = max(get_time() - t0, 1e-9)
+        nbytes = parser.bytes_read
+        print(f"{nbytes} bytes parsed, {rows} examples, {nnz} nnz")
+        print(f"{nbytes / (1 << 20) / dt:.2f} MB/sec, "
+              f"{rows / dt:.0f} examples/sec")
+    finally:
+        parser.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

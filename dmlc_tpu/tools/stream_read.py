@@ -1,0 +1,72 @@
+"""Stream read/write throughput harness.
+
+Reference: ``test/stream_read_test.cc`` (sequential Stream::Read MB/s) and
+``test/iostream_test.cc`` (``--rw``: write-then-read round-trip through the
+Stream API).
+
+Usage::
+
+    python -m dmlc_tpu.tools stream_read <uri> [--rw] [--size-mb N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from dmlc_tpu.io import create_stream, create_stream_for_read
+from dmlc_tpu.utils.timer import get_time
+
+_CHUNK = 4 << 20
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="stream_read", description=__doc__)
+    ap.add_argument("uri")
+    ap.add_argument("--rw", action="store_true",
+                    help="write --size-mb of data first, then verify it back")
+    ap.add_argument("--size-mb", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    checksum = None
+    if args.rw:
+        rng = np.random.RandomState(7)
+        t0 = get_time()
+        written = 0
+        checksum = 0
+        with create_stream(args.uri, "w") as stream:
+            while written < args.size_mb << 20:
+                data = rng.bytes(_CHUNK)
+                stream.write(data)
+                checksum = (checksum + int(np.frombuffer(
+                    data, dtype=np.uint8).sum(dtype=np.uint64))) & 0xFFFFFFFF
+                written += len(data)
+        dt = max(get_time() - t0, 1e-9)
+        print(f"wrote {written} bytes, {written / (1 << 20) / dt:.2f} MB/sec")
+
+    t0 = get_time()
+    nbytes = 0
+    read_sum = 0
+    with create_stream_for_read(args.uri) as stream:
+        while True:
+            data = stream.read(_CHUNK)
+            if not data:
+                break
+            nbytes += len(data)
+            if checksum is not None:
+                read_sum = (read_sum + int(np.frombuffer(
+                    data, dtype=np.uint8).sum(dtype=np.uint64))) & 0xFFFFFFFF
+    dt = max(get_time() - t0, 1e-9)
+    print(f"read {nbytes} bytes, {nbytes / (1 << 20) / dt:.2f} MB/sec")
+    if checksum is not None and read_sum != checksum:
+        print(f"ERROR: checksum mismatch {read_sum:#x} != {checksum:#x}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Tier-2 CLI harness tools (dmlc_tpu.tools.*).
+
+The reference's test/*.cc CLI binaries are integration harnesses driven by
+argv (SURVEY §4 tier 2); these tests drive their equivalents in-process and
+once via ``python -m`` for the dispatcher path.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io import RECORDIO_MAGIC, RecordIOWriter, create_stream
+from dmlc_tpu.tools import main as tools_main
+from dmlc_tpu.tools import (
+    dataiter as tool_dataiter,
+    filesys as tool_filesys,
+    parse as tool_parse,
+    recordio as tool_recordio,
+    split_read as tool_split_read,
+    stream_read as tool_stream_read,
+    strtonum as tool_strtonum,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def svm_file(tmp_path):
+    rng = np.random.RandomState(3)
+    path = tmp_path / "data.svm"
+    with open(path, "w") as fh:
+        for i in range(200):
+            feats = " ".join(
+                f"{j + 1}:{rng.rand():.6f}" for j in range(8)
+            )
+            fh.write(f"{i % 2} {feats}\n")
+    return str(path)
+
+
+class TestSplitRead:
+    def test_single_and_repeat(self, svm_file, capsys):
+        assert tool_split_read.main([svm_file, "0", "1", "--repeat", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "epoch 0: 200 records" in out
+        assert "epoch 1: 200 records" in out
+
+    def test_parts_cover_exactly_once(self, svm_file, capsys):
+        total = 0
+        for part in range(3):
+            assert tool_split_read.main(
+                [svm_file, str(part), "3", "--count-only"]
+            ) == 0
+            line = capsys.readouterr().out.strip().splitlines()[-1]
+            total += int(line.split(":")[1].split()[0])
+        assert total == 200
+
+    def test_recordio_type(self, tmp_path, capsys):
+        path = str(tmp_path / "r.rec")
+        with create_stream(path, "w") as s:
+            w = RecordIOWriter(s)
+            for i in range(50):
+                w.write_record(b"x" * i)
+        assert tool_split_read.main([path, "0", "1", "--type", "recordio"]) == 0
+        assert "50 records" in capsys.readouterr().out
+
+
+class TestParse:
+    def test_libsvm_throughput(self, svm_file, capsys):
+        assert tool_parse.main([svm_file]) == 0
+        out = capsys.readouterr().out
+        assert "200 examples" in out and "1600 nnz" in out
+
+    def test_csv(self, tmp_path, capsys):
+        path = tmp_path / "d.csv"
+        path.write_text("".join(f"{i % 2},1.5,2.5\n" for i in range(60)))
+        assert tool_parse.main(
+            [f"{path}?format=csv&label_column=0", "--format", "csv"]
+        ) == 0
+        assert "60 examples" in capsys.readouterr().out
+
+
+class TestRecordIOTool:
+    def test_roundtrip_with_embedded_magic(self, tmp_path, capsys):
+        path = str(tmp_path / "adv.rec")
+        assert tool_recordio.main([path, "--n", "300", "--nsplit", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "sequential read ok" in out
+        assert "chunk read ok" in out
+        # the generator must actually exercise embedded magics
+        first = out.splitlines()[0]
+        assert int(first.split()[3]) > 0, first
+
+
+class TestFilesys:
+    def test_ls_cat_cp(self, tmp_path, capsys):
+        src = tmp_path / "a.txt"
+        src.write_bytes(b"hello dmlc\n")
+        assert tool_filesys.main(["ls", str(tmp_path)]) == 0
+        assert "a.txt" in capsys.readouterr().out
+        assert tool_filesys.main(["cat", str(src)]) == 0
+        # cp to mem:// then back out
+        assert tool_filesys.main(["cp", str(src), "mem://t/b.txt"]) == 0
+        dst = tmp_path / "b.txt"
+        assert tool_filesys.main(["cp", "mem://t/b.txt", str(dst)]) == 0
+        assert dst.read_bytes() == b"hello dmlc\n"
+
+    def test_bad_subcommand(self):
+        assert tool_filesys.main(["mv", "a", "b"]) == 2
+
+
+class TestStreamRead:
+    def test_rw_checksum(self, tmp_path, capsys):
+        path = str(tmp_path / "blob.bin")
+        assert tool_stream_read.main([path, "--rw", "--size-mb", "8"]) == 0
+        out = capsys.readouterr().out
+        assert "wrote" in out and "read" in out
+
+
+class TestDataIter:
+    def test_epochs_stable(self, svm_file, capsys):
+        assert tool_dataiter.main([svm_file, "--epochs", "2"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("200 rows") == 2
+
+    def test_external_memory_cache(self, svm_file, tmp_path, capsys):
+        cache = tmp_path / "cache.bin"
+        uri = f"{svm_file}#{cache}"
+        assert tool_dataiter.main([uri, "--epochs", "2"]) == 0
+        assert os.path.exists(cache)  # DiskRowIter spilled pages here
+
+
+class TestStrtonum:
+    def test_fuzz_parity(self, capsys):
+        assert tool_strtonum.main(["--n", "5000"]) == 0
+        out = capsys.readouterr().out
+        assert "5000 values" in out
+
+
+class TestDispatcher:
+    def test_unknown(self, capsys):
+        assert tools_main(["nope"]) == 2
+
+    def test_module_invocation(self, svm_file):
+        proc = subprocess.run(
+            [sys.executable, "-m", "dmlc_tpu.tools", "parse", svm_file],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "200 examples" in proc.stdout
