@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Distributed allreduce-SGD on LibSVM data — the BASELINE north-star loop.
+
+Runs under any launcher that exports the DMLC_* env contract::
+
+    ./dmlc-submit --cluster=local -n 4 python examples/distributed_sgd.py data.svm
+    ./dmlc-submit --cluster=ssh -H hosts.txt -n 8 python examples/distributed_sgd.py gs://b/data.svm
+    ./dmlc-submit --cluster=tpu --tpu-name v5e -n 16 python examples/distributed_sgd.py ...
+
+or standalone (world size 1)::
+
+    python examples/distributed_sgd.py data.svm [--epochs N]
+
+Each worker reads its own InputSplit part (part=rank of world), computes a
+local logistic-regression gradient per block, allreduces it (socket tree on
+CPU clusters, psum over ICI under --cluster=tpu), and steps. Checkpoints go
+through the rabit-style ``checkpoint``/``load_checkpoint`` so a restarted
+worker resumes at the last committed epoch.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dmlc_tpu import collective as rabit
+from dmlc_tpu.data import create_parser
+
+
+def sigmoid(x):
+    return 0.5 * (1.0 + np.tanh(0.5 * x))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("uri")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--num-features", type=int, default=0,
+                    help="0 = discover from the data (epoch-0 max index + 1)")
+    ap.add_argument("--checkpoint-uri", default="")
+    args = ap.parse_args()
+
+    rabit.init()
+    rank, world = rabit.rank(), rabit.world_size()
+
+    start_epoch = 0
+    ckpt = rabit.load_checkpoint(args.checkpoint_uri or None)
+    if ckpt is not None:
+        # the checkpoint fixes w (and therefore the feature-space width):
+        # skip the discovery pass entirely on resume
+        start_epoch, w = ckpt
+        if rank == 0:
+            rabit.tracker_print(f"resumed at epoch {start_epoch}")
+    else:
+        # discover the feature-space width across all parts
+        num_features = args.num_features
+        if num_features == 0:
+            parser = create_parser(args.uri, rank, world)
+            local_max = 0
+            for block in parser:
+                if len(block.index):
+                    local_max = max(local_max, int(block.index.max()))
+            parser.close()
+            num_features = int(
+                rabit.allreduce(np.array([local_max], np.int64), op="max")[0]
+            ) + 1
+        w = np.zeros(num_features + 1, dtype=np.float64)  # [weights..., bias]
+
+    for epoch in range(start_epoch, args.epochs):
+        parser = create_parser(args.uri, rank, world)
+        grad = np.zeros_like(w)
+        loss = 0.0
+        weight_sum = 0.0
+        for block in parser:
+            # CSR block -> dense margin via segment sums (numpy reference
+            # loop; models/linear.py holds the jitted TPU twin)
+            n = len(block)
+            vals = (block.value if block.value is not None
+                    else np.ones_like(block.index, np.float32))
+            row_ids = np.repeat(np.arange(n), np.diff(block.offset))
+            margins = np.bincount(
+                row_ids, weights=vals * w[block.index], minlength=n
+            ) + w[-1]
+            y = (block.label > 0).astype(np.float64)
+            p = sigmoid(margins)
+            g = p - y
+            np.add.at(grad[:-1], block.index, g[row_ids] * vals)
+            grad[-1] += g.sum()
+            loss += float(
+                np.sum(np.maximum(margins, 0) - margins * y
+                       + np.log1p(np.exp(-np.abs(margins))))
+            )
+            weight_sum += len(block)
+        parser.close()
+
+        # grad sync: one fused allreduce over [grad, loss, count]
+        packed = np.concatenate([grad, [loss, weight_sum]])
+        packed = rabit.allreduce(packed, op="sum")
+        grad, loss, weight_sum = packed[:-2], packed[-2], packed[-1]
+        denom = max(weight_sum, 1e-12)
+        w -= args.lr * grad / denom
+        if rank == 0:
+            rabit.tracker_print(
+                f"epoch {epoch}: loss={loss / denom:.6f} "
+                f"examples={int(weight_sum)}"
+            )
+        rabit.checkpoint((epoch + 1, w), args.checkpoint_uri or None)
+
+    rabit.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Parameter module example (the reference's example/parameter.cc).
+
+Usage::
+
+    python examples/parameter.py num_hidden=100 name=aaa activation=relu
+
+Run with no arguments to see the auto-generated docstring; pass a bad value
+(activation=tanh, num_hidden=-1) to see constraint errors.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_tpu.params import ParamError, Parameter, field
+
+
+class MyParam(Parameter):
+    num_hidden = field(
+        int, lower_bound=0, upper_bound=1000,
+        description="Number of hidden units in the fully connected layer.",
+        aliases=["nhidden"],  # user can also set nhidden=...
+    )
+    learning_rate = field(
+        float, 0.01, description="Learning rate of SGD optimization."
+    )
+    activation = field(
+        int, enum={"relu": 1, "sigmoid": 2},
+        description="Activation function type.", aliases=["act"],
+    )
+    name = field(str, "mnet", description="Name of the net.")
+
+
+def main(argv):
+    if not argv:
+        print("Usage: parameter.py key=value ...")
+        print("example: parameter.py num_hidden=100 name=aaa activation=relu")
+        print()
+        print("parameters:")
+        print(MyParam.__doc_string__())
+        return 1
+    kwargs = dict(kv.split("=", 1) for kv in argv)
+    param = MyParam()
+    try:
+        param.init(kwargs)
+    except ParamError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    print(f"param.num_hidden={param.num_hidden}")
+    print(f"param.learning_rate={param.learning_rate}")
+    print(f"param.activation={param.activation}")
+    print(f"param.name={param.name}")
+    print(f"as dict: {param.to_dict()}")
+    print(f"as json: {param.saves()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

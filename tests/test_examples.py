@@ -1,0 +1,84 @@
+"""Runnable examples (examples/*.py) stay runnable.
+
+The reference ships example/parameter.cc built by `make example`; these are
+its equivalents plus the distributed-SGD demo loop.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+
+
+def _run(argv, timeout=120):
+    return subprocess.run(
+        argv, capture_output=True, text=True, timeout=timeout, env=ENV
+    )
+
+
+class TestParameterExample:
+    def test_valid(self):
+        proc = _run([sys.executable, os.path.join(REPO, "examples/parameter.py"),
+                     "num_hidden=100", "name=aaa", "activation=relu"])
+        assert proc.returncode == 0, proc.stderr
+        assert "param.activation=1" in proc.stdout
+
+    def test_constraint_error(self):
+        proc = _run([sys.executable, os.path.join(REPO, "examples/parameter.py"),
+                     "num_hidden=100", "activation=tanh"])
+        assert proc.returncode == 1
+        assert "relu" in proc.stderr  # names the allowed enum values
+
+    def test_usage_shows_docstring(self):
+        proc = _run([sys.executable, os.path.join(REPO, "examples/parameter.py")])
+        assert proc.returncode == 1
+        assert "num_hidden : int" in proc.stdout
+
+
+class TestDistributedSGDExample:
+    def _write_data(self, tmp_path, rows=400):
+        rng = np.random.RandomState(1)
+        path = tmp_path / "toy.svm"
+        with open(path, "w") as f:
+            for _ in range(rows):
+                x = rng.rand(5)
+                y = 1 if x.sum() > 2.5 else 0
+                f.write(f"{y} " + " ".join(
+                    f"{j + 1}:{x[j]:.4f}" for j in range(5)) + "\n")
+        return str(path)
+
+    def test_standalone_single_process(self, tmp_path):
+        data = self._write_data(tmp_path)
+        proc = _run([sys.executable,
+                     os.path.join(REPO, "examples/distributed_sgd.py"),
+                     data, "--epochs", "2"])
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout + proc.stderr
+        assert "epoch 0" in out and "epoch 1" in out
+
+    def test_local_cluster_matches_single_process(self, tmp_path):
+        """2-worker tracker run reproduces the single-process losses exactly
+        (the BASELINE bit-parity property: deterministic tree reduction)."""
+        data = self._write_data(tmp_path)
+        single = _run([sys.executable,
+                       os.path.join(REPO, "examples/distributed_sgd.py"),
+                       data, "--epochs", "2"])
+        assert single.returncode == 0, single.stderr
+        multi = _run([sys.executable, os.path.join(REPO, "dmlc-submit"),
+                      "--cluster", "local", "-n", "2", "--host-ip",
+                      "127.0.0.1", sys.executable,
+                      os.path.join(REPO, "examples/distributed_sgd.py"),
+                      data, "--epochs", "2"], timeout=180)
+        assert multi.returncode == 0, multi.stderr
+
+        def losses(text):
+            return [line.split("loss=")[1].split()[0]
+                    for line in text.splitlines() if "loss=" in line]
+
+        ls, lm = losses(single.stdout + single.stderr), \
+            losses(multi.stdout + multi.stderr)
+        assert ls and ls == lm, (ls, lm)
