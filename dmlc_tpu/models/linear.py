@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu.ops.objectives import margin_loss_grad
 from dmlc_tpu.ops.spmv import spmv, spmv_transpose
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import DMLCError, check
@@ -67,25 +68,12 @@ def linear_predict_dense(params: Dict, x):
 
 
 def _margin_grad(objective: str, margin, label):
-    """Per-row (loss, dloss/dmargin) for the supported objectives."""
-    if objective == "logistic":
-        # labels in {0,1}; numerically stable softplus form
-        loss = jnp.maximum(margin, 0.0) - margin * label + jnp.log1p(
-            jnp.exp(-jnp.abs(margin))
-        )
-        grad = jax.nn.sigmoid(margin) - label
-    elif objective == "squared":
-        diff = margin - label
-        loss = 0.5 * diff * diff
-        grad = diff
-    elif objective == "hinge":
-        # labels in {0,1} mapped to {-1,+1}
-        y = 2.0 * label - 1.0
-        loss = jnp.maximum(0.0, 1.0 - y * margin)
-        grad = jnp.where(y * margin < 1.0, -y, 0.0)
-    else:
-        raise DMLCError(f"unknown objective {objective!r}")
-    return loss, grad
+    """Per-row (loss, dloss/dmargin) — shared with the Pallas fused kernel
+    (ops/objectives.py holds the single definition)."""
+    try:
+        return margin_loss_grad(objective, margin, label)
+    except ValueError as err:
+        raise DMLCError(str(err)) from err
 
 
 def make_linear_train_step(
@@ -97,6 +85,7 @@ def make_linear_train_step(
     layout: str = "dense",
     num_features: int = 0,
     axis: str = "dp",
+    use_pallas: Optional[bool] = None,
 ):
     """Build the jitted allreduce-SGD step.
 
@@ -104,14 +93,46 @@ def make_linear_train_step(
     where metrics = {"loss_sum": Σ w·loss, "weight_sum": Σ w} (host divides).
     With ``mesh`` the batch is consumed sharded over ``axis`` and gradients
     cross ICI in one fused psum; without, it is a single-device step.
+
+    ``use_pallas`` (default: env DMLC_TPU_PALLAS=1) routes the dense
+    gradient core through the fused Pallas kernel
+    (ops/pallas_kernels.fused_linear_grads). Measured at parity with XLA's
+    own fusion on v5e (BASELINE.md) — XLA stays the default.
     """
     check(layout in ("dense", "csr"), "layout must be dense or csr")
     if layout == "csr":
         check(num_features > 0, "csr layout requires num_features")
+    if use_pallas is None:
+        import os
+
+        use_pallas = os.environ.get("DMLC_TPU_PALLAS", "0") == "1"
+    if use_pallas:
+        from dmlc_tpu.ops import pallas_kernels
+        from dmlc_tpu.ops.objectives import OBJECTIVES
+
+        check(layout == "dense", "the pallas fused step is dense-only")
+        check(
+            pallas_kernels.available and objective in OBJECTIVES,
+            "pallas path unavailable for this configuration",
+        )
+    # Mosaic only targets TPU; elsewhere (CPU meshes in tests, the
+    # dryrun_multichip virtual devices) the kernel runs interpreted.
+    pallas_interpret = jax.default_backend() != "tpu"
 
     def _local_grads(params, batch):
         label = batch["label"]
         weight = batch["weight"]
+        if layout == "dense" and use_pallas:
+            from dmlc_tpu.ops.pallas_kernels import fused_linear_grads
+
+            gw, gb, loss_sum, wsum = fused_linear_grads(
+                batch["x"], label, weight, params["w"], params["b"],
+                objective=objective, interpret=pallas_interpret,
+            )
+            # the kernel computes in f32; keep the params dtype contract of
+            # the XLA path (no silent upcast of bf16 params mid-training)
+            return (gw.astype(params["w"].dtype),
+                    gb.astype(params["b"].dtype), loss_sum, wsum)
         if layout == "dense":
             margin = batch["x"] @ params["w"] + params["b"]
         else:

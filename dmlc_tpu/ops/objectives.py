@@ -1,0 +1,39 @@
+"""Per-row objective math shared by the XLA and Pallas train steps.
+
+One definition of (loss, dloss/dmargin) per objective so a numerics fix or
+a new objective lands in both paths at once (models/linear.py consumes it
+directly; ops/pallas_kernels.py traces it inside the fused kernel — it is
+pure elementwise jnp, so it lowers in either context).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+OBJECTIVES = ("logistic", "squared", "hinge")
+
+
+def margin_loss_grad(objective: str, margin, label):
+    """(loss, dloss/dmargin) per row.
+
+    logistic: labels in {0,1}, numerically stable softplus form.
+    squared: plain least squares.
+    hinge: labels in {0,1} mapped to {-1,+1}.
+    """
+    if objective == "logistic":
+        loss = jnp.maximum(margin, 0.0) - margin * label + jnp.log1p(
+            jnp.exp(-jnp.abs(margin))
+        )
+        grad = jax.nn.sigmoid(margin) - label
+    elif objective == "squared":
+        diff = margin - label
+        loss = 0.5 * diff * diff
+        grad = diff
+    elif objective == "hinge":
+        y = 2.0 * label - 1.0
+        loss = jnp.maximum(0.0, 1.0 - y * margin)
+        grad = jnp.where(y * margin < 1.0, -y, 0.0)
+    else:
+        raise ValueError(f"unknown objective {objective!r}")
+    return loss, grad

@@ -1,0 +1,168 @@
+"""Pallas TPU kernels for the hot compute ops.
+
+The flagship loop's compute core is ``margin = X @ w`` followed by an
+elementwise loss/grad and ``gw = X^T (weight * dmargin)`` (models/linear.py).
+XLA already fuses the elementwise work into the matmuls; the Pallas kernel
+here goes one step further and keeps the whole step — both matmuls, the
+loss, and the scalar reductions — resident in VMEM per batch tile, with the
+gradient accumulated across grid steps. One HBM read of X per step, no
+intermediate [B] arrays ever round-tripping through HBM.
+
+Why there is NO pallas sparse (COO/segment-sum) kernel: gather/scatter with
+per-entry dynamic indices is exactly what the TPU's vector unit can't tile
+(SURVEY §7 hard parts; ops/spmv.py design note) — XLA's own segment_sum
+lowering is the right tool, and a hand-rolled kernel would serialize. The
+sparse path stays on ops.spmv; dense batches (the HIGGS north star) get the
+fused kernel.
+
+Tiling: batch rows are processed TILE_B at a time; the feature dim is padded
+to a lane multiple (128) by the wrapper, and the row tile to a sublane
+multiple. Padded rows carry weight 0, padded features carry x == w == 0, so
+both are arithmetic no-ops (the same invariant as device/csr.py padding).
+
+Opt-in: models/linear.py uses it when DMLC_TPU_PALLAS=1 (or use_pallas=True)
+— measured on-par with XLA's fusion for small feature dims, it exists as the
+template for wider fused steps (FM interactions, multi-tower).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_tpu.ops.objectives import margin_loss_grad
+
+try:  # pallas ships with jax; keep the module importable without it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    available = True
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+    available = False
+
+_LANE = 128
+_TILE_B = 512
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _fused_step_kernel(objective: str, x_ref, y_ref, wgt_ref, w_ref, b_ref,
+                       gw_ref, gb_ref, loss_ref, wsum_ref):
+    """One batch tile: margin → dloss → partial gw/gb/loss/wsum, accumulated
+    across the (sequential) grid."""
+    i = pl.program_id(0)
+
+    x = x_ref[...]                       # [TILE_B, F]
+    y = y_ref[...]                       # [TILE_B, 1]
+    wgt = wgt_ref[...]                   # [TILE_B, 1]
+    w = w_ref[...]                       # [1, F] — lane-major: an [F, 1]
+    # layout would pad the unit lane dimension to 128 and cost 128x VMEM
+
+    # A matvec is bandwidth-bound (2 flops/element): broadcast-multiply +
+    # reduce on the VPU is both the natural lowering (Mosaic rejects the
+    # [T,F]x[1,F] dot_general contraction) and exact f32 — the MXU's
+    # single-pass bf16 truncation would cost ~1e-2 relative error here
+    margin = jnp.sum(x * w, axis=1, keepdims=True) + b_ref[0, 0]  # [TILE_B, 1]
+    loss, dmargin = margin_loss_grad(objective, margin, y)
+
+    wg = wgt * dmargin                   # [TILE_B, 1]
+    gw_part = jnp.sum(x * wg, axis=0, keepdims=True)  # [1, F]
+    # (1,1)-shaped partials: Mosaic cannot store scalars to VMEM
+    gb_part = jnp.sum(wg).reshape(1, 1)
+    loss_part = jnp.sum(wgt * loss).reshape(1, 1)
+    wsum_part = jnp.sum(wgt).reshape(1, 1)
+
+    @pl.when(i == 0)
+    def _():
+        gw_ref[...] = gw_part
+        gb_ref[...] = gb_part
+        loss_ref[...] = loss_part
+        wsum_ref[...] = wsum_part
+
+    @pl.when(i > 0)
+    def _():
+        gw_ref[...] += gw_part
+        gb_ref[...] += gb_part
+        loss_ref[...] += loss_part
+        wsum_ref[...] += wsum_part
+
+
+@functools.partial(
+    jax.jit, static_argnames=("objective", "tile_b", "interpret")
+)
+def fused_linear_grads(
+    x, label, weight, w, b,
+    objective: str = "logistic",
+    tile_b: int = _TILE_B,
+    interpret: bool = False,
+):
+    """(gw [F], gb, loss_sum, weight_sum) for a dense batch, one kernel.
+
+    Same contract as the _local_grads dense path in models/linear.py.
+    Shapes: x [B, F], label/weight [B], w [F], b scalar. B and F need not be
+    tile-aligned — the wrapper zero-pads (padded rows get weight 0).
+    """
+    bsz, nfeat = x.shape
+    fpad = _round_up(max(nfeat, _LANE), _LANE)
+    # keep the x tile within a VMEM budget (~2 MiB leaves room for Mosaic's
+    # double buffering inside the 16 MiB scoped limit); floor is the f32
+    # sublane minimum so very wide feature dims shrink the row tile instead
+    # of blowing VMEM
+    vmem_rows = max(8, ((2 << 20) // (fpad * 4)) // 8 * 8)
+    tile = min(tile_b, vmem_rows, _round_up(max(bsz, 8), 8))
+    bpad = _round_up(max(bsz, tile), tile)
+    if fpad != nfeat or bpad != bsz:
+        x = jnp.pad(x, ((0, bpad - bsz), (0, fpad - nfeat)))
+        label = jnp.pad(label, (0, bpad - bsz))
+        weight = jnp.pad(weight, (0, bpad - bsz))
+        w = jnp.pad(w, (0, fpad - nfeat))
+
+    grid = bpad // tile
+    kernel = functools.partial(_fused_step_kernel, objective)
+    gw, gb, loss_sum, wsum = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((tile, fpad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, fpad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, fpad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, fpad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bpad * fpad,  # two matmuls over the batch
+            bytes_accessed=bpad * fpad * 4 + fpad * 4 * 2 + bpad * 8,
+            transcendentals=bpad,
+        ),
+        interpret=interpret,
+    )(
+        x.astype(jnp.float32),
+        label.astype(jnp.float32).reshape(-1, 1),
+        weight.astype(jnp.float32).reshape(-1, 1),
+        w.astype(jnp.float32).reshape(1, -1),
+        jnp.asarray(b, jnp.float32).reshape(1, 1),
+    )
+    return gw[0, :nfeat], gb[0, 0], loss_sum[0, 0], wsum[0, 0]

@@ -108,7 +108,12 @@ def main() -> int:
                 f"epoch {epoch}: loss={loss / denom:.6f} "
                 f"examples={int(weight_sum)}"
             )
-        rabit.checkpoint((epoch + 1, w), args.checkpoint_uri or None)
+        # only rank 0 persists to the shared URI (w is identical on every
+        # rank after the allreduce; concurrent writers would tear the file)
+        rabit.checkpoint(
+            (epoch + 1, w),
+            (args.checkpoint_uri or None) if rank == 0 else None,
+        )
 
     rabit.finalize()
     return 0

@@ -1,0 +1,96 @@
+"""Pallas fused train-step kernel (ops/pallas_kernels.py).
+
+Runs in interpret mode on the CPU test platform (Mosaic targets TPU only);
+on real TPU the same kernel compiles — parity + perf vs XLA's fusion was
+measured on v5e (see BASELINE.md "Pallas fused step").
+"""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.ops import pallas_kernels
+
+pytestmark = pytest.mark.skipif(
+    not pallas_kernels.available, reason="pallas unavailable"
+)
+
+
+def _reference(objective, x, y, wgt, w, b):
+    margin = x.astype(np.float64) @ w.astype(np.float64) + b
+    if objective == "logistic":
+        loss = (np.maximum(margin, 0) - margin * y
+                + np.log1p(np.exp(-np.abs(margin))))
+        dm = 1.0 / (1.0 + np.exp(-margin)) - y
+    elif objective == "squared":
+        loss = 0.5 * (margin - y) ** 2
+        dm = margin - y
+    else:
+        sy = 2 * y - 1
+        loss = np.maximum(0.0, 1 - sy * margin)
+        dm = np.where(sy * margin < 1, -sy, 0.0)
+    wg = wgt * dm
+    return x.T @ wg, wg.sum(), (wgt * loss).sum(), wgt.sum()
+
+
+@pytest.mark.parametrize("objective", ["logistic", "squared", "hinge"])
+def test_fused_grads_parity(objective):
+    rng = np.random.RandomState(0)
+    n, f = 700, 28  # deliberately unaligned to tile/lane sizes
+    x = rng.rand(n, f).astype(np.float32)
+    y = (rng.rand(n) > 0.5).astype(np.float32)
+    wgt = rng.rand(n).astype(np.float32)
+    w = (rng.randn(f) * 0.1).astype(np.float32)
+    gw, gb, ls, ws = pallas_kernels.fused_linear_grads(
+        x, y, wgt, w, 0.05, objective=objective, interpret=True
+    )
+    egw, egb, els, ews = _reference(objective, x, y, wgt, w, 0.05)
+    np.testing.assert_allclose(np.asarray(gw), egw, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(gb), egb, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(ls), els, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(ws), ews, rtol=1e-6)
+
+
+def test_multi_tile_accumulation():
+    """Batches spanning several grid steps accumulate exactly."""
+    rng = np.random.RandomState(1)
+    n, f = 2048, 16
+    x = rng.rand(n, f).astype(np.float32)
+    y = (rng.rand(n) > 0.5).astype(np.float32)
+    wgt = np.ones(n, np.float32)
+    w = np.zeros(f, np.float32)
+    gw, gb, ls, ws = pallas_kernels.fused_linear_grads(
+        x, y, wgt, w, 0.0, tile_b=256, interpret=True
+    )
+    egw, egb, els, ews = _reference("logistic", x, y, wgt, w, 0.0)
+    np.testing.assert_allclose(np.asarray(gw), egw, rtol=1e-5, atol=1e-4)
+    assert float(ws) == n
+
+
+def test_model_step_with_pallas_matches_xla():
+    """make_linear_train_step(use_pallas=True) reproduces the XLA step."""
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+    )
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    n, f = 512, 12
+    batch = {
+        "x": jnp.asarray(rng.rand(n, f).astype(np.float32)),
+        "label": jnp.asarray((rng.rand(n) > 0.5).astype(np.float32)),
+        "weight": jnp.ones(n, jnp.float32),
+    }
+    outs = {}
+    for use_pallas in (False, True):
+        params = init_linear_params(f)
+        velocity = {"w": jnp.zeros(f), "b": jnp.zeros(())}
+        step = make_linear_train_step(
+            None, layout="dense", use_pallas=use_pallas
+        )
+        params, velocity, metrics = step(params, velocity, batch)
+        outs[use_pallas] = (np.asarray(params["w"]),
+                            float(metrics["loss_sum"]))
+    np.testing.assert_allclose(outs[False][0], outs[True][0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[False][1], outs[True][1], rtol=1e-5)
