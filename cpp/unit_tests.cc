@@ -1,0 +1,274 @@
+// Native unit tier: plain-assert tests of the C ABI, no framework.
+//
+// The reference's gtest tier (test/unittest/*.cc, one dmlc_unittest binary)
+// covers its C++ library directly; this is the same tier for the native
+// core — built and run by `make -C cpp test` and wired into pytest via
+// tests/test_cpp_unit.py. The Python parity suite (tests/test_native.py)
+// covers native-vs-Python agreement; this tier covers C++-only invariants
+// (bounds, error codes, adversarial framing) without a Python interpreter
+// in the loop.
+
+#include <cassert>
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+int parse_libsvm(const char* data, int64_t len, float* labels, float* weights,
+                 int64_t* qids, int64_t* row_nnz, uint64_t* indices,
+                 float* values, int64_t max_rows, int64_t max_nnz,
+                 int64_t* out_rows, int64_t* out_nnz, int* out_flags);
+int parse_libfm(const char* data, int64_t len, float* labels, int64_t* row_nnz,
+                uint64_t* fields, uint64_t* indices, float* values,
+                int64_t max_rows, int64_t max_nnz, int64_t* out_rows,
+                int64_t* out_nnz);
+int parse_csv(const char* data, int64_t len, float* out, int64_t max_rows,
+              int64_t expect_cols, int64_t* out_rows, int64_t* out_cols);
+void count_tokens(const char* data, int64_t len, int64_t* out_rows,
+                  int64_t* out_tokens);
+int64_t recordio_pack_bound(const char* data, int64_t len);
+int64_t recordio_pack(const char* data, int64_t len, char* out);
+int recordio_unpack(const char* buf, int64_t len, char* out_data,
+                    int64_t* out_offsets, int64_t* out_nrec,
+                    int64_t* out_datalen, int64_t* consumed);
+int64_t recordio_find_head(const char* buf, int64_t len, int64_t start);
+void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
+                  int32_t format, int32_t part, int32_t nparts,
+                  int32_t nthread, int64_t chunk_bytes, int32_t capacity,
+                  int64_t csv_expect_cols);
+int ingest_peek(void* handle, int64_t* rows, int64_t* nnz, int64_t* ncols,
+                int32_t* flags);
+int ingest_fetch(void* handle, float* labels, float* weights, int64_t* qids,
+                 int64_t* offsets, uint32_t* indices, float* values,
+                 uint32_t* fields);
+int64_t ingest_bytes_read(void* handle);
+void ingest_close(void* handle);
+int dmlc_tpu_abi_version();
+}
+
+namespace {
+
+int g_checks = 0;
+
+#define CHECK_TRUE(cond)                                                   \
+  do {                                                                     \
+    ++g_checks;                                                            \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      std::exit(1);                                                        \
+    }                                                                      \
+  } while (0)
+
+bool near(double a, double b, double tol = 1e-6) {
+  double d = a - b;
+  if (d < 0) d = -d;
+  double m = (a < 0 ? -a : a) + (b < 0 ? -b : b) + 1e-12;
+  return d <= tol * m || d <= tol;
+}
+
+struct SvmOut {
+  std::vector<float> labels, weights, values;
+  std::vector<int64_t> qids, row_nnz;
+  std::vector<uint64_t> indices;
+  int64_t rows = 0, nnz = 0;
+  int flags = 0;
+  int rc = 0;
+};
+
+SvmOut run_libsvm(const std::string& text, int64_t cap = -1) {
+  SvmOut o;
+  int64_t bound = cap >= 0 ? cap : static_cast<int64_t>(text.size()) / 2 + 2;
+  o.labels.resize(bound + 1);
+  o.weights.resize(bound + 1);
+  o.values.resize(bound + 1);
+  o.qids.resize(bound + 1);
+  o.row_nnz.resize(bound + 1);
+  o.indices.resize(bound + 1);
+  o.rc = parse_libsvm(text.data(), text.size(), o.labels.data(),
+                      o.weights.data(), o.qids.data(), o.row_nnz.data(),
+                      o.indices.data(), o.values.data(), bound, bound,
+                      &o.rows, &o.nnz, &o.flags);
+  return o;
+}
+
+void test_libsvm_basic() {
+  SvmOut o = run_libsvm("1 1:0.5 7:2.25\n0:3.5 3:1e-3 4:-2.5e2\n");
+  CHECK_TRUE(o.rc == 0);
+  CHECK_TRUE(o.rows == 2 && o.nnz == 4);
+  CHECK_TRUE(near(o.labels[0], 1.0) && near(o.labels[1], 0.0));
+  CHECK_TRUE(near(o.weights[1], 3.5));  // label:weight form
+  CHECK_TRUE(o.flags & 1);              // HAS_WEIGHT
+  CHECK_TRUE(o.indices[1] == 7 && near(o.values[1], 2.25));
+  CHECK_TRUE(near(o.values[2], 1e-3) && near(o.values[3], -250.0));
+}
+
+void test_libsvm_qid_and_bare() {
+  SvmOut o = run_libsvm("2 qid:42 3 5\n");
+  CHECK_TRUE(o.rc == 0 && o.rows == 1 && o.nnz == 2);
+  CHECK_TRUE(o.qids[0] == 42 && (o.flags & 2));
+  CHECK_TRUE(near(o.values[0], 1.0) && near(o.values[1], 1.0));  // bare idx
+}
+
+void test_libsvm_errors() {
+  CHECK_TRUE(run_libsvm("not_a_number 1:2\n").rc == -2);  // EPARSE
+  CHECK_TRUE(run_libsvm("1 1:0.5\n0 2:1.5\n", 1).rc == -1);  // EOVERFLOW
+}
+
+void test_libsvm_numeric_edges() {
+  SvmOut o = run_libsvm(
+      "1 1:0.000000000000000000123 2:1e-999999999 3:0." +
+      std::string(420, '0') + "5e450 4:2e999999999\n");
+  CHECK_TRUE(o.rc == 0 && o.nnz == 4);
+  CHECK_TRUE(o.values[0] > 0.0f);                 // leading zeros kept
+  CHECK_TRUE(o.values[1] == 0.0f);                // saturates to 0
+  CHECK_TRUE(near(o.values[2], 5e29, 1e-3));      // compensating exponent
+  CHECK_TRUE(o.values[3] > 1e30f && o.values[3] > 0);  // +inf
+}
+
+void test_libfm() {
+  std::vector<float> labels(8), values(8);
+  std::vector<uint64_t> fields(8), indices(8);
+  std::vector<int64_t> row_nnz(8);
+  int64_t rows, nnz;
+  std::string text = "1 0:1:0.5 3:7:2.5\n0 1:2:-1.5\n";
+  int rc = parse_libfm(text.data(), text.size(), labels.data(),
+                       row_nnz.data(), fields.data(), indices.data(),
+                       values.data(), 8, 8, &rows, &nnz);
+  CHECK_TRUE(rc == 0 && rows == 2 && nnz == 3);
+  CHECK_TRUE(fields[1] == 3 && indices[1] == 7 && near(values[1], 2.5));
+  std::string bad = "1 0:1\n";  // missing third component
+  rc = parse_libfm(bad.data(), bad.size(), labels.data(), row_nnz.data(),
+                   fields.data(), indices.data(), values.data(), 8, 8,
+                   &rows, &nnz);
+  CHECK_TRUE(rc == -2);
+}
+
+void test_csv() {
+  std::vector<float> out(16);
+  int64_t rows, cols;
+  std::string text = "1,0.5,2.5\n0,1.5,-3.5\n";
+  CHECK_TRUE(parse_csv(text.data(), text.size(), out.data(), 4, 3, &rows,
+                       &cols) == 0);
+  CHECK_TRUE(rows == 2 && cols == 3 && near(out[5], -3.5));
+  // inferred column count + empty cells parse as 0
+  std::string text2 = "1,,2\n3,4,\n";
+  CHECK_TRUE(parse_csv(text2.data(), text2.size(), out.data(), 4, 0, &rows,
+                       &cols) == 0);
+  CHECK_TRUE(cols == 3 && near(out[1], 0.0) && near(out[5], 0.0));
+  // ragged row is a parse error
+  std::string text3 = "1,2,3\n4,5\n";
+  CHECK_TRUE(parse_csv(text3.data(), text3.size(), out.data(), 4, 0, &rows,
+                       &cols) == -2);
+}
+
+void test_count_tokens() {
+  int64_t rows, tokens;
+  std::string text = "a bb  ccc\ndd\n\n";
+  count_tokens(text.data(), text.size(), &rows, &tokens);
+  CHECK_TRUE(tokens == 4);
+  CHECK_TRUE(rows >= 3);  // upper bound contract: rows >= real row count
+}
+
+void test_recordio_roundtrip() {
+  // payload containing the magic word mid-record (the adversarial case of
+  // test/recordio_test.cc)
+  const uint32_t kMagic = 0xced7230a;
+  std::string payload = "hello";
+  payload.append(reinterpret_cast<const char*>(&kMagic), 4);
+  payload += "world";
+  std::vector<char> packed(recordio_pack_bound(payload.data(),
+                                               payload.size()));
+  int64_t packed_len =
+      recordio_pack(payload.data(), payload.size(), packed.data());
+  CHECK_TRUE(packed_len > 0 && packed_len % 4 == 0);
+  std::vector<char> out_data(payload.size() + 64);
+  std::vector<int64_t> offsets(4);
+  int64_t nrec, datalen, consumed;
+  CHECK_TRUE(recordio_unpack(packed.data(), packed_len, out_data.data(),
+                             offsets.data(), &nrec, &datalen,
+                             &consumed) == 0);
+  CHECK_TRUE(nrec == 1 && consumed == packed_len);
+  CHECK_TRUE(datalen == static_cast<int64_t>(payload.size()));
+  CHECK_TRUE(std::memcmp(out_data.data(), payload.data(), payload.size()) ==
+             0);
+  CHECK_TRUE(recordio_find_head(packed.data(), packed_len, 0) == 0);
+}
+
+void test_pipeline_end_to_end() {
+  // two files, three parts: exactly-once row coverage through the full
+  // native pipeline (reader thread + workers + ordered queue)
+  char dir_template[] = "/tmp/dmlc_tpu_unit_XXXXXX";
+  CHECK_TRUE(mkdtemp(dir_template) != nullptr);
+  std::string paths_blob;
+  std::vector<int64_t> sizes;
+  std::vector<std::string> paths;
+  int row_id = 0;
+  for (int f = 0; f < 2; ++f) {
+    std::string path = std::string(dir_template) + "/part" +
+                       std::to_string(f) + ".svm";
+    std::string content;
+    for (int i = 0; i < 57; ++i, ++row_id) {
+      content += std::to_string(row_id % 2) + " 1:" +
+                 std::to_string(row_id) + ".25 2:0.5\n";
+    }
+    FILE* fp = std::fopen(path.c_str(), "wb");
+    CHECK_TRUE(fp != nullptr);
+    CHECK_TRUE(std::fwrite(content.data(), 1, content.size(), fp) ==
+               content.size());
+    std::fclose(fp);
+    paths.push_back(path);
+    sizes.push_back(static_cast<int64_t>(content.size()));
+  }
+  for (const std::string& p : paths) {
+    paths_blob += p;
+    paths_blob.push_back('\0');
+  }
+  int64_t total_rows = 0;
+  for (int part = 0; part < 3; ++part) {
+    void* h = ingest_open(paths_blob.data(), sizes.data(), 2, /*libsvm=*/0,
+                          part, 3, /*nthread=*/2, /*chunk=*/1 << 16,
+                          /*capacity=*/4, 0);
+    CHECK_TRUE(h != nullptr);
+    for (;;) {
+      int64_t rows, nnz, ncols;
+      int32_t flags;
+      int rc = ingest_peek(h, &rows, &nnz, &ncols, &flags);
+      CHECK_TRUE(rc >= 0);
+      if (rc == 0) break;
+      std::vector<float> labels(rows), values(nnz);
+      std::vector<int64_t> offsets(rows + 1);
+      std::vector<uint32_t> indices(nnz);
+      CHECK_TRUE(ingest_fetch(h, labels.data(), nullptr, nullptr,
+                              offsets.data(), indices.data(), values.data(),
+                              nullptr) == 1);
+      CHECK_TRUE(offsets[rows] == nnz);
+      total_rows += rows;
+    }
+    CHECK_TRUE(ingest_bytes_read(h) > 0);
+    ingest_close(h);
+  }
+  CHECK_TRUE(total_rows == 114);  // every row in exactly one part
+  for (const std::string& p : paths) std::remove(p.c_str());
+  std::remove(dir_template);
+}
+
+}  // namespace
+
+int main() {
+  CHECK_TRUE(dmlc_tpu_abi_version() >= 1);
+  test_libsvm_basic();
+  test_libsvm_qid_and_bare();
+  test_libsvm_errors();
+  test_libsvm_numeric_edges();
+  test_libfm();
+  test_csv();
+  test_count_tokens();
+  test_recordio_roundtrip();
+  test_pipeline_end_to_end();
+  std::printf("cpp unit tests ok (%d checks)\n", g_checks);
+  return 0;
+}

@@ -197,11 +197,30 @@ class SocketEngine:
     def _tree_children(self) -> List[int]:
         return sorted(r for r in self.tree_links if r != self.parent_rank)
 
+    # Messages at or above this size take the ring (bandwidth-optimal:
+    # 2(n-1)/n bytes per rank vs the tree's up-to-2x at the root); short
+    # messages stay on the tree (latency-optimal: log n hops vs 2(n-1)).
+    # This is the split rabit makes — the tracker builds BOTH topologies for
+    # exactly this reason (tracker.py:193-225).
+    ring_threshold_bytes: int = 1 << 18
+
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
-        """Tree allreduce: reduce up (children in sorted rank order, so the
-        reduction order is deterministic → bit-exact reproducibility), then
-        broadcast the result down."""
+        """Allreduce with rabit's topology split: tree (reduce-up in sorted
+        child order → deterministic, bit-reproducible) for short messages,
+        ring reduce-scatter + allgather for long ones. Both produce a result
+        that is bit-identical across ranks and across repeated calls."""
         check(op in _REDUCERS, "unknown reduce op %s", op)
+        arr = np.asarray(array)
+        if (
+            arr.nbytes >= self.ring_threshold_bytes
+            and self.world_size > 1
+            and self.ring_prev not in (-1, self.rank)
+            and self.ring_next not in (-1, self.rank)
+        ):
+            return self._ring_allreduce(arr, op)
+        return self._tree_allreduce(arr, op)
+
+    def _tree_allreduce(self, array: np.ndarray, op: str) -> np.ndarray:
         reduce_fn = _REDUCERS[op]
         acc = np.asarray(array).copy()
         for child in self._tree_children():
@@ -212,6 +231,66 @@ class SocketEngine:
         for child in self._tree_children():
             self._send_array(self.links[child], acc)
         return acc
+
+    def _ring_step(self, send_id: int, send_chunk: np.ndarray):
+        """One ring exchange: send (id, chunk) to ring_next while receiving
+        (id, chunk) from ring_prev. The send runs on a helper thread so two
+        neighbors pushing large chunks at each other cannot deadlock on
+        full TCP buffers (with world == 2, prev and next are even the same
+        socket — concurrent one-send/one-recv is safe)."""
+        nxt = self.links[self.ring_next]
+        prv = self.links[self.ring_prev]
+        send_err: List[BaseException] = []
+
+        def _send():
+            try:
+                nxt.send_int(send_id)
+                self._send_array(nxt, send_chunk)
+            except BaseException as err:  # re-raised on the caller thread
+                send_err.append(err)
+
+        sender = threading.Thread(target=_send)
+        sender.start()
+        try:
+            recv_id = prv.recv_int()
+            recv_chunk = self._recv_array(prv)
+        finally:
+            sender.join()
+            if send_err:
+                raise DMLCError(
+                    f"ring send to rank {self.ring_next} failed: {send_err[0]}"
+                ) from send_err[0]
+        return recv_id, recv_chunk
+
+    def _ring_allreduce(self, array: np.ndarray, op: str) -> np.ndarray:
+        """Reduce-scatter + allgather around the tracker's ring.
+
+        Chunk ids travel with the data, so no rank needs to know its global
+        ring position — chunk r originates at rank r, accumulates along the
+        ring for n-1 hops (deterministic order: the fixed ring), then the
+        fully-reduced chunks circulate for n-1 more hops. Every rank moves
+        ~2·size·(n-1)/n bytes regardless of n."""
+        reduce_fn = _REDUCERS[op]
+        n = self.world_size
+        flat = array.reshape(-1)
+        chunks = {i: c.copy() for i, c in enumerate(np.array_split(flat, n))}
+
+        # reduce-scatter: forward the chunk just reduced, fold the incoming
+        send_id = self.rank
+        for _ in range(n - 1):
+            recv_id, recv_chunk = self._ring_step(send_id, chunks[send_id])
+            chunks[recv_id] = reduce_fn(chunks[recv_id], recv_chunk)
+            send_id = recv_id
+        # send_id now names this rank's fully-reduced chunk
+
+        # allgather: circulate the completed chunks
+        for _ in range(n - 1):
+            recv_id, recv_chunk = self._ring_step(send_id, chunks[send_id])
+            chunks[recv_id] = recv_chunk
+            send_id = recv_id
+
+        out = np.concatenate([chunks[i] for i in range(n)])
+        return out.reshape(array.shape).astype(array.dtype, copy=False)
 
     def broadcast(self, array: Optional[np.ndarray], root: int = 0) -> np.ndarray:
         """Tree broadcast from any root.

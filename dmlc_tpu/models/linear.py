@@ -233,6 +233,69 @@ def make_linear_train_step(
     return jax.jit(step, donate_argnums=(0, 1))
 
 
+def make_feature_sharded_train_step(
+    mesh: Mesh,
+    objective: str = "logistic",
+    learning_rate: float = 0.1,
+    batch_axis: str = "dp",
+    feature_axis: str = "mp",
+):
+    """dp×mp train step: batch rows sharded over ``batch_axis``, the weight
+    vector (and the feature dim of x) sharded over ``feature_axis``.
+
+    This is the TPU-native analog of the reference's parameter-server mode
+    (PARITY §2.9): parameter state lives sharded across devices instead of
+    on server processes, and the "push/pull" is XLA collectives — a psum of
+    partial margins over ``feature_axis`` (the pull of the full model
+    response) and a psum of gradients over ``batch_axis`` (the push of data
+    shards' updates). Only mp-invariant scalars and [B/dp] vectors cross
+    ICI; the [F/mp] gradient never leaves its shard.
+
+    Layouts (global shapes): x [B, F] sharded (dp, mp); label/weight [B]
+    sharded (dp); params {"w": [F] sharded (mp), "b": replicated}.
+    Returns (step, in_shardings) where in_shardings maps example arrays to
+    ``NamedSharding``s for ``jax.device_put``.
+    """
+    dp = batch_axis
+    mp = feature_axis
+
+    def _step(params, batch_x, batch_y, batch_w):
+        # local shapes: x [B/dp, F/mp], w [F/mp]
+        partial_margin = batch_x @ params["w"]
+        margin = jax.lax.psum(partial_margin, mp) + params["b"]
+        loss, dmargin = _margin_grad(objective, margin, batch_y)
+        wg = batch_w * dmargin
+        # margin is mp-invariant, so wg is too: gw needs only the dp-psum
+        gw = jax.lax.psum(batch_x.T @ wg, dp)
+        gb = jax.lax.psum(jnp.sum(wg), dp)
+        wsum = jax.lax.psum(jnp.sum(batch_w), dp)
+        loss_sum = jax.lax.psum(jnp.sum(batch_w * loss), dp)
+        denom = jnp.maximum(wsum, 1e-12)
+        new_params = {
+            "w": params["w"] - learning_rate * gw / denom,
+            "b": params["b"] - learning_rate * gb / denom,
+        }
+        return new_params, {"loss_sum": loss_sum, "weight_sum": wsum}
+
+    step = jax.jit(
+        jax.shard_map(
+            _step,
+            mesh=mesh,
+            in_specs=({"w": P(mp), "b": P()}, P(dp, mp), P(dp), P(dp)),
+            out_specs=({"w": P(mp), "b": P()}, P()),
+        ),
+        donate_argnums=(0,),
+    )
+    in_shardings = {
+        "x": NamedSharding(mesh, P(dp, mp)),
+        "label": NamedSharding(mesh, P(dp)),
+        "weight": NamedSharding(mesh, P(dp)),
+        "w": NamedSharding(mesh, P(mp)),
+        "b": NamedSharding(mesh, P()),
+    }
+    return step, in_shardings
+
+
 class LinearLearner:
     """Convenience trainer: uri → fitted params (the rabit-SGD loop)."""
 

@@ -91,8 +91,29 @@ def _worker_main(tracker_uri, tracker_port, world, results):
         s1 = engine.allreduce(a)
         s2 = engine.allreduce(a)
         ok_det = np.array_equal(s1, s2)
+        # 6. ring allreduce (long-message path): force the ring by dropping
+        # the threshold; must agree with the tree result elementwise and be
+        # bit-stable across calls. Shape chosen to not divide evenly.
+        ok_ring = True
+        if world > 1:
+            big = np.random.RandomState(100 + rank).rand(4097).astype(np.float32)
+            tree_out = engine.allreduce(big)
+            engine.ring_threshold_bytes = 0
+            ring1 = engine.allreduce(big)
+            ring2 = engine.allreduce(big)
+            ring_max = engine.allreduce(big, op="max")
+            engine.ring_threshold_bytes = SocketEngine.ring_threshold_bytes
+            tree_max = engine.allreduce(big, op="max")
+            ok_ring = (
+                np.array_equal(ring1, ring2)
+                and np.allclose(ring1, tree_out, rtol=1e-6, atol=1e-6)
+                and np.array_equal(ring_max, tree_max)
+            )
         engine.tracker_print(f"worker {rank} done")
-        results.put((rank, ok_sum and ok_max and ok_bcast and ok_gather and ok_det))
+        results.put((
+            rank,
+            ok_sum and ok_max and ok_bcast and ok_gather and ok_det and ok_ring,
+        ))
     finally:
         engine.shutdown()
 

@@ -1,0 +1,26 @@
+"""Native C++ unit tier (cpp/unit_tests.cc) runs green.
+
+The reference builds its gtest tier into one dmlc_unittest binary
+(test/unittest/dmlc_unittest.mk); here `make -C cpp test` builds and runs
+the plain-assert equivalent, and this wrapper keeps it inside `pytest
+tests/`. Skipped when no C++ toolchain is available.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(shutil.which("make") is None or shutil.which("g++") is None,
+                    reason="no native toolchain")
+def test_cpp_unit_tier():
+    proc = subprocess.run(
+        ["make", "-C", os.path.join(REPO, "cpp"), "-s", "test"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "cpp unit tests ok" in proc.stdout
