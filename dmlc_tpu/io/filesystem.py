@@ -398,7 +398,10 @@ class HTTPFileSystem(FileSystem):
 # ---------------------------------------------------------------------------
 
 _fs_factories: Dict[str, Callable[[URI], FileSystem]] = {}
-_fs_instances: Dict[str, FileSystem] = {}
+# instances cache per (protocol, host): hdfs:// instances are bound to
+# their namenode (the reference refcounts per-namenode hdfsFS connections,
+# hdfs_filesys.cc:93-125); object stores ignore the host at construction
+_fs_instances: Dict[tuple, FileSystem] = {}
 _fs_lock = threading.Lock()
 
 
@@ -407,15 +410,19 @@ def register_filesystem(protocol: str, factory: Callable[[URI], FileSystem]) -> 
     compile-gated dispatch table of src/io.cc:31-72, but open for plugins."""
     with _fs_lock:
         _fs_factories[protocol] = factory
-        _fs_instances.pop(protocol, None)
+        for key in [k for k in _fs_instances if k[0] == protocol]:
+            _fs_instances.pop(key, None)
 
 
 def get_filesystem(path: URI) -> FileSystem:
     proto = path.protocol
     if proto in ("s3://", "gs://", "gcs://") and proto not in _fs_factories:
         import dmlc_tpu.io.object_store  # noqa: F401  (self-registers)
+    if proto == "hdfs://" and "hdfs://" not in _fs_factories:
+        import dmlc_tpu.io.webhdfs  # noqa: F401  (self-registers)
     with _fs_lock:
-        inst = _fs_instances.get(proto)
+        key = (proto, path.host)
+        inst = _fs_instances.get(key)
         if inst is None:
             factory = _fs_factories.get(proto)
             if factory is None:
@@ -424,7 +431,7 @@ def get_filesystem(path: URI) -> FileSystem:
                     f"(known: {sorted(_fs_factories)})"
                 )
             inst = factory(path)
-            _fs_instances[proto] = inst
+            _fs_instances[key] = inst
     return inst
 
 
@@ -444,14 +451,12 @@ register_filesystem("file://", lambda uri: LocalFileSystem())
 register_filesystem("mem://", lambda uri: MemoryFileSystem())
 register_filesystem("http://", lambda uri: HTTPFileSystem())
 register_filesystem("https://", lambda uri: HTTPFileSystem())
-register_filesystem(
-    "hdfs://",
-    _gated_backend("hdfs://", "mount the cluster via an hdfs NFS/fuse "
-                   "gateway and use file://, or gs://-migrate the data"),
-)
+# hdfs:// resolves lazily to the WebHDFS backend (io/webhdfs.py) on first
+# use — see get_filesystem
 register_filesystem(
     "viewfs://",
-    _gated_backend("viewfs://", "use an hdfs gateway mount via file://"),
+    _gated_backend("viewfs://", "resolve the mounttable to a concrete "
+                   "hdfs:// namenode, or use an hdfs gateway mount"),
 )
 register_filesystem(
     "azure://",

@@ -1,0 +1,242 @@
+"""HDFS filesystem over the WebHDFS REST API (``hdfs://``).
+
+The reference's HDFS backend wraps libhdfs JNI (src/io/hdfs_filesys.{h,cc}:
+refcounted hdfsFS connections, EINTR-safe reads, compile-gated behind
+DMLC_USE_HDFS). A JVM dependency is the wrong shape for TPU host images, so
+this build speaks WebHDFS — the REST API every namenode serves — with
+nothing beyond the stdlib:
+
+- ``hdfs://host:port/path`` → ``http://host:port/webhdfs/v1/path``; the URI
+  host should name the namenode's **HTTP** address (default port 9870), or
+  set ``DMLC_WEBHDFS_ENDPOINT`` to the REST base to keep RPC-style URIs.
+- reads: ``op=OPEN&offset=N`` through the shared RangedReadStream, so HDFS
+  reads get the same lazy-seek + reconnect-retry behavior as s3/gs
+  (the reference's EINTR retry, hdfs_filesys.cc:31-49, generalized).
+- writes: ``op=CREATE`` then ``op=APPEND`` per buffered part (64 MB default
+  like the object stores), following WebHDFS's two-step redirect dance
+  (namenode 307 → datanode PUT/POST).
+- listing/stat: ``op=LISTSTATUS`` / ``op=GETFILESTATUS``.
+- auth: pseudo-auth ``user.name`` from ``HADOOP_USER_NAME`` (kerberos is
+  out of scope — front the cluster with a gateway, e.g. Knox, and point
+  DMLC_WEBHDFS_ENDPOINT at it).
+
+Tests run against an in-process fake namenode/datanode
+(tests/fake_webhdfs.py) — hermetic coverage the reference never had for
+HDFS (SURVEY §4: manual live-cluster scripts only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import List, Optional
+
+from dmlc_tpu.io.filesystem import (
+    FILE_TYPE_DIR,
+    FILE_TYPE_FILE,
+    FileInfo,
+    FileSystem,
+    RangedReadStream,
+    URI,
+    register_filesystem,
+)
+from dmlc_tpu.io.object_store import ObjectWriteStream
+from dmlc_tpu.io.stream import SeekStream, Stream
+from dmlc_tpu.utils.logging import check
+
+READ_MAX_RETRY = 50
+READ_RETRY_SLEEP_S = 0.1
+DEFAULT_WRITE_BUFFER_MB = 64
+DEFAULT_HTTP_PORT = 9870  # namenode web UI / WebHDFS default
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    """Surface 307s instead of following them: WebHDFS redirects PUT/POST
+    bodies to a datanode, and the client must re-send the body there."""
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        return None
+
+
+_no_redirect_opener = urllib.request.build_opener(_NoRedirect)
+
+
+class WebHDFSFileSystem(FileSystem):
+    """FileSystem speaking WebHDFS (see module docstring)."""
+
+    def __init__(self, uri: URI):
+        endpoint = os.environ.get("DMLC_WEBHDFS_ENDPOINT", "")
+        if endpoint:
+            self._base = endpoint.rstrip("/")
+        else:
+            check(uri.host, "hdfs:// URI needs a namenode host")
+            host = uri.host
+            if ":" not in host:
+                host = f"{host}:{DEFAULT_HTTP_PORT}"
+            self._base = f"http://{host}/webhdfs/v1"
+        self._user = os.environ.get("HADOOP_USER_NAME", "")
+        self._part_bytes = (
+            int(os.environ.get("DMLC_HDFS_WRITE_BUFFER_MB",
+                               DEFAULT_WRITE_BUFFER_MB)) << 20
+        )
+
+    # ---- REST plumbing -------------------------------------------------
+
+    def _url(self, path: str, op: str, **params) -> str:
+        query = {"op": op, **params}
+        if self._user:
+            query["user.name"] = self._user
+        return (
+            self._base
+            + urllib.parse.quote(path)
+            + "?"
+            + urllib.parse.urlencode(query)
+        )
+
+    def _json(self, method: str, path: str, op: str, **params) -> dict:
+        req = urllib.request.Request(
+            self._url(path, op, **params), method=method
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = resp.read()
+        return json.loads(body) if body else {}
+
+    def _two_step_write(self, method: str, path: str, op: str,
+                        data: bytes, **params) -> None:
+        """The CREATE/APPEND dance: ask the namenode (no body), get the 307
+        datanode location, re-send there with the payload."""
+        url = self._url(path, op, **params)
+        req = urllib.request.Request(url, method=method)
+        location = None
+        try:
+            with _no_redirect_opener.open(req, timeout=60) as resp:
+                # some gateways answer 200/201 directly with no redirect
+                location = resp.headers.get("Location")
+        except urllib.error.HTTPError as err:
+            if err.code in (301, 302, 307):
+                location = err.headers.get("Location")
+                err.close()
+            else:
+                raise
+        target = location or url
+        req2 = urllib.request.Request(
+            target, data=data, method=method,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req2, timeout=300):
+            pass
+
+    @staticmethod
+    def _display(path: URI) -> str:
+        return path.str_full()
+
+    # ---- FileSystem interface ------------------------------------------
+
+    def _status(self, path: URI) -> Optional[dict]:
+        try:
+            out = self._json("GET", path.name or "/", "GETFILESTATUS")
+        except urllib.error.HTTPError as err:
+            if err.code == 404:
+                err.close()
+                return None
+            raise
+        return out.get("FileStatus")
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        status = self._status(path)
+        if status is None:
+            raise FileNotFoundError(self._display(path))
+        is_dir = status.get("type") == "DIRECTORY"
+        return FileInfo(
+            path=path,
+            size=0 if is_dir else int(status.get("length", 0)),
+            type=FILE_TYPE_DIR if is_dir else FILE_TYPE_FILE,
+        )
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        out = self._json("GET", path.name or "/", "LISTSTATUS")
+        entries = out.get("FileStatuses", {}).get("FileStatus", [])
+        base = (path.name or "/").rstrip("/")
+        infos: List[FileInfo] = []
+        for st in entries:
+            suffix = st.get("pathSuffix", "")
+            sub_name = f"{base}/{suffix}" if suffix else (base or "/")
+            sub = URI(path.protocol, path.host, sub_name)
+            is_dir = st.get("type") == "DIRECTORY"
+            infos.append(
+                FileInfo(
+                    path=sub,
+                    size=0 if is_dir else int(st.get("length", 0)),
+                    type=FILE_TYPE_DIR if is_dir else FILE_TYPE_FILE,
+                )
+            )
+        infos.sort(key=lambda fi: fi.path.name)
+        return infos
+
+    def open_for_read(
+        self, path: URI, allow_null: bool = False
+    ) -> Optional[SeekStream]:
+        status = self._status(path)
+        if status is None or status.get("type") == "DIRECTORY":
+            if allow_null:
+                return None
+            raise FileNotFoundError(self._display(path))
+        size = int(status.get("length", 0))
+
+        def open_ranged(start: int):
+            # namenode 307s OPEN to a datanode; urllib follows GETs itself
+            return urllib.request.urlopen(
+                self._url(path.name, "OPEN", offset=start), timeout=60
+            )
+
+        return RangedReadStream(
+            open_ranged, size, self._display(path),
+            max_retry=READ_MAX_RETRY, retry_sleep_s=READ_RETRY_SLEEP_S,
+        )
+
+    def open(self, path: URI, flag: str) -> Stream:
+        check(flag in ("r", "w"), "hdfs supports flags r/w, not %s", flag)
+        if flag == "r":
+            stream = self.open_for_read(path)
+            assert stream is not None
+            return stream
+        return _WebHDFSWriteStream(self, path)
+
+
+class _WebHDFSWriteStream(ObjectWriteStream):
+    """Buffered CREATE-then-APPEND writer: the object stores' part-upload
+    base with HDFS's two REST steps. No per-call retry — WebHDFS APPEND is
+    not idempotent, so a blind resend could duplicate bytes; pipeline
+    recovery is HDFS's job. The base's close() marks the stream closed
+    BEFORE the final flush, so a failed close is not re-flushed from
+    __del__."""
+
+    def __init__(self, fs: WebHDFSFileSystem, path: URI):
+        super().__init__(fs._part_bytes)
+        self._fs = fs
+        self._path = path
+        self._created = False
+
+    def _upload_part(self, data: bytes, last: bool) -> None:
+        if not self._created:
+            self._fs._two_step_write(
+                "PUT", self._path.name, "CREATE", data, overwrite="true"
+            )
+            self._created = True
+        elif data:
+            self._fs._two_step_write(
+                "POST", self._path.name, "APPEND", data
+            )
+
+    def _finalize(self) -> None:
+        pass  # every byte is durable once its CREATE/APPEND returned
+
+
+def _factory(uri: URI) -> FileSystem:
+    return WebHDFSFileSystem(uri)
+
+
+register_filesystem("hdfs://", _factory)

@@ -1,0 +1,159 @@
+"""In-process fake WebHDFS namenode+datanode for hermetic hdfs:// tests.
+
+Implements the subset io/webhdfs.py speaks: GETFILESTATUS, LISTSTATUS,
+OPEN (with offset/length and the namenode→datanode 307 redirect), CREATE
+and APPEND (307 then PUT/POST to the /data path). Files live in a dict.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+
+
+class FakeWebHDFS:
+    def __init__(self):
+        self.files: Dict[str, bytes] = {}
+        self.dirs = {"/"}
+        self.open_requests = []  # (path, offset) log for redirect checks
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            # -- helpers --------------------------------------------------
+            def _parse(self) -> Tuple[str, dict]:
+                parsed = urllib.parse.urlsplit(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                return urllib.parse.unquote(parsed.path), query
+
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _not_found(self):
+                self._json(404, {"RemoteException": {
+                    "exception": "FileNotFoundException"}})
+
+            def _status_of(self, path: str) -> dict:
+                if path in fake.files:
+                    return {"pathSuffix": "", "type": "FILE",
+                            "length": len(fake.files[path])}
+                return {"pathSuffix": "", "type": "DIRECTORY", "length": 0}
+
+            # -- GET: status/list/open ------------------------------------
+            def do_GET(self):
+                path, query = self._parse()
+                if path.startswith("/data"):  # "datanode" side of OPEN
+                    real = path[len("/data"):]
+                    data = fake.files.get(real)
+                    if data is None:
+                        return self._not_found()
+                    off = int(query.get("offset", 0))
+                    length = int(query.get("length", len(data) - off))
+                    chunk = data[off:off + length]
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(chunk)))
+                    self.end_headers()
+                    self.wfile.write(chunk)
+                    return
+                assert path.startswith("/webhdfs/v1"), path
+                real = path[len("/webhdfs/v1"):] or "/"
+                op = query.get("op")
+                if op == "GETFILESTATUS":
+                    prefix = real.rstrip("/") + "/"
+                    is_dir = real in fake.dirs or any(
+                        f.startswith(prefix) for f in fake.files
+                    )
+                    if real in fake.files or is_dir:
+                        return self._json(
+                            200, {"FileStatus": self._status_of(real)})
+                    return self._not_found()
+                if op == "LISTSTATUS":
+                    prefix = real.rstrip("/") + "/"
+                    seen = {}
+                    for f, data in fake.files.items():
+                        if not f.startswith(prefix):
+                            continue
+                        rest = f[len(prefix):]
+                        head = rest.split("/", 1)[0]
+                        if "/" in rest:
+                            seen[head] = {"pathSuffix": head,
+                                          "type": "DIRECTORY", "length": 0}
+                        else:
+                            seen[head] = {"pathSuffix": head, "type": "FILE",
+                                          "length": len(data)}
+                    return self._json(200, {"FileStatuses": {
+                        "FileStatus": sorted(seen.values(),
+                                             key=lambda s: s["pathSuffix"])}})
+                if op == "OPEN":
+                    if real not in fake.files:
+                        return self._not_found()
+                    fake.open_requests.append(
+                        (real, int(query.get("offset", 0))))
+                    # namenode redirects to the "datanode" (same server)
+                    loc = (f"http://127.0.0.1:{fake.port}/data{real}?"
+                           + urllib.parse.urlencode(query))
+                    self.send_response(307)
+                    self.send_header("Location", loc)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self._json(400, {"RemoteException": {"exception": "Bad op"}})
+
+            # -- PUT: CREATE ----------------------------------------------
+            def do_PUT(self):
+                path, query = self._parse()
+                if path.startswith("/data"):
+                    real = path[len("/data"):]
+                    n = int(self.headers.get("Content-Length", 0))
+                    fake.files[real] = self.rfile.read(n)
+                    self._json(201, {})
+                    return
+                real = path[len("/webhdfs/v1"):]
+                assert query.get("op") == "CREATE"
+                loc = (f"http://127.0.0.1:{fake.port}/data{real}?"
+                       + urllib.parse.urlencode(query))
+                self.send_response(307)
+                self.send_header("Location", loc)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            # -- POST: APPEND ---------------------------------------------
+            def do_POST(self):
+                path, query = self._parse()
+                if path.startswith("/data"):
+                    real = path[len("/data"):]
+                    n = int(self.headers.get("Content-Length", 0))
+                    fake.files[real] = fake.files.get(real, b"") \
+                        + self.rfile.read(n)
+                    self._json(200, {})
+                    return
+                real = path[len("/webhdfs/v1"):]
+                assert query.get("op") == "APPEND"
+                loc = (f"http://127.0.0.1:{fake.port}/data{real}?"
+                       + urllib.parse.urlencode(query))
+                self.send_response(307)
+                self.send_header("Location", loc)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
