@@ -169,17 +169,24 @@ def checkpoint(state: Any, uri: Optional[str] = None) -> None:
     Serializable + Stream::Create (io.h:112-126, SURVEY §5.4).
     """
     global _version, _checkpoint_blob
-    stream = MemoryStream()
-    save_obj(stream, state)
-    _checkpoint_blob = stream.getvalue()
     _version += 1
+    stream = MemoryStream()
+    # the version travels inside the blob so a restarted process (or a
+    # recovering worker reloading the shared URI) resynchronizes
+    # version_number() with the snapshot it resumes from
+    save_obj(stream, ("dmlc_ckpt_v1", _version, state))
+    _checkpoint_blob = stream.getvalue()
     if uri:
         with create_stream(uri, "w") as out:
             out.write(_checkpoint_blob)
 
 
 def load_checkpoint(uri: Optional[str] = None) -> Optional[Any]:
-    """Return (latest checkpoint state) or None if none exists."""
+    """Return (latest checkpoint state) or None if none exists.
+
+    Also restores ``version_number()`` to the loaded snapshot's version, so
+    version-gated loops agree across restarted and surviving workers.
+    """
     global _version, _checkpoint_blob
     blob = _checkpoint_blob
     if blob is None and uri:
@@ -195,12 +202,103 @@ def load_checkpoint(uri: Optional[str] = None) -> Optional[Any]:
             _checkpoint_blob = blob
     if blob is None:
         return None
-    return load_obj(MemoryStream(blob))
+    payload = load_obj(MemoryStream(blob))
+    if (
+        isinstance(payload, tuple)
+        and len(payload) == 3
+        and payload[0] == "dmlc_ckpt_v1"
+    ):
+        _version = int(payload[1])
+        return payload[2]
+    return payload  # pre-versioned blob: state as written
 
 
 def version_number() -> int:
     """Number of checkpoints taken (rabit.version_number)."""
     return _version
+
+
+def reinit_recover() -> None:
+    """Re-enter the job after a collective failure (tracker cmd='recover').
+
+    Drops every peer link without notifying the tracker, reconnects keeping
+    the same rank AND the original engine's tracker address/jobid, and
+    clears the in-memory checkpoint blob so the next ``load_checkpoint(uri)``
+    reads the *shared* URI — the one state every worker (including a freshly
+    restarted process) can agree on. The reference tracker's recover
+    re-entry (tracker.py:279-291) is the other half of this handshake.
+
+    If the rendezvous itself fails (tracker transiently unreachable), the
+    aborted engine stays in place: its collectives fail fast with DMLCError,
+    so a surrounding ``run_with_recovery`` loop can try again.
+    """
+    global _engine, _checkpoint_blob
+    with _engine_lock:
+        check(
+            isinstance(_engine, SocketEngine),
+            "reinit_recover requires an active socket engine",
+        )
+        old = _engine
+        old.abort()
+        _checkpoint_blob = None
+        _engine = SocketEngine(
+            tracker_uri=old.tracker_uri,
+            tracker_port=old.tracker_port,
+            rank=old.rank,
+            world_size=old.world_size,
+            jobid=old.jobid,
+            cmd="recover",
+            connect_retry=old.connect_retry,
+        )
+
+
+def run_with_recovery(round_fn, max_attempts: int = 3,
+                      recover_on=(DMLCError, OSError)):
+    """rabit's checkpoint-replay pattern around one unit of collective work.
+
+    Runs ``round_fn()``; if a collective fails (a peer died — surfaced as a
+    socket/DMLC error), re-rendezvouses with ``reinit_recover`` and calls
+    ``round_fn`` again. The contract for ``round_fn``: it must START from
+    checkpoint state (``load_checkpoint(uri)``) so a replay resumes from the
+    last agreed snapshot; its collectives must be deterministic — a worker
+    that already finished the round replays it bit-identically while the
+    restarted worker catches up; and every worker must run the same
+    ``round_fn`` granularity (SPMD), so the abort cascade finds all peers
+    inside a collective or about to enter one. Handle non-collective I/O
+    that can fail persistently (e.g. checkpoint uploads) inside ``round_fn``
+    or narrow ``recover_on`` — an exception matching ``recover_on`` is
+    treated as a peer failure and triggers a world-wide re-rendezvous.
+
+    Failure cascades by construction: ``abort()`` closes all of this
+    worker's links, so every neighbor's in-flight collective errors too and
+    the whole world re-enters rendezvous together (world-size changes are
+    not supported; the restarted process must come back with the same
+    jobid/rank).
+    """
+    import time as _time
+
+    attempt = 0
+    while True:
+        try:
+            return round_fn()
+        except recover_on as err:
+            attempt += 1
+            with _engine_lock:
+                recoverable = isinstance(_engine, SocketEngine)
+            if not recoverable or attempt >= max_attempts:
+                raise
+            log_info(
+                "collective failure (%s); recovering, attempt %d/%d",
+                err, attempt, max_attempts,
+            )
+            try:
+                reinit_recover()
+            except (DMLCError, OSError) as rerr:
+                # rendezvous failed (e.g. tracker unreachable): the aborted
+                # engine fails fast on the next round_fn, which brings us
+                # back here to try again until attempts run out
+                log_info("recover rendezvous failed (%s); will retry", rerr)
+                _time.sleep(1.0)
 
 
 __all__ = [
@@ -216,6 +314,8 @@ __all__ = [
     "checkpoint",
     "load_checkpoint",
     "version_number",
+    "reinit_recover",
+    "run_with_recovery",
     "psum",
     "pmean",
     "pmax",

@@ -72,8 +72,10 @@ class SocketEngine:
         )
         check(self.tracker_uri, "no tracker address (DMLC_TRACKER_URI unset)")
         self.jobid = jobid or os.environ.get("DMLC_TASK_ID", "NULL")
+        self.connect_retry = connect_retry
         self.rank = rank
         self.world_size = world_size
+        self._aborted = False
         self.parent_rank = -1
         self.ring_prev = -1
         self.ring_next = -1
@@ -210,6 +212,8 @@ class SocketEngine:
         ring reduce-scatter + allgather for long ones. Both produce a result
         that is bit-identical across ranks and across repeated calls."""
         check(op in _REDUCERS, "unknown reduce op %s", op)
+        check(not self._aborted,
+              "engine aborted (pending recover); reinit before collectives")
         arr = np.asarray(array)
         if (
             arr.nbytes >= self.ring_threshold_bytes
@@ -362,14 +366,25 @@ class SocketEngine:
         conn.send_str(msg)
         conn.close()
 
-    def shutdown(self) -> None:
+    def abort(self) -> None:
+        """Drop every peer link and the listener WITHOUT telling the tracker
+        — the worker is coming back with cmd='recover'. Closing all links
+        (not just the failed one) is load-bearing: peers blocked in a
+        collective on this worker get a socket error, abort too, and the
+        failure cascades through the tree so the whole world re-enters
+        rendezvous together (rabit's abort-and-recover semantics)."""
+        self._aborted = True
         for peer in self.links.values():
             peer.close()
         self.links.clear()
+        if self._listener is not None:
+            self._listener.close()
+            self._listener = None
+
+    def shutdown(self) -> None:
+        self.abort()
         try:
             conn = self._dial_tracker("shutdown")
             conn.close()
         except (DMLCError, OSError):
             pass
-        if self._listener is not None:
-            self._listener.close()
