@@ -161,18 +161,19 @@ class FMLearner:
         )
 
     def fit_feed(self, feed, epochs: int = 1):
+        from dmlc_tpu.models.linear import EpochMetrics
+
         check(feed.spec.layout == "csr", "FM consumes csr batches")
         history = []
         for epoch in range(epochs):
-            loss_sum = weight_sum = 0.0
+            acc = EpochMetrics()
             for batch in feed:
                 self._ensure(self.param.num_features)
                 self.params, metrics = self._step(
                     self.params, step_batch(batch, "csr")
                 )
-                loss_sum += float(metrics["loss_sum"])
-                weight_sum += float(metrics["weight_sum"])
-            history.append(loss_sum / max(weight_sum, 1e-12))
+                acc.add(metrics)
+            history.append(acc.mean_loss())
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

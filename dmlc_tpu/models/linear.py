@@ -296,6 +296,28 @@ def make_feature_sharded_train_step(
     return step, in_shardings
 
 
+class EpochMetrics:
+    """Collect per-step device metric scalars with no per-step dispatch or
+    host sync; reading does one batched device_get. Shared by the learners'
+    fit loops (a per-step ``float()`` stalls the feed's batch-in-flight
+    overlap; a per-step device add pays dispatch overhead per step)."""
+
+    def __init__(self):
+        self._loss = []
+        self._weight = []
+
+    def add(self, metrics: Dict) -> None:
+        self._loss.append(metrics["loss_sum"])
+        self._weight.append(metrics["weight_sum"])
+
+    def mean_loss(self) -> float:
+        if not self._loss:
+            return 0.0
+        loss = float(np.sum(jax.device_get(self._loss)))
+        weight = float(np.sum(jax.device_get(self._weight)))
+        return loss / max(weight, 1e-12)
+
+
 class LinearLearner:
     """Convenience trainer: uri → fitted params (the rabit-SGD loop)."""
 
@@ -333,25 +355,21 @@ class LinearLearner:
         layout = feed.spec.layout
         history = []
         for epoch in range(epochs):
-            loss_sum = 0.0
-            weight_sum = 0.0
+            acc = EpochMetrics()
             nstep = 0
             for batch in feed:
                 self._ensure(feed.spec.num_features, layout)
                 self.params, self.velocity, metrics = self._step(
                     self.params, self.velocity, step_batch(batch, layout)
                 )
-                loss_sum += float(metrics["loss_sum"])
-                weight_sum += float(metrics["weight_sum"])
+                acc.add(metrics)
                 nstep += 1
                 if log_every and nstep % log_every == 0:
                     log_info(
                         "epoch %d step %d loss %.6f",
-                        epoch,
-                        nstep,
-                        loss_sum / max(weight_sum, 1e-12),
+                        epoch, nstep, acc.mean_loss(),
                     )
-            history.append(loss_sum / max(weight_sum, 1e-12))
+            history.append(acc.mean_loss())
             if epoch + 1 < epochs:
                 feed.before_first()
         return history
