@@ -34,6 +34,7 @@ from dmlc_tpu.io.recordio import (
     RecordIOWriter,
     RecordIOReader,
     RecordIOChunkReader,
+    build_index,
 )
 from dmlc_tpu.io.input_split import InputSplit, create_input_split
 
@@ -58,6 +59,7 @@ __all__ = [
     "list_split_files",
     "URISpec",
     "RECORDIO_MAGIC",
+    "build_index",
     "RecordIOWriter",
     "RecordIOReader",
     "RecordIOChunkReader",

@@ -236,3 +236,57 @@ class RecordIOChunkReader:
             if rec is None:
                 return
             yield rec
+
+
+def build_index(uri: str, index_uri: str) -> int:
+    """Write an IndexedRecordIO index file for an existing RecordIO file.
+
+    The reference consumes index files but ships no builder (they come from
+    downstream tooling like mxnet's im2rec); this walks the framing and
+    emits the ``key<TAB>offset`` text format ReadIndexFile expects
+    (indexed_recordio_split.cc:43-61), one line per record (multi-part
+    records index their first frame). Returns the record count.
+    """
+    from dmlc_tpu.io.filesystem import create_stream, create_stream_for_read
+
+    entries: List[Tuple[int, int]] = []
+    pos = 0
+    # bytearray: extend/compact are amortized linear even when one frame
+    # spans many reads (bytes concatenation would go quadratic there)
+    pending = bytearray()
+    record_start = -1  # offset of the current record's first frame
+    stream = create_stream_for_read(uri)
+    try:
+        while True:
+            data = stream.read(4 << 20)
+            if not data:
+                break
+            pending += data
+            off = 0
+            while off + 8 <= len(pending):
+                magic, lrec = struct.unpack_from("<II", pending, off)
+                check(magic == RECORDIO_MAGIC, "Invalid RecordIO format")
+                cflag = decode_flag(lrec)
+                frame = 8 + ((decode_length(lrec) + 3) & ~3)
+                if off + frame > len(pending):
+                    break
+                if cflag in (0, 1):
+                    check(record_start < 0, "Invalid RecordIO format")
+                    record_start = pos + off
+                else:
+                    check(record_start >= 0, "Invalid RecordIO format")
+                if cflag in (0, 3):
+                    entries.append((len(entries), record_start))
+                    record_start = -1
+                off += frame
+            pos += off
+            del pending[:off]
+        check(not pending and record_start < 0,
+              "truncated RecordIO file: trailing partial record")
+    finally:
+        stream.close()
+    with create_stream(index_uri, "w") as out:
+        out.write(
+            "".join(f"{k}\t{offset}\n" for k, offset in entries).encode()
+        )
+    return len(entries)

@@ -305,17 +305,23 @@ class EpochMetrics:
     def __init__(self):
         self._loss = []
         self._weight = []
+        self._loss_total = 0.0
+        self._weight_total = 0.0
 
     def add(self, metrics: Dict) -> None:
         self._loss.append(metrics["loss_sum"])
         self._weight.append(metrics["weight_sum"])
 
     def mean_loss(self) -> float:
-        if not self._loss:
-            return 0.0
-        loss = float(np.sum(jax.device_get(self._loss)))
-        weight = float(np.sum(jax.device_get(self._weight)))
-        return loss / max(weight, 1e-12)
+        if self._loss:
+            # drain pending scalars into the running totals so repeated
+            # reads (log_every) never re-fetch what was already summed
+            loss, weight = jax.device_get((self._loss, self._weight))
+            self._loss_total += float(np.sum(loss))
+            self._weight_total += float(np.sum(weight))
+            self._loss.clear()
+            self._weight.clear()
+        return self._loss_total / max(self._weight_total, 1e-12)
 
 
 class LinearLearner:

@@ -52,6 +52,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--n", type=int, default=500)
     ap.add_argument("--nsplit", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--write-index", metavar="INDEX_URI", default="",
+                    help="also build an IndexedRecordIO index file and "
+                         "verify a record-count-partitioned read through it")
     args = ap.parse_args(argv)
 
     records = _gen_records(args.n, args.seed)
@@ -98,6 +101,27 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"({len(got_all)} vs {len(records)} records)", file=sys.stderr)
         return 1
     print(f"chunk read ok across {args.nsplit} parts")
+
+    if args.write_index:
+        from dmlc_tpu.io import build_index, create_input_split
+
+        n = build_index(args.uri, args.write_index)
+        if n != len(records):
+            print(f"ERROR: index has {n} records, wrote {len(records)}",
+                  file=sys.stderr)
+            return 1
+        got_idx = []
+        for part in range(args.nsplit):
+            split = create_input_split(
+                args.uri, part, args.nsplit, "indexed_recordio",
+                index_uri=args.write_index,
+            )
+            got_idx.extend(bytes(r) for r in split.records())
+            split.close()
+        if sorted(got_idx) != sorted(records):
+            print("ERROR: indexed read mismatch", file=sys.stderr)
+            return 1
+        print(f"indexed read ok: {n} records via {args.write_index}")
     return 0
 
 

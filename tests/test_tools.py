@@ -95,6 +95,21 @@ class TestRecordIOTool:
         assert int(first.split()[3]) > 0, first
 
 
+class TestRecordIOIndexBuild:
+    def test_write_index_and_indexed_read(self, tmp_path, capsys):
+        path = str(tmp_path / "adv.rec")
+        idx = str(tmp_path / "adv.rec.idx")
+        assert tool_recordio.main(
+            [path, "--n", "120", "--nsplit", "3", "--write-index", idx]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "indexed read ok: 120 records" in out
+        # index format: key<TAB>offset lines, offsets ascending
+        offs = [int(line.split("\t")[1])
+                for line in open(idx).read().splitlines()]
+        assert offs == sorted(offs) and offs[0] == 0 and len(offs) == 120
+
+
 class TestFilesys:
     def test_ls_cat_cp(self, tmp_path, capsys):
         src = tmp_path / "a.txt"
