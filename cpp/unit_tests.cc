@@ -256,6 +256,47 @@ void test_pipeline_end_to_end() {
   std::remove(dir_template);
 }
 
+void test_pipeline_early_close() {
+  // tear the pipeline down while the reader and workers are mid-stream —
+  // the cancellation path where lifetime races hide (run under TSan/ASan
+  // by make test_tsan / test_asan)
+  char dir_template[] = "/tmp/dmlc_tpu_unit_close_XXXXXX";
+  CHECK_TRUE(mkdtemp(dir_template) != nullptr);
+  std::string path = std::string(dir_template) + "/big.svm";
+  std::string content;
+  for (int i = 0; i < 20000; ++i) {
+    content += std::to_string(i % 2) + " 1:0.125 2:0.5 3:0.75\n";
+  }
+  FILE* fp = std::fopen(path.c_str(), "wb");
+  CHECK_TRUE(fp != nullptr);
+  CHECK_TRUE(std::fwrite(content.data(), 1, content.size(), fp) ==
+             content.size());
+  std::fclose(fp);
+  std::string blob = path;
+  blob.push_back('\0');
+  int64_t size = static_cast<int64_t>(content.size());
+  for (int round = 0; round < 6; ++round) {
+    void* h = ingest_open(blob.data(), &size, 1, 0, 0, 1, /*nthread=*/4,
+                          /*chunk=*/1 << 14, /*capacity=*/2, 0);
+    CHECK_TRUE(h != nullptr);
+    // consume `round` blocks, then close with work still in flight
+    for (int k = 0; k < round; ++k) {
+      int64_t rows, nnz, ncols;
+      int32_t flags;
+      if (ingest_peek(h, &rows, &nnz, &ncols, &flags) != 1) break;
+      std::vector<float> labels(rows), values(nnz);
+      std::vector<int64_t> offsets(rows + 1);
+      std::vector<uint32_t> indices(nnz);
+      CHECK_TRUE(ingest_fetch(h, labels.data(), nullptr, nullptr,
+                              offsets.data(), indices.data(), values.data(),
+                              nullptr) == 1);
+    }
+    ingest_close(h);
+  }
+  std::remove(path.c_str());
+  std::remove(dir_template);
+}
+
 }  // namespace
 
 int main() {
@@ -269,6 +310,7 @@ int main() {
   test_count_tokens();
   test_recordio_roundtrip();
   test_pipeline_end_to_end();
+  test_pipeline_early_close();
   std::printf("cpp unit tests ok (%d checks)\n", g_checks);
   return 0;
 }

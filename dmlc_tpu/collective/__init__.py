@@ -18,7 +18,6 @@ JAX runtime → device; else local.
 
 from __future__ import annotations
 
-import io
 import os
 import threading
 from typing import Any, List, Optional

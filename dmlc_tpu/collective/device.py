@@ -9,13 +9,12 @@ mesh via jax.experimental.multihost_utils.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 # ---- in-jit collectives (use inside shard_map/pjit-ed functions) ----------

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import os
 import socket
-import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional

@@ -10,7 +10,7 @@ src/data.cc:87-128 — cache file present selects the disk iterator).
 from __future__ import annotations
 
 import os
-from typing import Iterator, List, Optional
+from typing import Iterator, Optional
 
 from dmlc_tpu.data.parsers import Parser, create_parser
 from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import struct
 import sys
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 

@@ -26,7 +26,7 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from dmlc_tpu.io.stream import Stream
-from dmlc_tpu.utils.logging import DMLCError, check
+from dmlc_tpu.utils.logging import check
 
 RECORDIO_MAGIC = 0xCED7230A
 _MAGIC_BYTES = struct.pack("<I", RECORDIO_MAGIC)

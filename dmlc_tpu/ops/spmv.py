@@ -50,7 +50,7 @@ def make_sharded_spmv(mesh, num_rows: int, axis: str = "dp"):
     segments). Returns f(values, indices, row_ids, weight_vec) -> [num_rows]
     sharded on the leading axis.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     n_shards = mesh.shape[axis]
     assert num_rows % n_shards == 0, "num_rows must divide over the mesh axis"

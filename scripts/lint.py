@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Repo lint (the reference's scripts/lint.py role, stdlib-only).
+
+Python tier: per-file AST checks — syntax, unused imports, bare excepts,
+tab indentation. C++ tier: g++ -fsyntax-only -Wall -Wextra -Werror over
+cpp/*.cc. Exit non-zero on any finding.
+
+Usage: python scripts/lint.py [paths...]   (default: the whole repo)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+from typing import Iterator, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules"}
+
+
+def _py_files(roots: List[str]) -> Iterator[str]:
+    for root in roots:
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+            for name in filenames:
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+class _ImportTracker(ast.NodeVisitor):
+    def __init__(self):
+        self.imported = {}  # name -> lineno
+        self.used = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = (alias.asname or alias.name).split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return  # compiler directives, not names
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.imported[alias.asname or alias.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def lint_python(path: str) -> List[str]:
+    problems = []
+    with open(path, "rb") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as err:
+        return [f"{path}:{err.lineno}: syntax error: {err.msg}"]
+    tracker = _ImportTracker()
+    tracker.visit(tree)
+    text = src.decode("utf-8", "replace")
+    # names referenced anywhere (incl. inside strings for __all__ re-exports
+    # and docstring references is too loose — use AST names + dunder-all)
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        exported |= {
+                            elt.value
+                            for elt in node.value.elts
+                            if isinstance(elt, ast.Constant)
+                        }
+    for name, lineno in sorted(tracker.imported.items()):
+        if name in tracker.used or name in exported:
+            continue
+        if name.startswith("_"):
+            continue
+        # keep imports marked as deliberate side-effect registrations
+        line = text.splitlines()[lineno - 1]
+        if "noqa" in line:
+            continue
+        problems.append(f"{path}:{lineno}: unused import '{name}'")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(
+                f"{path}:{node.lineno}: bare 'except:' (catch something)"
+            )
+    for i, line in enumerate(text.splitlines(), 1):
+        if line.startswith("\t"):
+            problems.append(f"{path}:{i}: tab indentation")
+    return problems
+
+
+def lint_cpp() -> List[str]:
+    cpp_dir = os.path.join(REPO, "cpp")
+    if not os.path.isdir(cpp_dir):
+        return []
+    sources = [
+        os.path.join(cpp_dir, f)
+        for f in sorted(os.listdir(cpp_dir))
+        if f.endswith(".cc")
+    ]
+    if not sources:
+        return []
+    proc = subprocess.run(
+        ["g++", "-std=c++17", "-fsyntax-only", "-Wall", "-Wextra",
+         "-Werror"] + sources,
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        return [line for line in proc.stderr.splitlines() if line.strip()]
+    return []
+
+
+def main(argv: List[str]) -> int:
+    roots = argv or [
+        os.path.join(REPO, "dmlc_tpu"),
+        os.path.join(REPO, "tests"),
+        os.path.join(REPO, "examples"),
+        os.path.join(REPO, "scripts"),
+        os.path.join(REPO, "bench.py"),
+        os.path.join(REPO, "__graft_entry__.py"),
+    ]
+    problems: List[str] = []
+    nfiles = 0
+    for path in _py_files(roots):
+        nfiles += 1
+        problems.extend(lint_python(path))
+    problems.extend(lint_cpp())
+    for p in problems:
+        print(p)
+    print(f"lint: {nfiles} python files + cpp/, {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -3,7 +3,6 @@
 link-map topology unit tests and the rabit-style API."""
 
 import multiprocessing as mp
-import os
 
 import numpy as np
 import pytest

@@ -24,3 +24,26 @@ def test_cpp_unit_tier():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "cpp unit tests ok" in proc.stdout
+
+
+@pytest.mark.skipif(shutil.which("make") is None or shutil.which("g++") is None,
+                    reason="no native toolchain")
+@pytest.mark.parametrize("target", ["test_asan", "test_tsan"])
+def test_cpp_sanitizer_tiers(target):
+    """ASan+UBSan and TSan over the same unit binary (SURVEY §5.2: the
+    reference configures no sanitizers; the threaded pipeline and its
+    cancellation paths run clean under both here). Skipped when the
+    toolchain lacks the sanitizer runtimes."""
+    build = subprocess.run(
+        ["make", "-C", os.path.join(REPO, "cpp"), "-s",
+         target.replace("test_", "unit_tests_")],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitizer build unavailable: {build.stderr[-200:]}")
+    proc = subprocess.run(
+        ["make", "-C", os.path.join(REPO, "cpp"), "-s", target],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "cpp unit tests ok" in proc.stdout

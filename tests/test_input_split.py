@@ -2,7 +2,6 @@
 (the property SURVEY §7 flags as easy to get subtly wrong; modeled on
 test/split_read_test.cc + recordio_test.cc)."""
 
-import os
 
 import numpy as np
 import pytest

@@ -3,9 +3,7 @@ hermetic coverage SURVEY §4 notes the reference lacked (it tested S3 against
 the live service only, test/README.md:3-31)."""
 
 import os
-import urllib.request
 
-import numpy as np
 import pytest
 
 from dmlc_tpu.io.filesystem import (

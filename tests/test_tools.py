@@ -6,7 +6,6 @@ once via ``python -m`` for the dispatcher path.
 """
 
 import os
-import struct
 import subprocess
 import sys
 
