@@ -237,7 +237,7 @@ class Pipeline {
  public:
   Pipeline(std::vector<std::string> paths, std::vector<int64_t> sizes,
            int format, int part, int nparts, int nthread, int64_t chunk_bytes,
-           int capacity, int64_t csv_expect_cols)
+           int capacity, int64_t csv_expect_cols, bool push_mode = false)
       : paths_(std::move(paths)),
         sizes_(std::move(sizes)),
         format_(format),
@@ -246,22 +246,78 @@ class Pipeline {
         nthread_(nthread < 1 ? 1 : nthread),
         chunk_bytes_(chunk_bytes < (1 << 16) ? (1 << 16) : chunk_bytes),
         out_capacity_(capacity < 2 ? 2 : capacity),
-        csv_expect_cols_(csv_expect_cols) {}
+        csv_expect_cols_(csv_expect_cols),
+        push_mode_(push_mode) {}
 
   ~Pipeline() { Close(); }
 
   void Start() {
-    reader_ = std::thread([this] {
-      try {
-        ReaderMain();
-      } catch (const std::bad_alloc&) {
-        Fail(kEOom);
-      }
-    });
+    if (!push_mode_) {
+      reader_ = std::thread([this] {
+        try {
+          ReaderMain();
+        } catch (const std::bad_alloc&) {
+          Fail(kEOom);
+        }
+      });
+    }
     for (int i = 0; i < nthread_; ++i) {
       workers_.emplace_back([this] { WorkerMain(); });
     }
   }
+
+  // ---- push mode: the caller is the reader ----------------------------
+  // Bytes arrive from Python-fetched remote chunks (parallel range-GET
+  // readahead over gs://, s3://, hdfs://) instead of local fopen. The
+  // caller must deliver the partition's byte range [begin, end) in order;
+  // record-boundary cutting, parse fan-out and ordered delivery are the
+  // same machinery the file reader uses. Blocks for backpressure when the
+  // work queue is full (the ctypes call releases the GIL, so the Python
+  // fetchers keep running). Returns 0, or the pipeline's error code.
+  int Push(const char* data, int64_t len) {
+    if (!push_mode_) return kEIo;
+    int64_t off = 0;
+    while (off < len) {
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (stop_) return kEIo;
+        if (error_ != 0) return error_;
+      }
+      int64_t want = std::min<int64_t>(len - off, chunk_bytes_);
+      if (!push_tail_.Reserve(push_tail_.size + want)) {
+        Fail(kEOom);
+        return kEOom;
+      }
+      std::memcpy(push_tail_.p + push_tail_.size, data + off,
+                  static_cast<size_t>(want));
+      push_tail_.size += want;
+      off += want;
+      if (push_tail_.size < chunk_bytes_) continue;
+      int64_t cut = LastRecordBegin(push_tail_);
+      if (cut == 0) continue;  // no boundary yet: keep accumulating
+      if (!EmitPushChunk(cut)) return kEIo;
+    }
+    return 0;
+  }
+
+  // Flush the remaining tail (the caller guarantees the pushed range ends
+  // at a record boundary, so the tail is whole records) and close the
+  // stream. Idempotent. Returns 0, or the pipeline's error code.
+  int PushEof() {
+    if (!push_mode_) return kEIo;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (reader_done_) return error_;
+      if (error_ != 0) return error_;
+    }
+    if (push_tail_.size > 0 && !EmitPushChunk(push_tail_.size)) return kEIo;
+    FinishReader(push_seq_);
+    return 0;
+  }
+
+  // The Python feeder hit an unrecoverable fetch error: fail the pipeline
+  // so blocked consumers wake with an error instead of hanging.
+  void PushAbort() { Fail(kEIo); }
 
   // Wait for the next in-order block without consuming it.
   // 1 = block staged (sizes via *out), 0 = end of stream, <0 = error.
@@ -348,6 +404,33 @@ class Pipeline {
   }
 
  private:
+  // Move the first `cut` bytes of push_tail_ into a work chunk; the
+  // remainder becomes the new tail. False when the pipeline stopped.
+  bool EmitPushChunk(int64_t cut) {
+    Chunk* chunk = AcquireChunk();
+    if (chunk == nullptr) return false;
+    chunk->data.Swap(push_tail_);
+    int64_t rest = chunk->data.size - cut;
+    push_tail_.size = 0;
+    if (rest > 0) {
+      if (!push_tail_.Reserve(rest)) {
+        delete chunk;
+        Fail(kEOom);
+        return false;
+      }
+      std::memcpy(push_tail_.p, chunk->data.p + cut,
+                  static_cast<size_t>(rest));
+      push_tail_.size = rest;
+    }
+    chunk->data.size = cut;
+    if (cut == 0) {
+      ReleaseChunk(chunk);
+      return true;
+    }
+    chunk->seq = push_seq_++;
+    return PushWork(chunk);
+  }
+
   // ---- reader side ----------------------------------------------------
   // adj(x): first record-begin at global offset >= x (0 stays 0). Scans to
   // the first EOL char then consumes the whole EOL run, the LineSplitter
@@ -477,10 +560,14 @@ class Pipeline {
 
   Chunk* AcquireChunk() {
     std::unique_lock<std::mutex> lk(mu_);
+    // error_ must wake a backpressure-blocked producer (the push-mode
+    // feeder especially: workers that exited on error stop draining work_,
+    // and PushAbort/Fail would otherwise never unblock it)
     cv_work_space_.wait(lk, [this] {
-      return stop_ || static_cast<int>(work_.size()) < nthread_ * 2;
+      return stop_ || error_ != 0 ||
+             static_cast<int>(work_.size()) < nthread_ * 2;
     });
-    if (stop_) return nullptr;
+    if (stop_ || error_ != 0) return nullptr;
     if (!free_chunks_.empty()) {
       Chunk* c = free_chunks_.back();
       free_chunks_.pop_back();
@@ -649,6 +736,11 @@ class Pipeline {
   const int64_t chunk_bytes_;
   const int out_capacity_;
   const int64_t csv_expect_cols_;
+  const bool push_mode_;
+
+  // push-mode state: only touched by the single pushing thread
+  Buf push_tail_;
+  int64_t push_seq_ = 0;
 
   std::thread reader_;
   std::vector<std::thread> workers_;
@@ -691,6 +783,34 @@ void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
                    nparts, nthread, chunk_bytes, capacity, csv_expect_cols);
   pl->Start();
   return pl;
+}
+
+// Push-mode pipeline: no reader thread — the caller streams the partition's
+// bytes in with ingest_push (Python-fetched remote chunks feed the same
+// native parse workers and ordered queue as local files). End the stream
+// with ingest_push_eof; on a fetch failure call ingest_push_abort so
+// consumers blocked in ingest_peek fail instead of hanging.
+void* ingest_open_push(int32_t format, int32_t nthread, int64_t chunk_bytes,
+                       int32_t capacity, int64_t csv_expect_cols) {
+  if (format < 0 || format > 2) return nullptr;
+  Pipeline* pl = new Pipeline({}, {}, format, 0, 1, nthread, chunk_bytes,
+                              capacity, csv_expect_cols, /*push_mode=*/true);
+  pl->Start();
+  return pl;
+}
+
+// Append len bytes of the partition stream. Blocks for backpressure when
+// the parse workers are behind. Returns 0 or a pipeline error code.
+int ingest_push(void* handle, const char* data, int64_t len) {
+  return static_cast<Pipeline*>(handle)->Push(data, len);
+}
+
+int ingest_push_eof(void* handle) {
+  return static_cast<Pipeline*>(handle)->PushEof();
+}
+
+void ingest_push_abort(void* handle) {
+  static_cast<Pipeline*>(handle)->PushAbort();
 }
 
 // Wait for the next in-order block and report its sizes without consuming

@@ -409,15 +409,55 @@ def _csv_table_to_block(
     return out
 
 
+def _feed_pipeline(pipe, reader, error_holder: list) -> None:
+    """Remote-ingest feeder thread: in-order readahead buffers → native
+    push ABI. ``push`` blocks for backpressure; a *fetch* failure is
+    recorded in ``error_holder`` and aborts the pipeline so a consumer
+    blocked in next_block wakes with an error instead of hanging. A push
+    failure means the pipeline itself already failed (parse error, close)
+    — nothing is recorded, the consumer sees the pipeline's own error.
+
+    Module-level on purpose: the thread must hold no reference to the
+    parser object so an abandoned parser can still be collected.
+    """
+    from dmlc_tpu.utils.logging import DMLCError as _DMLCError
+
+    try:
+        for buf in reader:
+            try:
+                pipe.push(buf)
+            except _DMLCError:
+                return  # pipeline already failed/closed; its error wins
+        try:
+            pipe.push_eof()
+        except _DMLCError:
+            return
+    except BaseException as err:  # noqa: BLE001 — must reach the consumer
+        error_holder.append(err)
+        try:
+            pipe.push_abort()
+        except Exception:
+            pass
+
+
 class NativePipelineParser:
     """All-native ingest: cpp/pipeline.cc reader + parse workers.
 
     Drop-in for ``ThreadedParser(LibSVM/LibFM/CSVParser(...))`` when the
-    dataset is local files and the native library is loaded: the reader
-    thread, record-boundary chunking, threaded parse, and ordered prefetch
-    queue all run in C++ with no Python in the loop — Python only wraps the
-    finished CSR arrays. Same exactly-once partition semantics as
-    ``create_input_split`` (input_split_base.cc:30-64).
+    native library is loaded: record-boundary chunking, threaded parse, and
+    the ordered prefetch queue all run in C++ with no Python in the parse
+    loop — Python only wraps the finished CSR arrays. Same exactly-once
+    partition semantics as ``create_input_split``
+    (input_split_base.cc:30-64).
+
+    Two byte sources feed the same native machinery:
+
+    - local files: the C++ reader thread (``ingest_open``);
+    - any registered remote filesystem (``gs://``, ``s3://``, ``hdfs://``,
+      ...): parallel range-GET readahead (io/readahead.py) on Python
+      threads pushing the partition stream through ``ingest_push`` — the
+      multi-connection generalization of the reference's native S3 reader
+      (s3_filesys.cc:219-445).
     """
 
     def __init__(
@@ -429,6 +469,8 @@ class NativePipelineParser:
         num_parts: int,
         nthread: int = 2,
         args: Optional[Dict[str, str]] = None,
+        remote_fs=None,
+        remote_uris=None,
     ):
         from dmlc_tpu import native
 
@@ -439,21 +481,69 @@ class NativePipelineParser:
             "csv": native.INGEST_CSV,
         }[data_format]
         self._open_args = (paths, sizes, part_index, num_parts, nthread)
+        self._remote_fs = remote_fs
+        self._remote_uris = remote_uris
         self._csv_param = None
         if data_format == "csv":
             self._csv_param = CSVParserParam()
             self._csv_param.init(args or {}, allow_unknown=True)
         self._pipe = None
+        self._feeder = None
+        self._reader = None
+        self._feed_error_holder: list = []
         self._bytes_read_done = 0
         self._open()
 
     def _open(self) -> None:
+        import os
+        import threading
+
         from dmlc_tpu import native
 
         paths, sizes, part, nparts, nthread = self._open_args
-        self._pipe = native.IngestPipeline(
-            paths, sizes, self._fmt, part, nparts, nthread=nthread
+        if self._remote_fs is None:
+            self._pipe = native.IngestPipeline(
+                paths, sizes, self._fmt, part, nparts, nthread=nthread
+            )
+            return
+        from dmlc_tpu.io.readahead import (
+            DEFAULT_CONNECTIONS,
+            DEFAULT_RANGE_BYTES,
+            RemotePartitionReader,
         )
+
+        reader = RemotePartitionReader(
+            self._remote_fs,
+            list(zip(self._remote_uris, sizes)),
+            part,
+            nparts,
+            range_bytes=int(
+                os.environ.get(
+                    "DMLC_TPU_READAHEAD_MB", DEFAULT_RANGE_BYTES >> 20
+                )
+            ) << 20,
+            connections=int(
+                os.environ.get("DMLC_TPU_READAHEAD_CONNS", DEFAULT_CONNECTIONS)
+            ),
+        )
+        self._pipe = native.IngestPipeline(
+            None, None, self._fmt, 0, 1, nthread=nthread, push=True
+        )
+        # the feeder must hold no reference to this parser (or __del__
+        # could never run and an abandoned parser would leak the thread
+        # and the native pipeline); errors travel through a shared holder
+        self._feed_error_holder: list = []
+        self._reader = reader
+        self._feeder = threading.Thread(
+            target=_feed_pipeline,
+            args=(self._pipe, reader, self._feed_error_holder),
+            name="remote-ingest-feeder", daemon=True,
+        )
+        self._feeder.start()
+
+    @property
+    def _feed_error(self) -> Optional[BaseException]:
+        return self._feed_error_holder[0] if self._feed_error_holder else None
 
     @property
     def bytes_read(self) -> int:
@@ -465,7 +555,14 @@ class NativePipelineParser:
         from dmlc_tpu import native
 
         while True:
-            parsed = self._pipe.next_block()
+            try:
+                parsed = self._pipe.next_block()
+            except DMLCError:
+                if self._feed_error is not None:
+                    raise DMLCError(
+                        f"remote ingest feeder failed: {self._feed_error}"
+                    ) from self._feed_error
+                raise
             if parsed is None:
                 return None
             if self._fmt == native.INGEST_CSV:
@@ -503,17 +600,36 @@ class NativePipelineParser:
                 return
             yield block
 
+    def _teardown(self) -> None:
+        if self._pipe is None:
+            return
+        if self._feeder is not None:
+            # abort first: a feeder blocked in push() wakes with an error,
+            # and cancelled fetch retries stop at their next checkpoint —
+            # both before the native handle is freed
+            self._reader.cancel()
+            self._pipe.push_abort()
+            self._feeder.join()
+            self._feeder = None
+            self._reader = None
+        self._bytes_read_done += self._pipe.bytes_read
+        self._pipe.close()
+        self._pipe = None
+
     def before_first(self) -> None:
-        if self._pipe is not None:
-            self._bytes_read_done += self._pipe.bytes_read
-            self._pipe.close()
+        self._teardown()
         self._open()
 
     def close(self) -> None:
-        if self._pipe is not None:
-            self._bytes_read_done += self._pipe.bytes_read
-            self._pipe.close()
-            self._pipe = None
+        self._teardown()
+
+    def __del__(self):
+        # ordering matters: the feeder must be joined before the native
+        # handle is freed (a feeder blocked in push() touches it)
+        try:
+            self._teardown()
+        except Exception:
+            pass
 
 
 def _try_native_pipeline(
@@ -523,7 +639,12 @@ def _try_native_pipeline(
     num_parts: int,
     nthread: int,
 ) -> Optional[NativePipelineParser]:
-    """Route to the all-native pipeline when the dataset allows it."""
+    """Route to the all-native pipeline when the dataset allows it.
+
+    Local files take the C++ reader; any single remote filesystem takes
+    the parallel-readahead push path. Mixed/unlistable datasets fall back
+    to the Python InputSplit stack.
+    """
     if data_format not in ("libsvm", "libfm", "csv"):
         return None
     if spec.cache_file:
@@ -532,7 +653,7 @@ def _try_native_pipeline(
 
     if not native.available():
         return None
-    from dmlc_tpu.io.filesystem import list_split_files
+    from dmlc_tpu.io.filesystem import get_filesystem, list_split_files
 
     try:
         files = list_split_files(spec.uri)
@@ -540,17 +661,24 @@ def _try_native_pipeline(
         return None
     if not files:
         return None
-    paths = []
-    sizes = []
-    for info in files:
-        if info.path.protocol not in ("file://", ""):
-            return None
-        paths.append(info.path.name)
-        sizes.append(info.size)
+    local = all(info.path.protocol in ("file://", "") for info in files)
+    sizes = [info.size for info in files]
     try:
+        if local:
+            return NativePipelineParser(
+                [info.path.name for info in files], sizes,
+                data_format, part_index, num_parts,
+                nthread=nthread, args=spec.args,
+            )
+        # one remote filesystem for the whole dataset
+        keys = {(info.path.protocol, info.path.host) for info in files}
+        if len(keys) != 1 or any(s <= 0 for s in sizes):
+            return None
+        fs = get_filesystem(files[0].path)
         return NativePipelineParser(
-            paths, sizes, data_format, part_index, num_parts,
+            [], sizes, data_format, part_index, num_parts,
             nthread=nthread, args=spec.args,
+            remote_fs=fs, remote_uris=[info.path for info in files],
         )
     except Exception:
         return None

@@ -115,6 +115,32 @@ class FileSystem:
         except (FileNotFoundError, DMLCError, OSError):
             return False
 
+    def read_range(
+        self, path: URI, offset: int, length: int, cancelled=None
+    ) -> bytes:
+        """Read up to ``length`` bytes at ``offset`` (short only at EOF).
+
+        The primitive under parallel range-GET readahead (io/readahead.py).
+        Default: seek+read on a fresh stream; remote backends override with
+        one bounded range request per call so N calls = N independent
+        connections (the multi-connection generalization of the reference's
+        single reconnecting range-GET stream, s3_filesys.cc:219-445).
+        ``cancelled()`` lets long retry loops stop early on teardown;
+        the local default has no retry loop and ignores it.
+        """
+        stream = self.open_for_read(path)
+        try:
+            stream.seek(offset)
+            out = bytearray()
+            while len(out) < length:
+                chunk = stream.read(length - len(out))
+                if not chunk:
+                    break
+                out.extend(chunk)
+            return bytes(out)
+        finally:
+            stream.close()
+
 
 # ---------------------------------------------------------------------------
 # Local filesystem (src/io/local_filesys.{h,cc})
@@ -270,6 +296,77 @@ class MemoryFileSystem(FileSystem):
 # HTTP(S) read-only backend (reference HttpReadStream, s3_filesys.cc:539-555;
 # registered for http:// https:// at src/io.cc:62-66).
 # ---------------------------------------------------------------------------
+
+
+def read_range_with_retry(
+    open_ranged,
+    offset: int,
+    length: int,
+    display: str,
+    max_retry: int = 50,
+    retry_sleep_s: float = 0.1,
+    cancelled=None,
+) -> bytes:
+    """One logical bounded range read over HTTP-shaped backends, with
+    per-range retry — the single copy of the remote ``read_range`` loop
+    shared by the object stores and WebHDFS.
+
+    ``open_ranged(start, end)`` must return a response object (context
+    manager with ``.read`` and ``.headers``) covering bytes [start, end).
+    Retries continue from the bytes already delivered (the reconnect shape
+    of s3_filesys.cc:319-342). A response whose body is shorter than its
+    own Content-Length is a truncated connection and retries; a clean
+    response shorter than the asked range is EOF. Throttling responses
+    (408/429) retry like 5xx — the parallel readahead makes them likelier,
+    and aborting ingest on rate limiting would be a regression vs the
+    single-connection reconnect loop. ``cancelled()`` (optional) is checked
+    between attempts so a teardown can stop a long retry budget promptly.
+    """
+    import http.client as _hc
+    import time as _time
+    import urllib.error
+
+    out = bytearray()
+    retries = max_retry
+    while len(out) < length:
+        if cancelled is not None and cancelled():
+            raise DMLCError(f"range read of {display} cancelled")
+        want = length - len(out)
+        try:
+            with open_ranged(offset + len(out), offset + length) as resp:
+                header = resp.headers.get("Content-Length")
+                expected = int(header) if header is not None else None
+                got = 0
+                while got < want:
+                    chunk = resp.read(want - got)
+                    if not chunk:
+                        break
+                    out.extend(chunk)
+                    got += len(chunk)
+                if expected is not None and got < min(expected, want):
+                    # server promised more than it sent: dropped connection,
+                    # NOT end-of-object (HTTPResponse.read returns short
+                    # instead of raising when the peer closes mid-body)
+                    raise OSError(
+                        f"truncated response: {got} of {expected} bytes"
+                    )
+            if got < want:
+                break  # clean short bounded response: range hit EOF
+        except (urllib.error.URLError, OSError, _hc.HTTPException) as err:
+            if isinstance(err, urllib.error.HTTPError):
+                if err.code == 416:  # offset at/past EOF: empty range
+                    err.close()
+                    break
+                if err.code < 500 and err.code not in (408, 429):
+                    raise  # 4xx (except throttling): not transient
+            retries -= 1
+            if retries <= 0:
+                raise DMLCError(
+                    f"range read of {display} failed after "
+                    f"{max_retry} retries: {err}"
+                ) from err
+            _time.sleep(retry_sleep_s)
+    return bytes(out)
 
 
 class RangedReadStream(SeekStream):

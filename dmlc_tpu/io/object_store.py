@@ -49,6 +49,7 @@ from dmlc_tpu.io.filesystem import (
     FileSystem,
     RangedReadStream,
     URI,
+    read_range_with_retry,
     register_filesystem,
 )
 from dmlc_tpu.io.stream import SeekStream, Stream
@@ -222,8 +223,25 @@ class _ObjectStoreBase(FileSystem):
     def _display(self, path: URI) -> str:
         return path.str_full()
 
-    def _open_ranged(self, path: URI, start: int):
+    def _open_ranged(self, path: URI, start: int, end: Optional[int] = None):
+        """GET from ``start``; bounded ``[start, end)`` when end given."""
         raise NotImplementedError
+
+    @staticmethod
+    def _range_header(start: int, end: Optional[int]) -> str:
+        return f"bytes={start}-" if end is None else f"bytes={start}-{end - 1}"
+
+    def read_range(
+        self, path: URI, offset: int, length: int, cancelled=None
+    ) -> bytes:
+        """One bounded range GET per call — the parallel-readahead
+        primitive, with per-range retry (s3_filesys.cc:319-342 shape)."""
+        return read_range_with_retry(
+            lambda start, end: self._open_ranged(path, start, end),
+            offset, length, self._display(path),
+            max_retry=READ_MAX_RETRY, retry_sleep_s=READ_RETRY_SLEEP_S,
+            cancelled=cancelled,
+        )
 
     def _stat_object(self, path: URI) -> Optional[int]:
         """size, or None when no such object."""
@@ -336,10 +354,10 @@ class S3FileSystem(_ObjectStoreBase):
 
     # ---- reads -------------------------------------------------------
 
-    def _open_ranged(self, path: URI, start: int):
+    def _open_ranged(self, path: URI, start: int, end: Optional[int] = None):
         bucket, key = self._bucket_key(path)
         url = self._url(bucket, key)
-        hdrs = {"Range": f"bytes={start}-"}
+        hdrs = {"Range": self._range_header(start, end)}
         if self.access_key and self.secret_key:
             hdrs.update(_sigv4_headers(
                 "GET", url, self.region, self.access_key, self.secret_key,
@@ -506,11 +524,11 @@ class GCSFileSystem(_ObjectStoreBase):
     def _media_url(self, bucket: str, key: str) -> str:
         return f"{self.endpoint}/{bucket}/{urllib.parse.quote(key)}"
 
-    def _open_ranged(self, path: URI, start: int):
+    def _open_ranged(self, path: URI, start: int, end: Optional[int] = None):
         bucket, key = self._bucket_key(path)
         req = urllib.request.Request(
             self._media_url(bucket, key),
-            headers=self._headers({"Range": f"bytes={start}-"}),
+            headers=self._headers({"Range": self._range_header(start, end)}),
         )
         return _http(req)
 

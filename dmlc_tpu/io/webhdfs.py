@@ -41,6 +41,7 @@ from dmlc_tpu.io.filesystem import (
     FileSystem,
     RangedReadStream,
     URI,
+    read_range_with_retry,
     register_filesystem,
 )
 from dmlc_tpu.io.object_store import ObjectWriteStream
@@ -195,6 +196,25 @@ class WebHDFSFileSystem(FileSystem):
         return RangedReadStream(
             open_ranged, size, self._display(path),
             max_retry=READ_MAX_RETRY, retry_sleep_s=READ_RETRY_SLEEP_S,
+        )
+
+    def read_range(
+        self, path: URI, offset: int, length: int, cancelled=None
+    ) -> bytes:
+        """One bounded OPEN per call (WebHDFS supports offset+length
+        natively) — the parallel-readahead primitive, with per-range retry
+        like the object stores (shared loop: read_range_with_retry)."""
+
+        def open_ranged(start: int, end: int):
+            return urllib.request.urlopen(
+                self._url(path.name, "OPEN", offset=start, length=end - start),
+                timeout=60,
+            )
+
+        return read_range_with_retry(
+            open_ranged, offset, length, self._display(path),
+            max_retry=READ_MAX_RETRY, retry_sleep_s=READ_RETRY_SLEEP_S,
+            cancelled=cancelled,
         )
 
     def open(self, path: URI, flag: str) -> Stream:

@@ -93,6 +93,16 @@ def _bind(lib) -> None:
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, i64, ctypes.c_int32, i64,
     ]
+    lib.ingest_open_push.restype = ctypes.c_void_p
+    lib.ingest_open_push.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i64, ctypes.c_int32, i64,
+    ]
+    lib.ingest_push.restype = ctypes.c_int
+    lib.ingest_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+    lib.ingest_push_eof.restype = ctypes.c_int
+    lib.ingest_push_eof.argtypes = [ctypes.c_void_p]
+    lib.ingest_push_abort.restype = None
+    lib.ingest_push_abort.argtypes = [ctypes.c_void_p]
     lib.ingest_peek.restype = ctypes.c_int
     lib.ingest_peek.argtypes = [
         ctypes.c_void_p,
@@ -158,7 +168,7 @@ def _load(path: str):
         _bind(lib)
     except (OSError, AttributeError):
         return None
-    if lib.dmlc_tpu_abi_version() != 1:
+    if lib.dmlc_tpu_abi_version() != 2:
         raise DMLCError(f"native ABI mismatch in {path}")
     return lib
 
@@ -435,24 +445,51 @@ class IngestPipeline:
         chunk_bytes: int = (2 << 20) * 4,
         capacity: int = 8,
         csv_expect_cols: int = 0,
+        push: bool = False,
     ):
         lib = get_lib()
         if lib is None:
             raise DMLCError("native library unavailable")
         self._lib = lib
-        path_blob = b"".join(
-            (p.encode() if isinstance(p, str) else bytes(p)) + b"\0"
-            for p in paths
-        )
-        size_arr = np.asarray(sizes, dtype=np.int64)
         self._fmt = fmt
-        self._handle = lib.ingest_open(
-            path_blob, _ptr(size_arr), len(paths),
-            fmt, part, nparts, nthread, chunk_bytes, capacity,
-            csv_expect_cols,
-        )
+        if push:
+            # push mode: the caller streams partition bytes in (remote
+            # ingest — parallel range-GET fetchers feed the native workers)
+            self._handle = lib.ingest_open_push(
+                fmt, nthread, chunk_bytes, capacity, csv_expect_cols
+            )
+        else:
+            path_blob = b"".join(
+                (p.encode() if isinstance(p, str) else bytes(p)) + b"\0"
+                for p in paths
+            )
+            size_arr = np.asarray(sizes, dtype=np.int64)
+            self._handle = lib.ingest_open(
+                path_blob, _ptr(size_arr), len(paths),
+                fmt, part, nparts, nthread, chunk_bytes, capacity,
+                csv_expect_cols,
+            )
         if not self._handle:
             raise DMLCError("ingest_open failed (bad arguments)")
+
+    # ---- push mode (remote ingest feeders) ---------------------------
+
+    def push(self, data: bytes) -> None:
+        """Append partition-stream bytes; blocks for backpressure when the
+        parse workers are behind (the ctypes call releases the GIL)."""
+        rc = self._lib.ingest_push(self._handle, bytes(data), len(data))
+        if rc != 0:
+            raise DMLCError(f"native ingest push failed rc={rc}")
+
+    def push_eof(self) -> None:
+        rc = self._lib.ingest_push_eof(self._handle)
+        if rc != 0:
+            raise DMLCError(f"native ingest push_eof failed rc={rc}")
+
+    def push_abort(self) -> None:
+        """Fail the pipeline so consumers blocked in next_block wake."""
+        if self._handle:
+            self._lib.ingest_push_abort(self._handle)
 
     def next_block(self) -> Optional[dict]:
         rows = ctypes.c_int64()

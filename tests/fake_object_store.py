@@ -81,12 +81,14 @@ class Handler(BaseHTTPRequestHandler):
         data = st.objects.get((bucket, key))
         if data is None:
             return self._send(404)
-        start = 0
+        start, stop = 0, len(data)
         rng = self.headers.get("Range")
         if rng:
             m = re.match(r"bytes=(\d+)-(\d*)", rng)
             start = int(m.group(1))
-        body = data[start:]
+            if m.group(2):  # inclusive end bound
+                stop = min(stop, int(m.group(2)) + 1)
+        body = data[start:stop]
         if st.fail_after_bytes is not None and len(body) > st.fail_after_bytes:
             # send a truncated response then drop the connection
             self.send_response(206 if rng else 200)

@@ -186,7 +186,16 @@ class TestFactoryAndIters:
     def test_create_parser_default_libsvm(self):
         MemoryFileSystem.put("test/x.svm", self.LIBSVM)
         parser = create_parser("mem://test/x.svm")
-        assert isinstance(parser, ThreadedParser)
+        # mem:// is a registered remote-style filesystem: with the native
+        # library loaded it takes the push-mode native pipeline; otherwise
+        # the Python ThreadedParser stack
+        from dmlc_tpu import native
+        from dmlc_tpu.data.parsers import NativePipelineParser
+
+        if native.available():
+            assert isinstance(parser, NativePipelineParser)
+        else:
+            assert isinstance(parser, ThreadedParser)
         total = sum(len(b) for b in parser)
         assert total == 500
 

@@ -1,0 +1,245 @@
+"""Remote ingest through the native pipeline: parallel range-GET readahead
+(io/readahead.py) feeding the push ABI (cpp/pipeline.cc ingest_push).
+
+The reference's remote hot path is its hand-tuned native S3 range-GET
+client (src/io/s3_filesys.cc:219-445); here the equivalent contract is
+proven hermetically against the in-process fake object store / webhdfs
+servers: exactly-once partitioning over remote multi-file datasets, parity
+with the local native path, reconnect-under-fault, and feeder-failure
+propagation (no hangs).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import native
+from dmlc_tpu.data.parsers import NativePipelineParser, create_parser
+from dmlc_tpu.io.filesystem import (
+    URI,
+    MemoryFileSystem,
+    get_filesystem,
+    register_filesystem,
+)
+from dmlc_tpu.io.readahead import RemotePartitionReader, fetch_ordered
+from dmlc_tpu.utils.logging import DMLCError
+from tests.fake_object_store import serve
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library required"
+)
+
+
+@pytest.fixture()
+def s3(monkeypatch):
+    from dmlc_tpu.io.object_store import S3FileSystem
+
+    server, store, base = serve()
+    monkeypatch.setenv("S3_ENDPOINT", base)
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    register_filesystem("s3://", lambda uri: S3FileSystem())
+    yield store
+    server.shutdown()
+
+
+def _libsvm_lines(n, start=0):
+    return b"".join(
+        b"%d %d:%d 7:1.5\n" % ((start + i) % 2, (start + i) % 5, start + i)
+        for i in range(n)
+    )
+
+
+class TestFetchOrdered:
+    def test_preserves_order(self):
+        def fetch(i):
+            return i * i
+
+        assert list(fetch_ordered(fetch, range(50), workers=8)) == [
+            i * i for i in range(50)
+        ]
+
+    def test_error_propagates_in_order(self):
+        def fetch(i):
+            if i == 5:
+                raise ValueError("boom")
+            return i
+
+        gen = fetch_ordered(fetch, range(10), workers=4)
+        got = []
+        with pytest.raises(ValueError):
+            for x in gen:
+                got.append(x)
+        assert got == [0, 1, 2, 3, 4]
+
+    def test_bounded_window(self):
+        """No more than window items are fetched ahead of consumption."""
+        started = []
+        gate = threading.Event()
+
+        def fetch(i):
+            started.append(i)
+            return i
+
+        gen = fetch_ordered(fetch, range(100), workers=2, window=4)
+        assert next(gen) == 0
+        gate.wait(0.05)
+        # consumed 1, so at most 1 + window submissions have happened
+        assert len(started) <= 6
+        gen.close()
+
+
+class TestRemotePartitionReader:
+    def _fs_files(self, s3, sizes):
+        datasets = []
+        pos = 0
+        for i, n in enumerate(sizes):
+            data = _libsvm_lines(n, start=pos)
+            s3.objects[("bkt", f"part-{i:03d}.svm")] = data
+            datasets.append(data)
+            pos += n
+        fs = get_filesystem(URI.parse("s3://bkt/"))
+        files = [
+            (URI.parse(f"s3://bkt/part-{i:03d}.svm"), len(d))
+            for i, d in enumerate(datasets)
+        ]
+        return fs, files, b"".join(datasets)
+
+    def test_exactly_once_over_parts(self, s3):
+        fs, files, whole = self._fs_files(s3, [37, 5, 101])
+        for nparts in (1, 2, 3, 7):
+            got = b"".join(
+                b"".join(
+                    RemotePartitionReader(
+                        fs, files, part, nparts, range_bytes=64 << 10
+                    )
+                )
+                for part in range(nparts)
+            )
+            assert got == whole, f"nparts={nparts}"
+
+    def test_small_ranges_many_connections(self, s3):
+        fs, files, whole = self._fs_files(s3, [200])
+        reader = RemotePartitionReader(
+            fs, files, 0, 1, range_bytes=64 << 10, connections=8
+        )
+        # range_bytes is floored at 64 KiB; file spans multiple ranges
+        assert len(reader.ranges()) >= 1
+        assert b"".join(reader) == whole
+
+    def test_boundary_lands_on_record_begin(self, s3):
+        fs, files, whole = self._fs_files(s3, [500])
+        r = RemotePartitionReader(fs, files, 1, 3)
+        assert r.begin == 0 or whole[r.begin - 1 : r.begin] in (b"\n", b"\r")
+
+
+class TestRemoteNativeParser:
+    def _put_dataset(self, s3, nrows=4000, nfiles=3):
+        rows = nrows // nfiles
+        blobs = [
+            _libsvm_lines(rows, start=i * rows) for i in range(nfiles)
+        ]
+        for i, b in enumerate(blobs):
+            s3.objects[("data", f"f{i}.svm")] = b
+        return b"".join(blobs)
+
+    def test_create_parser_routes_remote_native(self, s3):
+        self._put_dataset(s3)
+        parser = create_parser("s3://data/f0.svm;s3://data/f1.svm;s3://data/f2.svm")
+        assert isinstance(parser, NativePipelineParser)
+        assert parser._remote_fs is not None
+
+    def test_parity_with_local(self, s3, tmp_path):
+        whole = self._put_dataset(s3)
+        local = tmp_path / "all.svm"
+        local.write_bytes(whole)
+
+        def collect(uri, part, nparts):
+            p = create_parser(uri, part, nparts)
+            labels, indices, values = [], [], []
+            for b in p:
+                labels.append(np.asarray(b.label))
+                indices.append(np.asarray(b.index))
+                values.append(np.asarray(b.value))
+            p.close()
+            return (
+                np.concatenate(labels),
+                np.concatenate(indices),
+                np.concatenate(values),
+            )
+
+        remote_uri = "s3://data/f0.svm;s3://data/f1.svm;s3://data/f2.svm"
+        for nparts in (1, 3):
+            r_parts = [collect(remote_uri, k, nparts) for k in range(nparts)]
+            l_all = collect(str(local), 0, 1)
+            r_labels = np.concatenate([p[0] for p in r_parts])
+            r_indices = np.concatenate([p[1] for p in r_parts])
+            r_values = np.concatenate([p[2] for p in r_parts])
+            np.testing.assert_array_equal(r_labels, l_all[0])
+            np.testing.assert_array_equal(r_indices, l_all[1])
+            np.testing.assert_array_equal(r_values, l_all[2])
+
+    def test_before_first_re_reads(self, s3):
+        self._put_dataset(s3, nrows=1000, nfiles=1)
+        parser = create_parser("s3://data/f0.svm")
+        n1 = sum(len(b) for b in parser)
+        parser.before_first()
+        n2 = sum(len(b) for b in parser)
+        parser.close()
+        assert n1 == n2 == 1000
+
+    def test_reconnect_under_fault(self, s3):
+        """Truncated responses + dropped connections retry per range
+        (s3_filesys.cc:319-342 behavior through the parallel readers)."""
+        self._put_dataset(s3, nrows=2000, nfiles=1)
+        size = len(s3.objects[("data", "f0.svm")])
+        # every response is cut off well before the body completes, so
+        # each range needs several reconnects to make progress
+        s3.fail_after_bytes = max(1 << 10, size // 8)
+        assert s3.fail_after_bytes < size
+        parser = create_parser("s3://data/f0.svm")
+        assert isinstance(parser, NativePipelineParser)
+        total = sum(len(b) for b in parser)
+        parser.close()
+        assert total == 2000
+
+    def test_read_range_retries_truncation(self, s3):
+        """A response shorter than its own Content-Length is a dropped
+        connection, not EOF: read_range must continue, not return short."""
+        s3.objects[("data", "t.bin")] = bytes(range(256)) * 256  # 64 KiB
+        s3.fail_after_bytes = 10 << 10
+        fs = get_filesystem(URI.parse("s3://data/t.bin"))
+        got = fs.read_range(URI.parse("s3://data/t.bin"), 1000, 50_000)
+        assert got == (bytes(range(256)) * 256)[1000:51_000]
+
+    def test_feeder_failure_surfaces(self, s3):
+        """A dead feeder must fail next_block, not hang it."""
+        self._put_dataset(s3, nrows=100, nfiles=1)
+
+        class BrokenFS:
+            def read_range(self, path, offset, length):
+                raise OSError("network down")
+
+        fs = BrokenFS()
+        with pytest.raises(DMLCError):
+            # boundary probes happen in the constructor for part>0; use
+            # part 0 so failure lands in the feeder thread
+            p = NativePipelineParser(
+                [], [4096], "libsvm", 0, 1,
+                remote_fs=fs, remote_uris=[URI.parse("s3://data/f0.svm")],
+            )
+            try:
+                p.next_block()
+            finally:
+                p.close()
+
+
+class TestMemRouting:
+    def test_mem_uri_takes_native_push_path(self):
+        MemoryFileSystem.put("ri/x.svm", _libsvm_lines(300))
+        parser = create_parser("mem://ri/x.svm")
+        assert isinstance(parser, NativePipelineParser)
+        assert parser._remote_fs is not None
+        assert sum(len(b) for b in parser) == 300
+        parser.close()
