@@ -1,0 +1,32 @@
+"""The collective benchmark tier must stay runnable: tiny-size smoke of
+both measurements (socket loopback allreduce GB/s, device psum step)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench_collective  # noqa: E402
+
+
+class TestSocketTier:
+    def test_tree_and_ring_metrics(self):
+        out = bench_collective.socket_allreduce_metrics(
+            world=2,
+            cases=(("tree_4k", 4096, "tree"), ("ring_1m", 1 << 20, "ring")),
+            iters=2,
+        )
+        assert out["socket_world"] == 2
+        assert out["tree_4k_gbps"] > 0
+        assert out["ring_1m_gbps"] > 0
+
+
+class TestDeviceTier:
+    def test_psum_metrics_on_mesh(self):
+        out = bench_collective.device_psum_metrics(payload_mb=1.0, iters=2)
+        # conftest pins 8 virtual CPU devices
+        assert out["psum_devices"] == 8
+        assert out["psum_step_ms"] > 0
+        assert out["psum_algo_gbps"] > 0
+        assert "psum_ici_utilization" not in out  # cpu: no ICI estimate
