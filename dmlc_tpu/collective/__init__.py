@@ -220,12 +220,27 @@ def version_number() -> int:
 def reinit_recover() -> None:
     """Re-enter the job after a collective failure (tracker cmd='recover').
 
-    Drops every peer link without notifying the tracker, reconnects keeping
-    the same rank AND the original engine's tracker address/jobid, and
-    clears the in-memory checkpoint blob so the next ``load_checkpoint(uri)``
-    reads the *shared* URI — the one state every worker (including a freshly
-    restarted process) can agree on. The reference tracker's recover
-    re-entry (tracker.py:279-291) is the other half of this handshake.
+    Socket engine: drops every peer link without notifying the tracker,
+    reconnects keeping the same rank AND the original engine's tracker
+    address/jobid, and clears the in-memory checkpoint blob so the next
+    ``load_checkpoint(uri)`` reads the *shared* URI — the one state every
+    worker (including a freshly restarted process) can agree on. The
+    reference tracker's recover re-entry (tracker.py:279-291) is the other
+    half of this handshake.
+
+    Device engine (SURVEY §5.3 TPU mapping — 'recover ⇒ jax.distributed
+    re-init + checkpoint restore'): aborts the engine, then re-runs
+    ``jax.distributed.initialize`` from the launcher's DMLC_TPU_* env
+    contract and rebuilds the engine over the fresh runtime. The JAX
+    distributed runtime is *fail-stop* — its coordination client usually
+    hard-terminates surviving processes when a peer dies — so the primary
+    recovery path is the tpu launcher's per-task restart loop
+    (launchers/tpu.py run_task), which relaunches every terminated worker;
+    the restarted processes rendezvous in ``initialize`` and resume from
+    the shared checkpoint URI. The in-process path here covers the cases
+    where the process outlives the failure; a watchdog turns a hung re-init
+    into a clean process exit (code 41) so the launcher's restart loop
+    takes over rather than leaving a zombie.
 
     If the rendezvous itself fails (tracker transiently unreachable), the
     aborted engine stays in place: its collectives fail fast with DMLCError,
@@ -233,9 +248,12 @@ def reinit_recover() -> None:
     """
     global _engine, _checkpoint_blob
     with _engine_lock:
+        if isinstance(_engine, DeviceEngine):
+            _reinit_device_engine()
+            return
         check(
             isinstance(_engine, SocketEngine),
-            "reinit_recover requires an active socket engine",
+            "reinit_recover requires an active socket or device engine",
         )
         old = _engine
         old.abort()
@@ -251,6 +269,63 @@ def reinit_recover() -> None:
         )
 
 
+def _reinit_device_engine() -> None:
+    """Device-engine half of reinit_recover (engine lock held)."""
+    global _engine, _checkpoint_blob
+    from dmlc_tpu.parallel import distributed as _dist
+
+    # validate before destroying anything: a reinit_recover() on an
+    # unrecoverable engine must leave the engine and checkpoint intact
+    info = _dist.env_process_info()
+    check(
+        info is not None and info["num_processes"] > 1,
+        "device-engine recover needs the DMLC_TPU_* launcher env "
+        "(multi-process); single-process jobs have nothing to recover",
+    )
+    old = _engine
+    old.abort()
+    _checkpoint_blob = None
+    # jax.distributed.shutdown inside the re-init can block indefinitely
+    # when the coordinator is gone; fail-stop is then the correct outcome —
+    # exit so the launcher's per-task retry restarts this worker cleanly.
+    timeout_s = float(os.environ.get("DMLC_TPU_RECOVER_TIMEOUT", 60))
+    reinit_done = threading.Event()
+
+    def _fail_stop():
+        if not reinit_done.is_set():  # cancel() can lose the race; this
+            os._exit(41)              # flag cannot
+
+    watchdog = threading.Timer(timeout_s, _fail_stop)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        try:
+            _dist.initialize_from_env(force=True)
+        except Exception as err:  # gRPC/barrier errors are RuntimeError-
+            # shaped; translate so the run_with_recovery retry loop (which
+            # catches DMLCError/OSError around this call) keeps its
+            # try-again contract
+            raise DMLCError(
+                f"device re-rendezvous failed: {err}"
+            ) from err
+        _engine = DeviceEngine(axis=old.axis)
+    finally:
+        reinit_done.set()
+        watchdog.cancel()
+
+
+# configuration mistakes that must surface immediately, never trigger a
+# world-wide recovery cascade (they are OSError subclasses, but a bad
+# checkpoint URI is not a peer failure)
+_NON_PEER_ERRORS = (
+    FileNotFoundError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+    FileExistsError,
+)
+
+
 def run_with_recovery(round_fn, max_attempts: int = 3,
                       recover_on=(DMLCError, OSError)):
     """rabit's checkpoint-replay pattern around one unit of collective work.
@@ -263,10 +338,14 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
     that already finished the round replays it bit-identically while the
     restarted worker catches up; and every worker must run the same
     ``round_fn`` granularity (SPMD), so the abort cascade finds all peers
-    inside a collective or about to enter one. Handle non-collective I/O
-    that can fail persistently (e.g. checkpoint uploads) inside ``round_fn``
-    or narrow ``recover_on`` — an exception matching ``recover_on`` is
-    treated as a peer failure and triggers a world-wide re-rendezvous.
+    inside a collective or about to enter one. An exception matching
+    ``recover_on`` is treated as a peer failure and triggers a world-wide
+    re-rendezvous. The default covers DMLCError (the device engine
+    translates transport failures into it) and OSError (raw socket
+    failures — EHOSTUNREACH etc. are not ConnectionError subclasses),
+    EXCEPT filesystem-shaped subclasses (FileNotFoundError,
+    PermissionError, ...): a misconfigured checkpoint URI surfaces
+    immediately instead of triggering max_attempts recovery cascades.
 
     Failure cascades by construction: ``abort()`` closes all of this
     worker's links, so every neighbor's in-flight collective errors too and
@@ -281,9 +360,21 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
         try:
             return round_fn()
         except recover_on as err:
+            if isinstance(err, _NON_PEER_ERRORS):
+                raise  # configuration error, not a peer failure
             attempt += 1
             with _engine_lock:
-                recoverable = isinstance(_engine, SocketEngine)
+                if isinstance(_engine, SocketEngine):
+                    recoverable = True
+                elif isinstance(_engine, DeviceEngine):
+                    from dmlc_tpu.parallel.distributed import env_process_info
+
+                    info = env_process_info()
+                    recoverable = (
+                        info is not None and info["num_processes"] > 1
+                    )
+                else:
+                    recoverable = False
             if not recoverable or attempt >= max_attempts:
                 raise
             log_info(

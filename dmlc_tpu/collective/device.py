@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dmlc_tpu.utils.logging import DMLCError
+
 
 # ---- in-jit collectives (use inside shard_map/pjit-ed functions) ----------
 
@@ -67,12 +69,43 @@ class DeviceEngine:
         self.axis = axis
         self.rank = jax.process_index()
         self.world_size = jax.process_count()
+        self._aborted = False
+
+    def _check_live(self) -> None:
+        if self._aborted:
+            raise DMLCError(
+                "device engine aborted (pending recover); reinit before "
+                "collectives"
+            )
+
+    def _translate(self, err: Exception, what: str) -> DMLCError:
+        """Backend failures (Gloo/ICI transport errors, coordination-service
+        loss) surface as assorted RuntimeError/ValueError types; collapse
+        them into DMLCError so run_with_recovery's default recover_on
+        catches device-plane peer failures exactly like socket ones.
+        Deterministic user errors are screened out by _validate before the
+        collective runs, so what reaches the wrap is transport-shaped."""
+        self._aborted = True
+        return DMLCError(f"device collective {what} failed: {err}")
+
+    @staticmethod
+    def _validate(array) -> np.ndarray:
+        """Raise locally (unwrapped) on inputs every rank would reject —
+        these must surface as user errors, not trigger recovery."""
+        arr = np.asarray(array)
+        if arr.dtype.kind not in "fiub":
+            raise TypeError(
+                f"device collectives need numeric arrays, got dtype "
+                f"{arr.dtype}"
+            )
+        return arr
 
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
         """Allreduce a host array across all processes' devices."""
         from jax.experimental import multihost_utils
 
-        arr = np.asarray(array)
+        self._check_live()
+        arr = self._validate(array)
         if self.world_size == 1:
             # Single process owns every device: nothing to reduce across
             # processes; return as-is (matches rabit world=1 semantics).
@@ -82,27 +115,78 @@ class DeviceEngine:
             raise ValueError(f"unknown op {op!r}")
         # stack contributions along a new leading axis sharded over processes,
         # then reduce it with a jitted global reduction (XLA AllReduce).
-        stacked = multihost_utils.process_allgather(arr)
+        try:
+            stacked = multihost_utils.process_allgather(arr)
+        except Exception as err:  # noqa: BLE001 — backend error translation
+            raise self._translate(err, "allreduce") from err
         reduce_fn = ops[op]
         return np.asarray(reduce_fn(stacked, axis=0))
 
+    # fixed-size broadcast header: [ndim, dims[0..7], dtype_num]
+    _HDR_SLOTS = 10
+    # np.dtype(num) is not a constructor; invert .num over the numeric
+    # dtypes the engine supports (kind in "fiub")
+    _DTYPE_BY_NUM = {
+        np.dtype(t).num: np.dtype(t)
+        for t in (
+            np.bool_, np.int8, np.int16, np.int32, np.int64,
+            np.uint8, np.uint16, np.uint32, np.uint64,
+            np.float16, np.float32, np.float64,
+        )
+    }
+
     def broadcast(self, array: Optional[np.ndarray], root: int = 0) -> np.ndarray:
+        """Broadcast from ``root``; non-root ranks may pass None (rabit
+        semantics). broadcast_one_to_all requires every process to supply
+        the same array structure, so a fixed-size header round carries
+        shape+dtype first and non-roots then contribute matching zeros."""
         from jax.experimental import multihost_utils
 
+        self._check_live()
+        is_root = self.rank == root
         if self.world_size == 1:
             assert array is not None
-            return np.asarray(array)
-        return np.asarray(
-            multihost_utils.broadcast_one_to_all(
-                array, is_source=self.rank == root
+            return self._validate(array)
+        header = np.zeros(self._HDR_SLOTS, dtype=np.int64)
+        if is_root:
+            arr = self._validate(array)
+            if arr.ndim > self._HDR_SLOTS - 2:
+                raise ValueError(f"broadcast supports <= 8 dims, got {arr.ndim}")
+            header[0] = arr.ndim
+            header[1 : 1 + arr.ndim] = arr.shape
+            header[-1] = arr.dtype.num
+        try:
+            header = np.asarray(
+                multihost_utils.broadcast_one_to_all(header, is_source=is_root)
             )
-        )
+            if not is_root:
+                ndim = int(header[0])
+                shape = tuple(int(d) for d in header[1 : 1 + ndim])
+                arr = np.zeros(shape, dtype=self._DTYPE_BY_NUM[int(header[-1])])
+            return np.asarray(
+                multihost_utils.broadcast_one_to_all(arr, is_source=is_root)
+            )
+        except Exception as err:  # noqa: BLE001 — backend error translation
+            raise self._translate(err, "broadcast") from err
 
     def barrier(self) -> None:
         from jax.experimental import multihost_utils
 
+        self._check_live()
         if self.world_size > 1:
-            multihost_utils.sync_global_devices("dmlc_tpu_barrier")
+            try:
+                multihost_utils.sync_global_devices("dmlc_tpu_barrier")
+            except Exception as err:  # noqa: BLE001 — backend translation
+                raise self._translate(err, "barrier") from err
+
+    def abort(self) -> None:
+        """Mark the engine dead: collectives fail fast with DMLCError until
+        a new engine is built over a re-initialized runtime (the socket
+        engine's abort() contract, for the device plane)."""
+        self._aborted = True
+
+    def shutdown(self) -> None:
+        self._aborted = True
 
 
 # ---- gradient-sync building block (the BASELINE north-star op) ------------

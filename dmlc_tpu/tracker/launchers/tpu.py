@@ -12,9 +12,17 @@ code keeps working) and the jax.distributed coordination contract:
 Workers call :func:`dmlc_tpu.parallel.initialize_from_env` which turns these
 into ``jax.distributed.initialize(...)``; after that ``jax.devices()`` spans
 the pod and collectives ride ICI (the socket tree/ring of the reference
-tracker is replaced by XLA AllReduce — SURVEY §5.8). The tracker's
-``recover`` path maps to per-host restart (retry loop below) + elastic
-jax.distributed re-init + checkpoint restore.
+tracker is replaced by XLA AllReduce — SURVEY §5.8).
+
+The tracker's ``recover`` path (tracker.py:279-291) maps to the per-task
+restart loop below + elastic jax.distributed re-init + checkpoint restore:
+the JAX runtime is fail-stop (a dead peer terminates the survivors), so
+every terminated worker exits nonzero — including exit 41 from
+``reinit_recover``'s hung-re-init watchdog — and ``run_task`` relaunches it
+with ``DMLC_NUM_ATTEMPT`` bumped; the relaunched processes rendezvous in
+``initialize_from_env`` on the same coordinator and resume from the shared
+checkpoint URI (``dmlc_tpu.collective.run_with_recovery`` round contract;
+proven end to end in tests/test_device_recovery.py).
 
 Host discovery order: --tpu-hosts, --host-file, ``TPU_WORKER_HOSTNAMES``
 (set by Cloud TPU runtimes), else single-host localhost.

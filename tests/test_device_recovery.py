@@ -1,0 +1,174 @@
+"""Elastic recovery on the device (TPU) engine — SURVEY §5.3 TPU mapping:
+'recover ⇒ jax.distributed re-init + checkpoint restore'.
+
+The JAX distributed runtime is fail-stop: when a peer dies, the coordination
+client terminates surviving processes. Recovery therefore composes
+- the tpu launcher's per-task restart loop (launchers/tpu.py run_task),
+- fresh ``jax.distributed.initialize`` rendezvous on the same coordinator,
+- resume from the shared checkpoint URI (rabit checkpoint-replay pattern),
+with ``run_with_recovery``'s in-process re-init (reinit_recover device
+branch) covering processes that outlive the failure, and its watchdog
+(exit 41) converting a hung re-init into a clean restart.
+
+The end-to-end test mirrors tests/test_recovery.py's socket-engine version:
+kill rank 0 mid-epoch after a checkpoint on a 2-process virtual-CPU
+cluster, restart every terminated task launcher-style, and prove the final
+state is identical to a crash-free run.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.utils.logging import DMLCError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from dmlc_tpu.parallel.distributed import initialize_from_env
+    initialize_from_env()
+    from dmlc_tpu import collective as rabit
+
+    CKPT = sys.argv[1]
+    EPOCHS = 4
+    CRASH = sys.argv[2] == "crash"
+
+    rabit.init("device")
+    rank = rabit.rank()
+    world = rabit.world_size()
+    attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", 0))
+
+    def round_fn():
+        state = rabit.load_checkpoint(CKPT)
+        if state is None:
+            state = (0, np.zeros(4))
+        epoch, w = state
+        if epoch >= EPOCHS:
+            return state
+        if CRASH and rank == 0 and attempt == 0 and epoch == 2:
+            os._exit(17)  # hard crash mid-job, after checkpointing epoch 2
+        g = rabit.allreduce(
+            np.full(4, (rank + 1) * (epoch + 1), dtype=np.float64))
+        w = w + g
+        if rank == 0:
+            rabit.checkpoint((epoch + 1, w), CKPT)
+        else:
+            rabit.checkpoint((epoch + 1, w))
+        return (epoch + 1, w)
+
+    state = (0, None)
+    while state[0] < EPOCHS:
+        state = rabit.run_with_recovery(round_fn)
+    epoch, w = state
+    # rabit broadcast semantics on the device plane: None on non-root
+    b = rabit.broadcast(np.full(3, 7.5) if rank == 0 else None, root=0)
+    if not np.array_equal(b, np.full(3, 7.5)):
+        os._exit(3)
+    print(f"RESULT rank={{rank}} w0={{w[0]:.1f}} v={{rabit.version_number()}}",
+          flush=True)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_job(tmp_path, crash: bool, world: int = 2, attempts: int = 3):
+    """Launcher-style driver: per-task restart loop, the run_task shape of
+    launchers/tpu.py (any nonzero exit — crash, fail-stop termination, or
+    the recover watchdog's 41 — relaunches the task with the attempt
+    counter bumped)."""
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO))
+    ckpt = tmp_path / ("ckpt_crash.bin" if crash else "ckpt_clean.bin")
+    port = _free_port()
+    outputs = {}
+    fail = {}
+
+    def run_task(tid: int) -> None:
+        for attempt in range(attempts):
+            env = {
+                **os.environ,
+                "DMLC_TPU_COORDINATOR": f"127.0.0.1:{port}",
+                "DMLC_TPU_NUM_PROC": str(world),
+                "DMLC_TPU_PROC_ID": str(tid),
+                "DMLC_NUM_ATTEMPT": str(attempt),
+                "DMLC_TPU_RECOVER_TIMEOUT": "10",
+            }
+            proc = subprocess.run(
+                [sys.executable, str(script), str(ckpt),
+                 "crash" if crash else "clean"],
+                capture_output=True, text=True, timeout=240, env=env,
+            )
+            outputs[tid] = proc.stdout + proc.stderr
+            if proc.returncode == 0:
+                return
+        fail[tid] = outputs[tid]
+
+    threads = [
+        threading.Thread(target=run_task, args=(tid,)) for tid in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not fail, f"tasks exhausted attempts: {fail}"
+    results = {}
+    for tid, out in outputs.items():
+        for line in out.splitlines():
+            if "RESULT" in line:
+                kv = dict(p.split("=") for p in line.split("RESULT", 1)[1].split())
+                results[int(kv["rank"])] = (float(kv["w0"]), int(kv["v"]))
+    assert sorted(results) == list(range(world)), outputs
+    assert all(v == 4 for _, v in results.values()), results
+    return {r: w0 for r, (w0, _) in results.items()}
+
+
+class TestDeviceEngineAbort:
+    def test_abort_fails_fast(self):
+        from dmlc_tpu.collective.device import DeviceEngine
+
+        eng = DeviceEngine()
+        eng.abort()
+        with pytest.raises(DMLCError):
+            eng.allreduce(np.ones(2))
+        with pytest.raises(DMLCError):
+            eng.barrier()
+
+    def test_reinit_recover_needs_multiprocess_env(self, monkeypatch):
+        from dmlc_tpu import collective as rabit
+        from dmlc_tpu.collective.device import DeviceEngine
+
+        monkeypatch.delenv("DMLC_TPU_COORDINATOR", raising=False)
+        rabit.finalize()
+        rabit.init("device")
+        try:
+            with pytest.raises(DMLCError):
+                rabit.reinit_recover()
+        finally:
+            rabit.finalize()
+
+
+class TestDeviceRecoveryEndToEnd:
+    def test_crash_recover_replay_matches_clean_run(self, tmp_path):
+        world = 2
+        clean = _run_job(tmp_path, crash=False, world=world)
+        crashed = _run_job(tmp_path, crash=True, world=world)
+        # sum over epochs e of (e+1) * sum over ranks (r+1)
+        expect = sum(e + 1 for e in range(4)) * world * (world + 1) / 2
+        for rank in range(world):
+            assert clean[rank] == expect, (clean, expect)
+            assert crashed[rank] == expect, (crashed, expect)
