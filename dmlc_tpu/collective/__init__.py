@@ -276,9 +276,8 @@ def _reinit_device_engine() -> None:
 
     # validate before destroying anything: a reinit_recover() on an
     # unrecoverable engine must leave the engine and checkpoint intact
-    info = _dist.env_process_info()
     check(
-        info is not None and info["num_processes"] > 1,
+        _dist.multiprocess_env(),
         "device-engine recover needs the DMLC_TPU_* launcher env "
         "(multi-process); single-process jobs have nothing to recover",
     )
@@ -301,14 +300,18 @@ def _reinit_device_engine() -> None:
     try:
         try:
             _dist.initialize_from_env(force=True)
-        except Exception as err:  # gRPC/barrier errors are RuntimeError-
-            # shaped; translate so the run_with_recovery retry loop (which
-            # catches DMLCError/OSError around this call) keeps its
-            # try-again contract
+            # engine rebuild can also raise RuntimeError on a transiently
+            # unhealthy backend — same translation so the run_with_recovery
+            # retry loop (which catches DMLCError/OSError around this call)
+            # keeps its try-again contract
+            new_engine = DeviceEngine(axis=old.axis)
+        except DMLCError:
+            raise
+        except Exception as err:  # gRPC/barrier errors are RuntimeError-shaped
             raise DMLCError(
                 f"device re-rendezvous failed: {err}"
             ) from err
-        _engine = DeviceEngine(axis=old.axis)
+        _engine = new_engine
     finally:
         reinit_done.set()
         watchdog.cancel()
@@ -326,8 +329,11 @@ _NON_PEER_ERRORS = (
 )
 
 
+_DEFAULT_RECOVER_ON = (DMLCError, OSError)
+
+
 def run_with_recovery(round_fn, max_attempts: int = 3,
-                      recover_on=(DMLCError, OSError)):
+                      recover_on=_DEFAULT_RECOVER_ON):
     """rabit's checkpoint-replay pattern around one unit of collective work.
 
     Runs ``round_fn()``; if a collective fails (a peer died — surfaced as a
@@ -360,19 +366,20 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
         try:
             return round_fn()
         except recover_on as err:
-            if isinstance(err, _NON_PEER_ERRORS):
-                raise  # configuration error, not a peer failure
+            if recover_on is _DEFAULT_RECOVER_ON and isinstance(
+                err, _NON_PEER_ERRORS
+            ):
+                # configuration error, not a peer failure; a caller who
+                # explicitly listed these types in recover_on keeps them
+                raise
             attempt += 1
             with _engine_lock:
                 if isinstance(_engine, SocketEngine):
                     recoverable = True
                 elif isinstance(_engine, DeviceEngine):
-                    from dmlc_tpu.parallel.distributed import env_process_info
+                    from dmlc_tpu.parallel.distributed import multiprocess_env
 
-                    info = env_process_info()
-                    recoverable = (
-                        info is not None and info["num_processes"] > 1
-                    )
+                    recoverable = multiprocess_env()
                 else:
                     recoverable = False
             if not recoverable or attempt >= max_attempts:

@@ -139,7 +139,12 @@ class DeviceEngine:
         """Broadcast from ``root``; non-root ranks may pass None (rabit
         semantics). broadcast_one_to_all requires every process to supply
         the same array structure, so a fixed-size header round carries
-        shape+dtype first and non-roots then contribute matching zeros."""
+        shape+dtype first and non-roots then contribute matching zeros.
+
+        A root-side validation error travels THROUGH the header (ndim slot
+        -1) instead of raising before it: every rank stays in lockstep and
+        raises the same TypeError, rather than non-roots hanging in the
+        collective while the root errored out locally."""
         from jax.experimental import multihost_utils
 
         self._check_live()
@@ -148,17 +153,40 @@ class DeviceEngine:
             assert array is not None
             return self._validate(array)
         header = np.zeros(self._HDR_SLOTS, dtype=np.int64)
+        arr = header  # placeholder payload when the root's input is invalid
+        root_err: Optional[Exception] = None
         if is_root:
-            arr = self._validate(array)
-            if arr.ndim > self._HDR_SLOTS - 2:
-                raise ValueError(f"broadcast supports <= 8 dims, got {arr.ndim}")
-            header[0] = arr.ndim
-            header[1 : 1 + arr.ndim] = arr.shape
-            header[-1] = arr.dtype.num
+            try:
+                arr = self._validate(array)
+                if arr.ndim > self._HDR_SLOTS - 2:
+                    raise ValueError(
+                        f"broadcast supports <= {self._HDR_SLOTS - 2} dims, "
+                        f"got {arr.ndim}"
+                    )
+                if arr.dtype.num not in self._DTYPE_BY_NUM:
+                    raise TypeError(
+                        f"broadcast cannot encode dtype {arr.dtype}; "
+                        f"supported: "
+                        f"{sorted(str(d) for d in self._DTYPE_BY_NUM.values())}"
+                    )
+                header[0] = arr.ndim
+                header[1 : 1 + arr.ndim] = arr.shape
+                header[-1] = arr.dtype.num
+            except (TypeError, ValueError) as err:
+                root_err = err
+                header[0] = -1
         try:
             header = np.asarray(
                 multihost_utils.broadcast_one_to_all(header, is_source=is_root)
             )
+            if int(header[0]) < 0:
+                # root's input was invalid: same user error on every rank,
+                # no recovery cascade, engine stays live
+                if root_err is not None:
+                    raise root_err
+                raise TypeError(
+                    "broadcast root input was invalid (see root rank log)"
+                )
             if not is_root:
                 ndim = int(header[0])
                 shape = tuple(int(d) for d in header[1 : 1 + ndim])
@@ -166,6 +194,10 @@ class DeviceEngine:
             return np.asarray(
                 multihost_utils.broadcast_one_to_all(arr, is_source=is_root)
             )
+        except (TypeError, ValueError) as err:
+            if err is root_err or int(header[0]) < 0:
+                raise  # validated user error, already lockstep
+            raise self._translate(err, "broadcast") from err
         except Exception as err:  # noqa: BLE001 — backend error translation
             raise self._translate(err, "broadcast") from err
 

@@ -33,6 +33,14 @@ def env_process_info() -> Optional[dict]:
     }
 
 
+def multiprocess_env() -> bool:
+    """True when the DMLC_TPU_* launcher contract names a multi-process
+    job — the single recoverability predicate shared by run_with_recovery
+    and reinit_recover."""
+    info = env_process_info()
+    return info is not None and info["num_processes"] > 1
+
+
 def initialize_from_env(force: bool = False) -> bool:
     """Call jax.distributed.initialize from the DMLC_TPU_* env contract.
 

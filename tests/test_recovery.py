@@ -27,7 +27,13 @@ WORKER = textwrap.dedent("""
 
     CKPT = sys.argv[1]
     EPOCHS = 4
-    CRASH = sys.argv[2] == "crash"
+    # argv[2]: comma-separated ranks that crash at epoch 2 on attempt 0
+    # ("none" = clean run; the launcher shell-joins argv, eating empty args)
+    CRASH_RANKS = set(
+        int(r) for r in sys.argv[2].split(",") if r not in ("", "none"))
+    # argv[3]: array size — >= 32768 f64 elements (256 KiB) takes the RING
+    # allreduce path, so a crash lands while neighbors are mid-ring
+    SIZE = int(sys.argv[3])
 
     rabit.init()
     rank = rabit.rank()
@@ -37,14 +43,14 @@ WORKER = textwrap.dedent("""
     def round_fn():
         state = rabit.load_checkpoint(CKPT)
         if state is None:
-            state = (0, np.zeros(8))
+            state = (0, np.zeros(SIZE))
         epoch, w = state
         if epoch >= EPOCHS:
             return state
-        if CRASH and rank == 0 and attempt == 0 and epoch == 2:
+        if rank in CRASH_RANKS and attempt == 0 and epoch == 2:
             os._exit(17)  # hard crash mid-job, after checkpointing epoch 2
         g = rabit.allreduce(
-            np.full(8, (rank + 1) * (epoch + 1), dtype=np.float64))
+            np.full(SIZE, (rank + 1) * (epoch + 1), dtype=np.float64))
         w = w + g
         if rank == 0:
             rabit.checkpoint((epoch + 1, w), CKPT)
@@ -62,16 +68,17 @@ WORKER = textwrap.dedent("""
 """)
 
 
-def _run_job(tmp_path, crash: bool, world: int):
+def _run_job(tmp_path, crash_ranks: str, world: int, size: int = 8,
+             tag: str = ""):
     script = tmp_path / "worker.py"
     script.write_text(WORKER.format(repo=REPO))
-    ckpt = tmp_path / ("ckpt_crash.bin" if crash else "ckpt_clean.bin")
+    ckpt = tmp_path / f"ckpt_{tag or (crash_ranks or 'clean')}.bin"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "dmlc-submit"),
          "--cluster", "local", "-n", str(world), "--max-attempts", "2",
          "--host-ip", "127.0.0.1",
-         sys.executable, str(script), str(ckpt),
-         "crash" if crash else "clean"],
+         sys.executable, str(script), str(ckpt), crash_ranks or "none",
+         str(size)],
         capture_output=True, text=True, timeout=180,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
@@ -89,12 +96,98 @@ def _run_job(tmp_path, crash: bool, world: int):
     return {r: w0 for r, (w0, _) in results.items()}
 
 
+def _expect(world: int) -> float:
+    # sum over epochs e of (e+1) * sum over ranks (r+1)
+    return sum(e + 1 for e in range(4)) * world * (world + 1) / 2
+
+
 @pytest.mark.parametrize("world", [2, 3])
 def test_crash_recover_replay_matches_clean_run(tmp_path, world):
-    clean = _run_job(tmp_path, crash=False, world=world)
-    crashed = _run_job(tmp_path, crash=True, world=world)
-    # sum over epochs e of (e+1) * sum over ranks (r+1)
-    expect = sum(e + 1 for e in range(4)) * world * (world + 1) / 2
+    clean = _run_job(tmp_path, "", world=world)
+    crashed = _run_job(tmp_path, "0", world=world)
+    expect = _expect(world)
     for rank in range(world):
         assert clean[rank] == expect, (clean, expect)
         assert crashed[rank] == expect, (crashed, expect)
+
+
+def test_crash_with_ring_allreduce_in_flight(tmp_path):
+    """Survivors are blocked inside a RING allreduce (bandwidth path, not
+    tree) when the peer dies: the ring hop errors, cascades into recover,
+    and the replay still matches bit-exactly."""
+    world, size = 3, 40_000  # 320 KB > ring_threshold_bytes (256 KiB)
+    clean = _run_job(tmp_path, "", world=world, size=size, tag="ring_clean")
+    crashed = _run_job(tmp_path, "0", world=world, size=size, tag="ring_crash")
+    expect = _expect(world)
+    for rank in range(world):
+        assert clean[rank] == expect
+        assert crashed[rank] == expect
+
+
+def test_double_failure_recovers(tmp_path):
+    """Two of three workers die at the same epoch; both restart, the
+    survivor cascades through recover, everyone replays to the same state."""
+    world = 3
+    crashed = _run_job(tmp_path, "0,1", world=world, tag="double")
+    expect = _expect(world)
+    for rank in range(world):
+        assert crashed[rank] == expect
+
+
+def test_attempts_exhaustion_raises():
+    """run_with_recovery must surface the error after max_attempts instead
+    of recovering forever (YARN AM maxNumAttempt semantics,
+    ApplicationMaster.java:212-213)."""
+    from dmlc_tpu import collective as rabit
+    from dmlc_tpu.tracker.rendezvous import RabitTracker
+    from dmlc_tpu.utils.logging import DMLCError
+
+    tracker = RabitTracker("127.0.0.1", 1, port=19691, port_end=19791)
+    tracker.start(1)
+    calls = []
+    old_env = {
+        k: os.environ.get(k) for k in ("DMLC_TRACKER_URI", "DMLC_TRACKER_PORT")
+    }
+    os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
+    os.environ["DMLC_TRACKER_PORT"] = str(tracker.port)
+    try:
+        rabit.finalize()
+        rabit.init("socket")
+
+        def round_fn():
+            calls.append(1)
+            raise DMLCError("synthetic collective failure")
+
+        with pytest.raises(DMLCError):
+            rabit.run_with_recovery(round_fn, max_attempts=3)
+        # attempt 1..3: the third failure exhausts the budget
+        assert len(calls) == 3
+    finally:
+        rabit.finalize()
+        tracker.close()
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_config_errors_do_not_trigger_recovery():
+    """A bad checkpoint URI (FileNotFoundError) is a configuration error:
+    it must surface immediately, not burn recovery attempts."""
+    from dmlc_tpu import collective as rabit
+
+    calls = []
+
+    def round_fn():
+        calls.append(1)
+        raise FileNotFoundError("/no/such/checkpoint")
+
+    rabit.finalize()
+    rabit.init("local")
+    try:
+        with pytest.raises(FileNotFoundError):
+            rabit.run_with_recovery(round_fn)
+        assert len(calls) == 1
+    finally:
+        rabit.finalize()
