@@ -6,22 +6,33 @@ native chunk parse → CSR RowBlocks) over a deterministic synthetic HIGGS-like
 file (600k rows × 28 dense features ≈ 190 MB), the same workload as the
 reference's `test/libsvm_parser_test.cc` harness.
 
+Methodology (the numbers must be defensible on a noisy 1-core host):
+- one untimed warmup pass first (builds the native lib on fresh checkouts,
+  warms the page cache, primes thread pools);
+- every configuration runs TRIALS timed passes; a configuration's score is
+  its MEDIAN, and the headline is the best configuration's median;
+- the spread (min..max over that configuration's trials) and the native
+  pipeline's per-stage counters (reader/parse/consumer ns) are reported in
+  `extra` so a drifting number can be root-caused from the JSON alone.
+
 vs_baseline compares against the reference C++ parser (libsvm_parser_test,
-compiled -O3, best of nthread ∈ {4,8,16}) measured on the same class of host:
-334 MB/s (see BASELINE.md "measured" section).
+compiled -O3, best of nthread ∈ {4,8,16}) measured on the same class of
+host: 334 MB/s (see BASELINE.md "measured" section).
 
 Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": N, "extra": {...}}
 """
 
 import json
 import os
+import statistics
 import sys
 import time
 
 REFERENCE_MBPS = 334.0  # reference libsvm_parser_test on this host class
 ROWS = 600_000
 FEATURES = 28
+TRIALS = 3
 CACHE_DIR = os.environ.get("DMLC_TPU_BENCH_DIR", "/tmp/dmlc_tpu_bench")
 DATA_PATH = os.path.join(CACHE_DIR, f"higgs_like_{ROWS}.svm")
 
@@ -53,6 +64,130 @@ def _ensure_data() -> str:
             fh.write("\n".join(lines) + "\n")
     os.replace(tmp, DATA_PATH)
     return DATA_PATH
+
+
+def _one_pass(path: str, nthread: int) -> tuple:
+    """One timed full parse pass → (MB/s, per-stage stats dict)."""
+    from dmlc_tpu.data import create_parser
+
+    t0 = time.time()
+    parser = create_parser(path, 0, 1, nthread=nthread)
+    rows = 0
+    nnz = 0
+    for block in parser:
+        rows += len(block)
+        nnz += block.num_nonzero
+    dt = time.time() - t0
+    stats = parser.stats() if hasattr(parser, "stats") else None
+    mbps = parser.bytes_read / (1 << 20) / dt
+    parser.close()
+    assert rows == ROWS, f"row count mismatch: {rows}"
+    assert nnz == ROWS * FEATURES, f"nnz mismatch: {nnz}"
+    return mbps, stats
+
+
+def _bench_headline(path: str) -> tuple:
+    """→ (headline MB/s, extra dict) per the median-of-trials methodology."""
+    _one_pass(path, 1)  # warmup: native build, page cache, allocators
+
+    cpus = os.cpu_count() or 1
+    threads = sorted({1, 2, min(8, max(1, cpus)), min(16, max(1, cpus))})
+    trials = {}
+    stats_by_cfg = {}
+    for nthread in threads:
+        runs = []
+        run_stats = []
+        for _ in range(TRIALS):
+            mbps, stats = _one_pass(path, nthread)
+            runs.append(round(mbps, 1))
+            run_stats.append(stats)
+        trials[nthread] = runs
+        # keep the stats of the median trial — the one the score reports
+        median_idx = runs.index(
+            sorted(runs)[len(runs) // 2]
+        )
+        stats_by_cfg[nthread] = run_stats[median_idx]
+
+    best_cfg = max(threads, key=lambda nt: statistics.median(trials[nt]))
+    runs = trials[best_cfg]
+    headline = statistics.median(runs)
+    extra = {
+        "trials_mbps": {str(k): v for k, v in trials.items()},
+        "headline_cfg_nthread": best_cfg,
+        "headline_spread_mbps": [min(runs), max(runs)],
+    }
+    stats = stats_by_cfg.get(best_cfg)
+    if stats:
+        sec = 1e9
+        extra["stages"] = {
+            "chunks": stats["chunks"],
+            "reader_io_s": round(stats["reader_io_ns"] / sec, 3),
+            "reader_wait_s": round(stats["reader_wait_ns"] / sec, 3),
+            "parse_s": round(stats["parse_ns"] / sec, 3),
+            "worker_wait_s": round(stats["worker_wait_ns"] / sec, 3),
+            "consumer_wait_s": round(stats["consumer_wait_ns"] / sec, 3),
+        }
+    return headline, extra
+
+
+def _bench_device_feed(path: str) -> dict:
+    """Feed-only (parse→densify→H2D) and ingest→SGD MB/s on the attached
+    accelerator, median of warm passes (the jitted step persists across
+    passes — steady-state epochs, not first-compile)."""
+    import jax
+
+    from dmlc_tpu.data.parsers import create_parser
+    from dmlc_tpu.device.feed import BatchSpec, DeviceFeed
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+        step_batch,
+    )
+    import jax.numpy as jnp
+
+    size_mb = os.path.getsize(path) / (1 << 20)
+    spec = BatchSpec(batch_size=16384, layout="dense", num_features=29)
+    # parse workers, native fill and device dispatch contend on small hosts:
+    # measured on the 1-core driver box, nthread=1 beats 2 by ~1.5x here
+    nthread = 1 if (os.cpu_count() or 1) <= 2 else 2
+
+    def _feed():
+        return DeviceFeed(create_parser(path, 0, 1, nthread=nthread), spec)
+
+    feed_runs = []
+    for _ in range(TRIALS + 1):  # first pass is compile/cache warmup
+        feed = _feed()
+        t0 = time.time()
+        last = None
+        for batch in feed:
+            last = batch
+        jax.block_until_ready(last["x"])
+        feed_runs.append(round(size_mb / (time.time() - t0), 1))
+        feed.close()
+
+    params = init_linear_params(29)
+    velocity = {"w": jnp.zeros_like(params["w"]),
+                "b": jnp.zeros_like(params["b"])}
+    step = make_linear_train_step(None, learning_rate=0.1, layout="dense")
+    sgd_runs = []
+    for _ in range(TRIALS + 1):
+        feed = _feed()
+        t0 = time.time()
+        for batch in feed:
+            params, velocity, _m = step(
+                params, velocity, step_batch(batch, "dense")
+            )
+        jax.block_until_ready(params)
+        sgd_runs.append(round(size_mb / (time.time() - t0), 1))
+        feed.close()
+
+    return {
+        "feed_dense_mbps": round(statistics.median(feed_runs[1:]), 1),
+        "feed_dense_trials_mbps": feed_runs[1:],
+        "sgd_e2e_mbps": round(statistics.median(sgd_runs[1:]), 1),
+        "sgd_e2e_trials_mbps": sgd_runs[1:],
+        "device": str(jax.devices()[0].platform),
+    }
 
 
 def _bench_remote_ingest(path: str) -> float:
@@ -108,31 +243,15 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     path = _ensure_data()
 
-    from dmlc_tpu.data import create_parser
+    headline, extra = _bench_headline(path)
 
-    cpus = os.cpu_count() or 1
-    threads = sorted({1, 2, min(8, max(1, cpus)), min(16, max(1, cpus))})
-    best = 0.0
-    for nthread in threads:
-        for _trial in range(2):
-            t0 = time.time()
-            parser = create_parser(path, 0, 1, nthread=nthread)
-            rows = 0
-            nnz = 0
-            for block in parser:
-                rows += len(block)
-                nnz += block.num_nonzero
-            dt = time.time() - t0
-            parser.close()
-            assert rows == ROWS, f"row count mismatch: {rows}"
-            assert nnz == ROWS * FEATURES, f"nnz mismatch: {nnz}"
-            mbps = parser.bytes_read / (1 << 20) / dt
-            best = max(best, mbps)
-
-    extra = {}
+    try:
+        extra.update(_bench_device_feed(path))
+    except Exception as err:  # the headline metric must still print
+        extra["device_feed_error"] = str(err)
     try:
         extra["remote_ingest_mbps"] = round(_bench_remote_ingest(path), 1)
-    except Exception as err:  # the headline metric must still print
+    except Exception as err:
         extra["remote_ingest_error"] = str(err)
     try:
         from bench_collective import collective_metrics
@@ -145,9 +264,9 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "higgs_libsvm_ingest",
-                "value": round(best, 1),
+                "value": round(headline, 1),
                 "unit": "MB/s",
-                "vs_baseline": round(best / REFERENCE_MBPS, 3),
+                "vs_baseline": round(headline / REFERENCE_MBPS, 3),
                 "extra": extra,
             }
         )

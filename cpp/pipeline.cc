@@ -19,6 +19,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <new>
 #include <cstdint>
@@ -59,6 +60,15 @@ enum {
   kEIo = -3,
   kEOom = -4,
 };
+
+// row-flag bits mirrored from parse.cc (DMLC_TPU_HAS_*)
+enum { kHasWeight = 1, kHasQid = 2, kHasValue = 4 };
+
+inline int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
 
@@ -339,7 +349,9 @@ class Pipeline {
         return 1;
       }
       if (reader_done_ && next_seq_out_ >= total_chunks_) return 0;
+      int64_t t0 = NowNs();
       cv_out_.wait(lk);
+      consumer_wait_ns_.fetch_add(NowNs() - t0);
     }
   }
 
@@ -376,6 +388,140 @@ class Pipeline {
     return b;
   }
 
+  // ---- consumer-side batch staging ------------------------------------
+  // Fixed-shape re-batching in native code: the TPU feed consumes
+  // [batch_size]-row batches with static shapes (device/csr.py's contract),
+  // and doing the re-slice + densify in Python costs more than the parse
+  // itself (BASELINE.md: 850 MB/s parse vs 244 MB/s feed). Staging pulls
+  // parsed blocks in order and batch-fetch fills caller-owned buffers
+  // (dense [batch, F] scatter or padded COO) directly from the CSR arrays —
+  // the zero-copy handoff discipline of the reference's RowBlock
+  // (src/data/row_block.h:169-188) extended through densify.
+  //
+  // Single-consumer API like Peek/Fetch: stage, then fetch consumes.
+
+  // Stage >= batch_size rows (or all remaining). Returns 1 with
+  // *rows/*nnz describing the next batch (rows = min(batch_size, staged)),
+  // 0 at end of stream (no rows left), <0 on pipeline error.
+  int StageBatch(int64_t batch_size, int64_t* out_rows, int64_t* out_nnz) {
+    if (format_ == kCsv) return kEIo;  // csv blocks carry no CSR arrays
+    while (staged_rows_ < batch_size) {
+      Block* b = nullptr;
+      int rc = Peek(&b);
+      if (rc < 0) return rc;
+      if (rc == 0) break;  // end of stream
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        current_ = nullptr;  // take ownership
+      }
+      if (b->rows == 0) {
+        delete b;
+        continue;
+      }
+      staged_.push_back(Span{b, 0});
+      staged_rows_ += b->rows;
+    }
+    int64_t rows = std::min<int64_t>(batch_size, staged_rows_);
+    *out_rows = rows;
+    *out_nnz = NnzOfFirst(rows);
+    return rows > 0 ? 1 : 0;
+  }
+
+  // Fill a dense [batch_size, num_features] f32 batch (plus labels/weights)
+  // from the staged rows, consuming min(batch_size, staged) rows. Rows past
+  // the valid count are zero (weight 0 ⇒ no-op in weighted losses). Feature
+  // ids >= num_features are dropped, matching device/csr.py block_to_dense.
+  // Returns rows consumed, or <0 (kEIo when the format has no CSR arrays).
+  int64_t FetchBatchDense(float* x, float* labels, float* weights,
+                          int64_t batch_size, int64_t num_features) {
+    if (format_ == kCsv) return kEIo;
+    std::memset(x, 0, static_cast<size_t>(batch_size * num_features) * 4);
+    std::memset(labels, 0, static_cast<size_t>(batch_size) * 4);
+    std::memset(weights, 0, static_cast<size_t>(batch_size) * 4);
+    int64_t out_row = 0;
+    while (out_row < batch_size && !staged_.empty()) {
+      Span& sp = staged_.front();
+      Block* b = sp.block;
+      bool has_w = (b->flags & kHasWeight) != 0;
+      bool has_v = format_ == kLibfm || (b->flags & kHasValue) != 0;
+      const uint32_t* idx = reinterpret_cast<const uint32_t*>(b->indices);
+      int64_t take = std::min<int64_t>(batch_size - out_row, b->rows - sp.row);
+      for (int64_t i = 0; i < take; ++i) {
+        int64_t r = sp.row + i;
+        labels[out_row] = b->labels[r];
+        weights[out_row] = has_w ? b->weights[r] : 1.0f;
+        float* xrow = x + out_row * num_features;
+        for (int64_t k = b->offsets[r]; k < b->offsets[r + 1]; ++k) {
+          uint32_t j = idx[k];
+          if (j < static_cast<uint64_t>(num_features)) {
+            xrow[j] = has_v ? b->values[k] : 1.0f;
+          }
+        }
+        ++out_row;
+      }
+      ConsumeSpan(take);
+    }
+    return out_row;
+  }
+
+  // Fill a padded COO batch (labels/weights [batch_size]; indices/values/
+  // row_ids [nnz_bucket]) from the staged rows, consuming them. Padded
+  // entries are (row 0, feature 0, value 0) — arithmetic no-ops for
+  // segment-sum SpMV. Fails with kEOverflow (consuming nothing) when the
+  // batch's nnz exceeds nnz_bucket. Returns rows consumed, or <0.
+  int64_t FetchBatchCoo(float* labels, float* weights, int32_t* indices,
+                        float* values, int32_t* row_ids, int64_t batch_size,
+                        int64_t nnz_bucket) {
+    if (format_ == kCsv) return kEIo;
+    int64_t rows = std::min<int64_t>(batch_size, staged_rows_);
+    if (NnzOfFirst(rows) > nnz_bucket) return kEOverflow;
+    std::memset(labels, 0, static_cast<size_t>(batch_size) * 4);
+    std::memset(weights, 0, static_cast<size_t>(batch_size) * 4);
+    int64_t out_row = 0, out_k = 0;
+    while (out_row < batch_size && !staged_.empty()) {
+      Span& sp = staged_.front();
+      Block* b = sp.block;
+      bool has_w = (b->flags & kHasWeight) != 0;
+      bool has_v = format_ == kLibfm || (b->flags & kHasValue) != 0;
+      const uint32_t* idx = reinterpret_cast<const uint32_t*>(b->indices);
+      int64_t take = std::min<int64_t>(batch_size - out_row, b->rows - sp.row);
+      for (int64_t i = 0; i < take; ++i) {
+        int64_t r = sp.row + i;
+        labels[out_row] = b->labels[r];
+        weights[out_row] = has_w ? b->weights[r] : 1.0f;
+        for (int64_t k = b->offsets[r]; k < b->offsets[r + 1]; ++k) {
+          indices[out_k] = static_cast<int32_t>(idx[k]);
+          values[out_k] = has_v ? b->values[k] : 1.0f;
+          row_ids[out_k] = static_cast<int32_t>(out_row);
+          ++out_k;
+        }
+        ++out_row;
+      }
+      ConsumeSpan(take);
+    }
+    for (int64_t k = out_k; k < nnz_bucket; ++k) {
+      indices[k] = 0;
+      values[k] = 0.0f;
+      row_ids[k] = 0;
+    }
+    return out_row;
+  }
+
+  // Per-stage counters for bench/diagnosis (SURVEY §5.1): where does wall
+  // time go between reading, parsing and the consumer?
+  void Stats(double* out, int32_t n) const {
+    double vals[7] = {
+        static_cast<double>(bytes_read_.load()),
+        static_cast<double>(chunk_count_.load()),
+        static_cast<double>(reader_io_ns_.load()),
+        static_cast<double>(reader_wait_ns_.load()),
+        static_cast<double>(parse_ns_.load()),
+        static_cast<double>(worker_wait_ns_.load()),
+        static_cast<double>(consumer_wait_ns_.load()),
+    };
+    for (int32_t i = 0; i < n && i < 7; ++i) out[i] = vals[i];
+  }
+
   int64_t BytesRead() const { return bytes_read_.load(); }
 
   void Close() {
@@ -401,9 +547,41 @@ class Pipeline {
       delete current_;
       current_ = nullptr;
     }
+    for (Span& sp : staged_) delete sp.block;
+    staged_.clear();
+    staged_rows_ = 0;
   }
 
  private:
+  // ---- batch staging state (single consumer thread only) --------------
+  struct Span {
+    Block* block;
+    int64_t row;  // first unconsumed row
+  };
+
+  // nnz covered by the first `rows` staged rows
+  int64_t NnzOfFirst(int64_t rows) const {
+    int64_t nnz = 0;
+    for (const Span& sp : staged_) {
+      if (rows <= 0) break;
+      int64_t take = std::min<int64_t>(rows, sp.block->rows - sp.row);
+      nnz += sp.block->offsets[sp.row + take] - sp.block->offsets[sp.row];
+      rows -= take;
+    }
+    return nnz;
+  }
+
+  // advance the front span by `rows`, retiring it when exhausted
+  void ConsumeSpan(int64_t rows) {
+    Span& sp = staged_.front();
+    sp.row += rows;
+    staged_rows_ -= rows;
+    if (sp.row >= sp.block->rows) {
+      delete sp.block;
+      staged_.pop_front();
+    }
+  }
+
   // Move the first `cut` bytes of push_tail_ into a work chunk; the
   // remainder becomes the new tail. False when the pipeline stopped.
   bool EmitPushChunk(int64_t cut) {
@@ -496,7 +674,9 @@ class Pipeline {
             Fail(kEOom);
             return;
           }
+          int64_t tr = NowNs();
           int64_t got = rd.Read(chunk->data.p + base, want);
+          reader_io_ns_.fetch_add(NowNs() - tr);
           if (got < 0) {
             delete chunk;
             Fail(kEIo);
@@ -563,10 +743,12 @@ class Pipeline {
     // error_ must wake a backpressure-blocked producer (the push-mode
     // feeder especially: workers that exited on error stop draining work_,
     // and PushAbort/Fail would otherwise never unblock it)
+    int64_t t0 = NowNs();
     cv_work_space_.wait(lk, [this] {
       return stop_ || error_ != 0 ||
              static_cast<int>(work_.size()) < nthread_ * 2;
     });
+    reader_wait_ns_.fetch_add(NowNs() - t0);
     if (stop_ || error_ != 0) return nullptr;
     if (!free_chunks_.empty()) {
       Chunk* c = free_chunks_.back();
@@ -617,9 +799,11 @@ class Pipeline {
       Chunk* chunk = nullptr;
       {
         std::unique_lock<std::mutex> lk(mu_);
+        int64_t t0 = NowNs();
         cv_work_.wait(lk, [this] {
           return stop_ || error_ != 0 || !work_.empty() || reader_done_;
         });
+        worker_wait_ns_.fetch_add(NowNs() - t0);
         if (stop_ || error_ != 0) return;
         if (work_.empty()) {
           if (reader_done_) return;
@@ -631,6 +815,7 @@ class Pipeline {
       }
       Block* block = nullptr;
       int rc;
+      int64_t t0 = NowNs();
       try {
         block = new Block();
         block->seq = chunk->seq;
@@ -638,6 +823,8 @@ class Pipeline {
       } catch (const std::bad_alloc&) {
         rc = kEOom;
       }
+      parse_ns_.fetch_add(NowNs() - t0);
+      chunk_count_.fetch_add(1);
       bytes_read_.fetch_add(chunk->data.size);
       ReleaseChunk(chunk);
       if (rc != kOk) {
@@ -741,6 +928,18 @@ class Pipeline {
   // push-mode state: only touched by the single pushing thread
   Buf push_tail_;
   int64_t push_seq_ = 0;
+
+  // batch-staging state: only touched by the single consuming thread
+  std::deque<Span> staged_;
+  int64_t staged_rows_ = 0;
+
+  // per-stage counters (ns); written by their owning threads, read by Stats
+  std::atomic<int64_t> reader_io_ns_{0};
+  std::atomic<int64_t> reader_wait_ns_{0};
+  std::atomic<int64_t> parse_ns_{0};
+  std::atomic<int64_t> worker_wait_ns_{0};
+  std::atomic<int64_t> consumer_wait_ns_{0};
+  std::atomic<int64_t> chunk_count_{0};
 
   std::thread reader_;
   std::vector<std::thread> workers_;
@@ -863,6 +1062,45 @@ void* ingest_fetch_view(void* handle, float** labels, float** weights,
 }
 
 void ingest_block_free(void* block) { delete static_cast<Block*>(block); }
+
+// ---- native batch staging (fixed-shape TPU feed) -------------------------
+// Stage the next batch of up to batch_size rows (pulling parsed blocks in
+// order; partial blocks carry over). Fills *rows (min(batch_size, left))
+// and *nnz for sizing the fetch buffers. Returns 1 when rows > 0, 0 at end
+// of stream, <0 on pipeline error. Single consumer thread, like
+// ingest_peek/ingest_fetch.
+int ingest_stage_batch(void* handle, int64_t batch_size, int64_t* rows,
+                       int64_t* nnz) {
+  return static_cast<Pipeline*>(handle)->StageBatch(batch_size, rows, nnz);
+}
+
+// Consume the staged rows into a dense [batch_size, num_features] f32 image
+// plus labels/weights (zero-padded past the valid rows; weights default 1
+// for valid rows). Returns rows consumed, or <0 on error.
+int64_t ingest_fetch_batch_dense(void* handle, float* x, float* labels,
+                                 float* weights, int64_t batch_size,
+                                 int64_t num_features) {
+  return static_cast<Pipeline*>(handle)->FetchBatchDense(
+      x, labels, weights, batch_size, num_features);
+}
+
+// Consume the staged rows into a padded COO batch: labels/weights
+// [batch_size], indices/values/row_ids [nnz_bucket] (padding = arithmetic
+// no-ops for segment-sum). Fails with -1 (consuming nothing) when the
+// batch nnz exceeds nnz_bucket. Returns rows consumed, or <0 on error.
+int64_t ingest_fetch_batch_coo(void* handle, float* labels, float* weights,
+                               int32_t* indices, float* values,
+                               int32_t* row_ids, int64_t batch_size,
+                               int64_t nnz_bucket) {
+  return static_cast<Pipeline*>(handle)->FetchBatchCoo(
+      labels, weights, indices, values, row_ids, batch_size, nnz_bucket);
+}
+
+// Per-stage counters: out[0]=bytes_read, [1]=chunks, [2]=reader_io_ns,
+// [3]=reader_wait_ns, [4]=parse_ns, [5]=worker_wait_ns, [6]=consumer_wait_ns.
+void ingest_stats(void* handle, double* out, int32_t n) {
+  static_cast<Pipeline*>(handle)->Stats(out, n);
+}
 
 int64_t ingest_bytes_read(void* handle) {
   return static_cast<Pipeline*>(handle)->BytesRead();

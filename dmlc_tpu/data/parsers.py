@@ -600,6 +600,62 @@ class NativePipelineParser:
                 return
             yield block
 
+    # ---- native fixed-shape batch path (the TPU feed fast path) -------
+    # Re-batching to [batch_size] rows and densify/COO-pad run in C++
+    # (pipeline.cc StageBatch/FetchBatch*), so the per-batch Python work is
+    # one ctypes call + device_put. libsvm/libfm only (csv densifies via
+    # its table layout already).
+
+    @property
+    def supports_batch_fetch(self) -> bool:
+        from dmlc_tpu import native
+
+        return self._fmt in (native.INGEST_LIBSVM, native.INGEST_LIBFM)
+
+    def _stage(self, batch_size: int):
+        try:
+            return self._pipe.stage_batch(batch_size)
+        except DMLCError:
+            if self._feed_error is not None:
+                raise DMLCError(
+                    f"remote ingest feeder failed: {self._feed_error}"
+                ) from self._feed_error
+            raise
+
+    def read_batch_dense(self, batch_size: int, num_features: int):
+        """→ (x [batch,F] f32, labels, weights, valid_rows) or None at end
+        of stream. Short final batch is zero-padded (weight 0 rows)."""
+        if self._stage(batch_size) is None:
+            return None
+        return self._pipe.fetch_batch_dense(batch_size, num_features)
+
+    def read_batch_coo(
+        self, batch_size: int, nnz_bucket=None, nnz_floor: int = 256
+    ):
+        """→ DeviceCSRBatch or None at end of stream. The nnz bucket is
+        fixed when given, else the power-of-two policy of device/csr.py."""
+        from dmlc_tpu.device.csr import DeviceCSRBatch, round_up_bucket
+
+        staged = self._stage(batch_size)
+        if staged is None:
+            return None
+        _rows, nnz = staged
+        bucket = (
+            nnz_bucket if nnz_bucket is not None
+            else round_up_bucket(nnz, nnz_floor)
+        )
+        labels, weights, indices, values, row_ids, rows = (
+            self._pipe.fetch_batch_coo(batch_size, bucket)
+        )
+        return DeviceCSRBatch(
+            labels=labels, weights=weights, indices=indices, values=values,
+            row_ids=row_ids, num_rows=rows, num_nonzero=nnz,
+        )
+
+    def stats(self) -> Optional[dict]:
+        """Per-stage pipeline counters (ns), or None when closed."""
+        return self._pipe.stats() if self._pipe is not None else None
+
     def _teardown(self) -> None:
         if self._pipe is None:
             return

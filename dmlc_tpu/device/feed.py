@@ -78,7 +78,19 @@ class DeviceFeed:
         )
 
     # ---- host side: re-batch parser blocks into fixed-size slices ------
-    def _host_batches(self) -> Iterator[RowBlock]:
+    def _use_native_batches(self) -> bool:
+        """Native C++ re-batch + densify/COO-pad (pipeline.cc StageBatch):
+        no RowBlockContainer copies, no numpy scatter — the feed-side answer
+        to the parse-vs-feed throughput cliff (BASELINE.md)."""
+        return (
+            getattr(self._parser, "supports_batch_fetch", False)
+            and self.spec.layout in ("dense", "csr")
+        )
+
+    def _host_batches(self) -> Iterator:
+        if self._use_native_batches():
+            yield from self._host_batches_native()
+            return
         bs = self.spec.batch_size
         pending = RowBlockContainer()
         for block in self._parser:
@@ -95,6 +107,25 @@ class DeviceFeed:
                 pending.push_block(whole.slice(nfull * bs, len(whole)))
         if len(pending) and not self.spec.drop_remainder:
             yield pending.to_block()
+
+    def _host_batches_native(self) -> Iterator:
+        spec = self.spec
+        bs = spec.batch_size
+        while True:
+            if spec.layout == "dense":
+                check(spec.num_features > 0,
+                      "dense layout requires num_features")
+                out = self._parser.read_batch_dense(bs, spec.num_features)
+            else:
+                out = self._parser.read_batch_coo(
+                    bs, nnz_bucket=spec.nnz_bucket
+                )
+            if out is None:
+                return
+            rows = out[3] if spec.layout == "dense" else out.num_rows
+            if rows < bs and spec.drop_remainder:
+                return
+            yield out
 
     # ---- device side ---------------------------------------------------
     def _sharding(self, spec: P) -> Optional[NamedSharding]:
@@ -119,8 +150,19 @@ class DeviceFeed:
         shardings = {k: self._sharding(specs[k]) for k in arrays}
         return jax.device_put(arrays, shardings)
 
-    def _to_device(self, block: RowBlock):
+    def _to_device(self, block):
         spec = self.spec
+        if isinstance(block, tuple):  # native dense batch, pre-densified
+            x, labels, weights, rows = block
+            out = self._put_tree(
+                {"x": x, "label": labels, "weight": weights},
+                {"x": P(self._axis), "label": P(self._axis),
+                 "weight": P(self._axis)},
+            )
+            out["num_rows"] = rows
+            return out
+        if isinstance(block, DeviceCSRBatch):  # native COO batch, pre-padded
+            return self._put_csr(block)
         if spec.layout == "dense":
             check(spec.num_features > 0, "dense layout requires num_features")
             x, labels, weights = block_to_dense(
@@ -137,29 +179,32 @@ class DeviceFeed:
             batch: DeviceCSRBatch = pad_to_bucket(
                 block, spec.batch_size, nnz_bucket=spec.nnz_bucket
             )
-            # Entries are replicated over the mesh (row_ids address the global
-            # batch); rows are sharded. Sparse sharded SpMV splits by rows in
-            # ops.spmv via shard_map.
-            out = self._put_tree(
-                {
-                    "label": batch.labels,
-                    "weight": batch.weights,
-                    "indices": batch.indices,
-                    "values": batch.values,
-                    "row_ids": batch.row_ids,
-                },
-                {
-                    "label": P(self._axis),
-                    "weight": P(self._axis),
-                    "indices": P(),
-                    "values": P(),
-                    "row_ids": P(),
-                },
-            )
-            out["num_rows"] = batch.num_rows
-            out["num_nonzero"] = batch.num_nonzero
-            return out
+            return self._put_csr(batch)
         raise ValueError(f"unknown layout {spec.layout!r}")
+
+    def _put_csr(self, batch: DeviceCSRBatch):
+        # Entries are replicated over the mesh (row_ids address the global
+        # batch); rows are sharded. Sparse sharded SpMV splits by rows in
+        # ops.spmv via shard_map.
+        out = self._put_tree(
+            {
+                "label": batch.labels,
+                "weight": batch.weights,
+                "indices": batch.indices,
+                "values": batch.values,
+                "row_ids": batch.row_ids,
+            },
+            {
+                "label": P(self._axis),
+                "weight": P(self._axis),
+                "indices": P(),
+                "values": P(),
+                "row_ids": P(),
+            },
+        )
+        out["num_rows"] = batch.num_rows
+        out["num_nonzero"] = batch.num_nonzero
+        return out
 
     def __iter__(self):
         """Yield device batches with one transfer in flight ahead."""

@@ -117,6 +117,24 @@ def _bind(lib) -> None:
     ] * 7
     lib.ingest_block_free.restype = None
     lib.ingest_block_free.argtypes = [ctypes.c_void_p]
+    lib.ingest_stage_batch.restype = ctypes.c_int
+    lib.ingest_stage_batch.argtypes = [
+        ctypes.c_void_p, i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
+    ]
+    lib.ingest_fetch_batch_dense.restype = i64
+    lib.ingest_fetch_batch_dense.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        i64, i64,
+    ]
+    lib.ingest_fetch_batch_coo.restype = i64
+    lib.ingest_fetch_batch_coo.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, i64, i64,
+    ]
+    lib.ingest_stats.restype = None
+    lib.ingest_stats.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+    ]
     lib.ingest_bytes_read.restype = i64
     lib.ingest_bytes_read.argtypes = [ctypes.c_void_p]
     lib.ingest_close.restype = None
@@ -168,7 +186,7 @@ def _load(path: str):
         _bind(lib)
     except (OSError, AttributeError):
         return None
-    if lib.dmlc_tpu_abi_version() != 2:
+    if lib.dmlc_tpu_abi_version() != 3:
         raise DMLCError(f"native ABI mismatch in {path}")
     return lib
 
@@ -550,6 +568,61 @@ class IngestPipeline:
                 owner, fields_p, z, ctypes.c_uint32, np.uint32
             )
         return out
+
+    # ---- native batch staging (fixed-shape TPU feed) -----------------
+
+    def stage_batch(self, batch_size: int):
+        """Stage the next batch; → (rows, nnz) or None at end of stream.
+        rows = min(batch_size, rows left); the matching fetch consumes."""
+        rows = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        rc = self._lib.ingest_stage_batch(
+            self._handle, batch_size, ctypes.byref(rows), ctypes.byref(nnz)
+        )
+        if rc == 0:
+            return None
+        if rc < 0:
+            raise DMLCError(f"native ingest pipeline failed rc={rc}")
+        return rows.value, nnz.value
+
+    def fetch_batch_dense(self, batch_size: int, num_features: int):
+        """Consume the staged batch densified to [batch, F]; → (x, labels,
+        weights, rows). Rows past `rows` are zero-padded (weight 0)."""
+        x = np.empty((batch_size, num_features), dtype=np.float32)
+        labels = np.empty(batch_size, dtype=np.float32)
+        weights = np.empty(batch_size, dtype=np.float32)
+        rows = self._lib.ingest_fetch_batch_dense(
+            self._handle, _ptr(x), _ptr(labels), _ptr(weights),
+            batch_size, num_features,
+        )
+        if rows < 0:
+            raise DMLCError(f"native dense batch fetch failed rc={rows}")
+        return x, labels, weights, int(rows)
+
+    def fetch_batch_coo(self, batch_size: int, nnz_bucket: int):
+        """Consume the staged batch as padded COO; → (labels, weights,
+        indices, values, row_ids, rows)."""
+        labels = np.empty(batch_size, dtype=np.float32)
+        weights = np.empty(batch_size, dtype=np.float32)
+        indices = np.empty(nnz_bucket, dtype=np.int32)
+        values = np.empty(nnz_bucket, dtype=np.float32)
+        row_ids = np.empty(nnz_bucket, dtype=np.int32)
+        rows = self._lib.ingest_fetch_batch_coo(
+            self._handle, _ptr(labels), _ptr(weights), _ptr(indices),
+            _ptr(values), _ptr(row_ids), batch_size, nnz_bucket,
+        )
+        if rows < 0:
+            raise DMLCError(f"native coo batch fetch failed rc={rows}")
+        return labels, weights, indices, values, row_ids, int(rows)
+
+    def stats(self) -> dict:
+        """Per-stage counters (SURVEY §5.1 pipeline timers)."""
+        out = np.zeros(7, dtype=np.float64)
+        self._lib.ingest_stats(self._handle, _ptr(out), 7)
+        keys = ("bytes_read", "chunks", "reader_io_ns", "reader_wait_ns",
+                "parse_ns", "worker_wait_ns", "consumer_wait_ns")
+        return {k: (int(v) if k in ("bytes_read", "chunks") else float(v))
+                for k, v in zip(keys, out)}
 
     @property
     def bytes_read(self) -> int:

@@ -195,3 +195,148 @@ def test_empty_parts_beyond_data(tmp_path):
         r, _, _, _ = _collect(create_parser(str(path), part, 8))
         total += r
     assert total == 1
+
+
+# ---------------------------------------------------------------------------
+# Native batch staging (pipeline.cc StageBatch/FetchBatch*): the fixed-shape
+# TPU feed path — re-batch + densify/COO-pad in C++
+# ---------------------------------------------------------------------------
+
+
+def _dense_from_blocks(blocks, rows, num_features):
+    x = np.zeros((rows, num_features), dtype=np.float32)
+    labels = np.zeros(rows, dtype=np.float32)
+    off = 0
+    for b in blocks:
+        for r in range(len(b)):
+            labels[off + r] = b.label[r]
+            for k in range(b.offset[r], b.offset[r + 1]):
+                if b.index[k] < num_features:
+                    val = 1.0 if b.value is None else b.value[k]
+                    x[off + r, b.index[k]] = val
+        off += len(b)
+    return x, labels
+
+
+def test_batch_dense_matches_block_path(svm_file):
+    blocks = list(create_parser(svm_file, 0, 1))
+    want_x, want_labels = _dense_from_blocks(blocks, 997, 6)
+
+    parser = create_parser(svm_file, 0, 1)
+    assert parser.supports_batch_fetch
+    got_x, got_labels, got_w = [], [], []
+    total = 0
+    while True:
+        out = parser.read_batch_dense(128, 6)
+        if out is None:
+            break
+        x, labels, weights, n = out
+        assert x.shape == (128, 6)
+        # padding contract: rows past n are zero with weight 0
+        assert (weights[n:] == 0).all() and (weights[:n] == 1).all()
+        assert (x[n:] == 0).all() and (labels[n:] == 0).all()
+        got_x.append(x[:n])
+        got_labels.append(labels[:n])
+        total += n
+    parser.close()
+    assert total == 997
+    np.testing.assert_allclose(np.concatenate(got_x), want_x, rtol=1e-6)
+    np.testing.assert_array_equal(np.concatenate(got_labels), want_labels)
+
+
+def test_batch_coo_matches_block_path(svm_file):
+    blocks = list(create_parser(svm_file, 0, 1))
+    want_nnz = sum(b.num_nonzero for b in blocks)
+
+    parser = create_parser(svm_file, 0, 1)
+    rows = 0
+    nnz = 0
+    vals = []
+    while True:
+        batch = parser.read_batch_coo(100, nnz_floor=4)
+        if batch is None:
+            break
+        rows += batch.num_rows
+        nnz += batch.num_nonzero
+        # padded entries are arithmetic no-ops
+        assert (batch.values[batch.num_nonzero:] == 0).all()
+        assert (batch.indices[batch.num_nonzero:] == 0).all()
+        assert batch.nnz_bucket >= batch.num_nonzero
+        # row_ids address rows within this batch
+        if batch.num_nonzero:
+            assert batch.row_ids[: batch.num_nonzero].max() < batch.num_rows
+        vals.append(batch.values[: batch.num_nonzero])
+    parser.close()
+    assert rows == 997
+    assert nnz == want_nnz
+    want_vals = np.concatenate(
+        [b.value if b.value is not None
+         else np.ones(b.num_nonzero, np.float32) for b in blocks]
+    )
+    np.testing.assert_allclose(np.concatenate(vals), want_vals, rtol=1e-6)
+
+
+def test_batch_dense_partition_union(svm_file):
+    """Batched fetch over k-of-n partitions covers every row exactly once."""
+    whole = list(create_parser(svm_file, 0, 1))
+    _, want_labels = _dense_from_blocks(whole, 997, 6)
+    got = []
+    for part in range(3):
+        parser = create_parser(svm_file, part, 3)
+        while True:
+            out = parser.read_batch_dense(64, 6)
+            if out is None:
+                break
+            _x, labels, _w, n = out
+            got.append(labels[:n])
+        parser.close()
+    got = np.concatenate(got)
+    assert len(got) == 997
+    np.testing.assert_array_equal(got, want_labels)
+
+
+def test_pipeline_stats(svm_file):
+    parser = create_parser(svm_file, 0, 1)
+    list(parser)
+    stats = parser.stats()
+    assert stats["bytes_read"] > 0
+    assert stats["chunks"] >= 1
+    assert stats["parse_ns"] > 0
+    parser.close()
+
+
+def test_batch_csv_rejected(tmp_path):
+    path = tmp_path / "d.csv"
+    path.write_text("1,2,3\n4,5,6\n")
+    parser = create_parser(str(path), 0, 1, data_format="csv")
+    assert isinstance(parser, NativePipelineParser)
+    assert not parser.supports_batch_fetch
+    parser.close()
+
+
+def test_device_feed_native_path_matches_legacy(svm_file):
+    """DeviceFeed over the native batch path == the RowBlock re-batch path."""
+    import jax
+
+    from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+    spec = BatchSpec(batch_size=128, layout="dense", num_features=6)
+    feed_native = DeviceFeed(create_parser(svm_file, 0, 1), spec)
+    assert feed_native._use_native_batches()
+    native_batches = [jax.device_get(b["x"]) for b in feed_native]
+    feed_native.close()
+
+    os.environ["DMLC_TPU_NATIVE"] = "0"
+    try:
+        py_parser = create_parser(svm_file, 0, 1)
+        assert not isinstance(py_parser, NativePipelineParser)
+        feed_py = DeviceFeed(py_parser, spec)
+        assert not feed_py._use_native_batches()
+        py_batches = [jax.device_get(b["x"]) for b in feed_py]
+        feed_py.close()
+    finally:
+        del os.environ["DMLC_TPU_NATIVE"]
+
+    assert len(native_batches) == len(py_batches)
+    for a, b in zip(native_batches, py_batches):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
