@@ -47,11 +47,30 @@ int parse_csv(const char* data, int64_t len, float* out, int64_t max_rows,
               int64_t expect_cols, int64_t* out_rows, int64_t* out_cols);
 void count_tokens(const char* data, int64_t len, int64_t* out_rows,
                   int64_t* out_tokens);
+// recordio.cc framing primitives
+int recordio_unpack(const char* buf, int64_t len, char* out_data,
+                    int64_t* out_offsets, int64_t* out_nrec,
+                    int64_t* out_datalen, int64_t* out_consumed);
+int64_t recordio_find_head(const char* buf, int64_t len, int64_t start);
 }
 
 namespace {
 
-enum Format { kLibsvm = 0, kLibfm = 1, kCsv = 2 };
+enum Format { kLibsvm = 0, kLibfm = 1, kCsv = 2, kRecordIO = 3 };
+
+// RecordIO framing constants (cpp/recordio.cc; reference recordio.h:17-70)
+constexpr uint32_t kRioMagic = 0xced7230aU;
+
+// Row-group payload: the binary row format carried inside RecordIO frames —
+// the TPU build's answer to "binary shards must beat text parse" (the
+// reference splits recordio natively, src/io/recordio_split.cc:9-82, but
+// its data parsers are text-only; here the payload IS the CSR block, so
+// ingest is framing + memcpy, no byte scanning). Layout, little-endian:
+//   u8 tag 'R', u8 flags (1=weights 2=qids 4=values), u16 reserved,
+//   u32 nrows, u32 nnz,
+//   labels f32[nrows], weights f32[nrows]?, qids i64[nrows]?,
+//   row_nnz u32[nrows], indices u32[nnz], values f32[nnz]?
+constexpr uint8_t kRowGroupTag = 0x52;
 
 enum {
   kOk = 0,
@@ -610,10 +629,15 @@ class Pipeline {
   }
 
   // ---- reader side ----------------------------------------------------
-  // adj(x): first record-begin at global offset >= x (0 stays 0). Scans to
-  // the first EOL char then consumes the whole EOL run, the LineSplitter
-  // SeekRecordBegin contract (line_split.cc:9-26).
+  // adj(x): first record-begin at global offset >= x (0 stays 0). Text
+  // formats scan to the first EOL char then consume the whole EOL run, the
+  // LineSplitter SeekRecordBegin contract (line_split.cc:9-26); recordio
+  // scans aligned words for a head frame (recordio_split.cc:9-25 — exact,
+  // not heuristic: packing elides aligned embedded magics, so an aligned
+  // magic word can only be a frame head, and cflag 0/1 selects record
+  // starts over continuations).
   int64_t AdjustBoundary(RangeReader* rd, int64_t x) {
+    if (format_ == kRecordIO) return AdjustBoundaryRecordIO(rd, x);
     if (x <= 0) return 0;
     if (x >= rd->total()) return rd->total();
     if (!rd->SeekGlobal(x)) return -1;
@@ -635,11 +659,39 @@ class Pipeline {
     }
   }
 
+  int64_t AdjustBoundaryRecordIO(RangeReader* rd, int64_t x) {
+    if (x <= 0) return 0;
+    int64_t total = rd->total();
+    if (x >= total) return total;
+    int64_t base = (x + 3) & ~int64_t(3);  // heads sit on 4B alignment
+    if (!rd->SeekGlobal(base)) return -1;
+    char buf[4096 + 8];
+    int64_t avail = 0;
+    for (;;) {
+      int64_t n = rd->Read(buf + avail, 4096);
+      if (n < 0) return -1;
+      avail += n;
+      int64_t hit = recordio_find_head(buf, avail, 0);
+      if (hit >= 0) return base + hit;
+      if (n == 0) return total;  // no head before EOF
+      // keep the unscanned aligned tail (< 8 bytes) for the next round
+      int64_t processed = std::max<int64_t>(0, (avail - 4) & ~int64_t(3));
+      std::memmove(buf, buf + processed, avail - processed);
+      base += processed;
+      avail -= processed;
+    }
+  }
+
   void ReaderMain() {
     RangeReader rd(paths_, sizes_);
     int64_t total = rd.total();
-    // ceil-div step, matching input_split_base.cc:30-40 with align=1
+    // ceil-div step, matching input_split_base.cc:30-40; recordio rounds
+    // the step to 4B alignment like the Python splitter (input_split.py
+    // reset_partition) so both stacks assign boundary records to the SAME
+    // part — a mixed native/fallback job must still tile exactly-once
+    int64_t align = (format_ == kRecordIO) ? 4 : 1;
     int64_t nstep = (total + nparts_ - 1) / nparts_;
+    nstep = (nstep + align - 1) / align * align;
     int64_t raw_begin = std::min<int64_t>(nstep * part_, total);
     int64_t raw_end = std::min<int64_t>(nstep * (part_ + 1), total);
     if (raw_begin >= raw_end) {
@@ -729,9 +781,23 @@ class Pipeline {
     FinishReader(seq);
   }
 
-  // offset just past the last EOL char at index >= 1, or 0 when none
-  // (line_split.cc FindLastRecordBegin semantics).
-  static int64_t LastRecordBegin(const Buf& buf) {
+  // Offset of the last record begin at index >= 1, or 0 when none. Text:
+  // just past the last EOL char (line_split.cc FindLastRecordBegin).
+  // RecordIO: the last aligned head frame (the chunk starts at a head, so
+  // in-buffer heads stay 4B-aligned; see AdjustBoundary notes).
+  int64_t LastRecordBegin(const Buf& buf) const {
+    if (format_ == kRecordIO) {
+      for (int64_t i = (buf.size - 8) & ~int64_t(3); i >= 4; i -= 4) {
+        uint32_t w;
+        std::memcpy(&w, buf.p + i, 4);
+        if (w != kRioMagic) continue;
+        uint32_t lrec;
+        std::memcpy(&lrec, buf.p + i + 4, 4);
+        uint32_t cflag = lrec >> 29;
+        if (cflag == 0 || cflag == 1) return i;
+      }
+      return 0;
+    }
     for (int64_t i = buf.size - 1; i >= 1; --i) {
       if (is_eol(buf.p[i])) return i + 1;
     }
@@ -854,6 +920,7 @@ class Pipeline {
     const char* p = data.p;
     int64_t len = data.size;
     if (format_ == kCsv) return ParseCsvChunk(p, len, b);
+    if (format_ == kRecordIO) return ParseRecordIOChunk(p, len, b);
     int64_t bound = len / 2 + 2;  // rows and nnz are both >= 2 bytes each
     b->labels = AllocArray<float>(bound);
     b->offsets = AllocArray<int64_t>(bound + 1);
@@ -914,6 +981,129 @@ class Pipeline {
     return kOk;
   }
 
+  // Decode a chunk of RecordIO-framed row groups into one CSR block: strip
+  // the framing (recordio_unpack), then memcpy the typed sections — no text
+  // scanning anywhere. Chunks are cut at record heads, so the frame stream
+  // must decode completely.
+  int ParseRecordIOChunk(const char* p, int64_t len, Block* b) {
+    // reassembly re-inserts elided magics: output can exceed payload bytes
+    // but never input length + one magic per frame
+    Buf payload;
+    if (!payload.Reserve(len + 4)) return kEOom;
+    int64_t max_rec = len / 8 + 2;
+    int64_t* offsets = AllocArray<int64_t>(max_rec + 1);
+    if (offsets == nullptr) return kEOom;
+    int64_t nrec = 0, dlen = 0, consumed = 0;
+    int rc = recordio_unpack(p, len, payload.p, offsets, &nrec, &dlen,
+                             &consumed);
+    if (rc != 0 || consumed != len) {
+      std::free(offsets);
+      return kEParse;
+    }
+    // pass 1: header validation + totals
+    int64_t rows = 0, nnz = 0;
+    int flags = 0;
+    for (int64_t r = 0; r < nrec; ++r) {
+      const char* rp = payload.p + offsets[r];
+      int64_t rlen = offsets[r + 1] - offsets[r];
+      uint32_t n, z;
+      uint8_t rflags;
+      if (!RowGroupHeader(rp, rlen, &n, &z, &rflags)) {
+        std::free(offsets);
+        return kEParse;
+      }
+      rows += n;
+      nnz += z;
+      flags |= rflags;
+    }
+    b->labels = AllocArray<float>(rows + 1);
+    b->offsets = AllocArray<int64_t>(rows + 1);
+    b->indices = reinterpret_cast<uint64_t*>(AllocArray<uint32_t>(nnz + 1));
+    if (b->labels == nullptr || b->offsets == nullptr ||
+        b->indices == nullptr) {
+      std::free(offsets);
+      return kEOom;
+    }
+    if (flags & kHasWeight) b->weights = AllocArray<float>(rows + 1);
+    if (flags & kHasQid) b->qids = AllocArray<int64_t>(rows + 1);
+    if (flags & kHasValue) b->values = AllocArray<float>(nnz + 1);
+    if (((flags & kHasWeight) && b->weights == nullptr) ||
+        ((flags & kHasQid) && b->qids == nullptr) ||
+        ((flags & kHasValue) && b->values == nullptr)) {
+      std::free(offsets);
+      return kEOom;
+    }
+    // pass 2: memcpy the sections
+    uint32_t* idx_out = reinterpret_cast<uint32_t*>(b->indices);
+    int64_t row_at = 0, nnz_at = 0;
+    b->offsets[0] = 0;
+    for (int64_t r = 0; r < nrec; ++r) {
+      const char* rp = payload.p + offsets[r];
+      uint32_t n = 0, z = 0;
+      uint8_t rflags = 0;  // header re-read; validated in pass 1
+      RowGroupHeader(rp, offsets[r + 1] - offsets[r], &n, &z, &rflags);
+      const char* q = rp + 12;
+      std::memcpy(b->labels + row_at, q, n * 4);
+      q += int64_t(n) * 4;
+      if (rflags & kHasWeight) {
+        std::memcpy(b->weights + row_at, q, n * 4);
+        q += int64_t(n) * 4;
+      } else if (flags & kHasWeight) {
+        for (uint32_t i = 0; i < n; ++i) b->weights[row_at + i] = 1.0f;
+      }
+      if (rflags & kHasQid) {
+        std::memcpy(b->qids + row_at, q, n * 8);
+        q += int64_t(n) * 8;
+      } else if (flags & kHasQid) {
+        std::memset(b->qids + row_at, 0, n * 8);
+      }
+      // row_nnz -> running offsets
+      const uint32_t* row_nnz = reinterpret_cast<const uint32_t*>(q);
+      for (uint32_t i = 0; i < n; ++i) {
+        b->offsets[row_at + i + 1] =
+            b->offsets[row_at + i] + row_nnz[i];
+      }
+      q += int64_t(n) * 4;
+      std::memcpy(idx_out + nnz_at, q, z * 4);
+      q += int64_t(z) * 4;
+      if (rflags & kHasValue) {
+        std::memcpy(b->values + nnz_at, q, z * 4);
+      } else if (flags & kHasValue) {
+        for (uint32_t k = 0; k < z; ++k) b->values[nnz_at + k] = 1.0f;
+      }
+      row_at += n;
+      nnz_at += z;
+    }
+    std::free(offsets);
+    if (b->offsets[rows] != nnz) return kEParse;  // row_nnz vs nnz mismatch
+    b->rows = rows;
+    b->nnz = nnz;
+    b->flags = flags;
+    return kOk;
+  }
+
+  // Validate one row-group payload; false on malformed. Exact-size check
+  // keeps a corrupt length from driving the memcpys past the payload.
+  static bool RowGroupHeader(const char* p, int64_t len, uint32_t* nrows,
+                             uint32_t* nnz, uint8_t* flags) {
+    if (len < 12) return false;
+    if (static_cast<uint8_t>(p[0]) != kRowGroupTag) return false;
+    uint8_t fl = static_cast<uint8_t>(p[1]);
+    if (fl & ~uint8_t(kHasWeight | kHasQid | kHasValue)) return false;
+    uint32_t n, z;
+    std::memcpy(&n, p + 4, 4);
+    std::memcpy(&z, p + 8, 4);
+    int64_t want = 12 + int64_t(n) * 4 + int64_t(n) * 4 + int64_t(z) * 4;
+    if (fl & kHasWeight) want += int64_t(n) * 4;
+    if (fl & kHasQid) want += int64_t(n) * 8;
+    if (fl & kHasValue) want += int64_t(z) * 4;
+    if (want != len) return false;
+    *nrows = n;
+    *nnz = z;
+    *flags = fl;
+    return true;
+  }
+
   // ---- state ----------------------------------------------------------
   const std::vector<std::string> paths_;
   const std::vector<int64_t> sizes_;
@@ -969,7 +1159,7 @@ void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
                   int32_t nthread, int64_t chunk_bytes, int32_t capacity,
                   int64_t csv_expect_cols) {
   if (nfiles <= 0 || part < 0 || nparts <= 0 || part >= nparts) return nullptr;
-  if (format < 0 || format > 2) return nullptr;
+  if (format < 0 || format > 3) return nullptr;
   std::vector<std::string> path_vec;
   const char* p = paths;
   for (int32_t i = 0; i < nfiles; ++i) {
@@ -991,7 +1181,7 @@ void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
 // consumers blocked in ingest_peek fail instead of hanging.
 void* ingest_open_push(int32_t format, int32_t nthread, int64_t chunk_bytes,
                        int32_t capacity, int64_t csv_expect_cols) {
-  if (format < 0 || format > 2) return nullptr;
+  if (format < 0 || format > 3) return nullptr;
   Pipeline* pl = new Pipeline({}, {}, format, 0, 1, nthread, chunk_bytes,
                               capacity, csv_expect_cols, /*push_mode=*/true);
   pl->Start();

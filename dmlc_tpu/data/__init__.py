@@ -25,6 +25,13 @@ from dmlc_tpu.data.row_iter import (
     DiskRowIter,
     create_row_block_iter,
 )
+from dmlc_tpu.data.rowrec import (
+    RecordIORowParser,
+    convert_to_recordio,
+    decode_row_group,
+    encode_row_group,
+    write_recordio_rows,
+)
 
 __all__ = [
     "Row",
@@ -42,4 +49,9 @@ __all__ = [
     "BasicRowIter",
     "DiskRowIter",
     "create_row_block_iter",
+    "RecordIORowParser",
+    "convert_to_recordio",
+    "decode_row_group",
+    "encode_row_group",
+    "write_recordio_rows",
 ]

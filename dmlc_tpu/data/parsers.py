@@ -479,6 +479,7 @@ class NativePipelineParser:
             "libsvm": native.INGEST_LIBSVM,
             "libfm": native.INGEST_LIBFM,
             "csv": native.INGEST_CSV,
+            "recordio": native.INGEST_RECORDIO,
         }[data_format]
         self._open_args = (paths, sizes, part_index, num_parts, nthread)
         self._remote_fs = remote_fs
@@ -524,6 +525,9 @@ class NativePipelineParser:
             ) << 20,
             connections=int(
                 os.environ.get("DMLC_TPU_READAHEAD_CONNS", DEFAULT_CONNECTIONS)
+            ),
+            record_format=(
+                "recordio" if self._fmt_name == "recordio" else "text"
             ),
         )
         self._pipe = native.IngestPipeline(
@@ -610,7 +614,10 @@ class NativePipelineParser:
     def supports_batch_fetch(self) -> bool:
         from dmlc_tpu import native
 
-        return self._fmt in (native.INGEST_LIBSVM, native.INGEST_LIBFM)
+        return self._fmt in (
+            native.INGEST_LIBSVM, native.INGEST_LIBFM,
+            native.INGEST_RECORDIO,
+        )
 
     def _stage(self, batch_size: int):
         try:
@@ -701,7 +708,7 @@ def _try_native_pipeline(
     the parallel-readahead push path. Mixed/unlistable datasets fall back
     to the Python InputSplit stack.
     """
-    if data_format not in ("libsvm", "libfm", "csv"):
+    if data_format not in ("libsvm", "libfm", "csv", "recordio"):
         return None
     if spec.cache_file:
         return None
@@ -792,9 +799,20 @@ def register_parser(name: str, factory=None):
     return PARSER_REGISTRY.register(name, factory) if factory else PARSER_REGISTRY.register(name)
 
 
+def _make_recordio_parser(source, args, nthread):
+    from dmlc_tpu.data.rowrec import RecordIORowParser
+
+    return RecordIORowParser(source, args, nthread)
+
+
 register_parser("libsvm", lambda source, args, nthread: LibSVMParser(source, nthread))
 register_parser("libfm", lambda source, args, nthread: LibFMParser(source, nthread))
 register_parser("csv", lambda source, args, nthread: CSVParser(source, args, nthread))
+register_parser("recordio", _make_recordio_parser)
+
+# InputSplit record type per format ("text" unless registered here): the
+# recordio parser consumes whole framed records, not lines
+_SPLIT_TYPE = {"recordio": "recordio"}
 
 
 def create_parser(
@@ -829,6 +847,8 @@ def create_parser(
         )
         if native_parser is not None:
             return native_parser
-    source = create_input_split(uri, part_index, num_parts, "text")
+    source = create_input_split(
+        uri, part_index, num_parts, _SPLIT_TYPE.get(data_format, "text")
+    )
     base = entry(source, spec.args, nthread)
     return ThreadedParser(base) if threaded else base

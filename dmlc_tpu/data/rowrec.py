@@ -1,0 +1,210 @@
+"""Binary row-group records: CSR blocks inside RecordIO framing.
+
+The reference splits RecordIO natively (src/io/recordio_split.cc:9-82) but
+its data parsers are text-only — every Criteo-class ingest pays a byte-scan
+tax per epoch. The TPU build makes binary shards the fast path: a row group
+is a serialized CSR slice, so decode is framing + memcpy with no scanning.
+``cpp/pipeline.cc`` ParseRecordIOChunk is the native decoder; this module is
+its Python twin plus the writer/converter tooling.
+
+Payload layout (little-endian), mirrored in pipeline.cc RowGroupHeader:
+
+    u8  tag 0x52 ('R')
+    u8  flags: 1=weights, 2=qids, 4=values
+    u16 reserved (0)
+    u32 nrows
+    u32 nnz
+    labels  f32[nrows]
+    weights f32[nrows]      (iff flags & 1)
+    qids    i64[nrows]      (iff flags & 2)
+    row_nnz u32[nrows]
+    indices u32[nnz]
+    values  f32[nnz]        (iff flags & 4)
+
+libfm ``field`` arrays are not carried: the row-group format targets the
+libsvm-style CSR contract (data.h:170-230); field-aware datasets stay on
+the text path.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, Optional
+
+import numpy as np
+
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.io.recordio import RecordIOWriter
+from dmlc_tpu.utils.logging import DMLCError, check
+
+ROW_GROUP_TAG = 0x52
+HAS_WEIGHT = 1
+HAS_QID = 2
+HAS_VALUE = 4
+
+_HEADER = struct.Struct("<BBHII")
+
+
+def encode_row_group(block: RowBlock) -> bytes:
+    """Serialize a RowBlock slice into one row-group payload."""
+    n = len(block)
+    nnz = block.num_nonzero
+    check(
+        block.field is None,
+        "row-group records do not carry libfm fields",
+    )
+    index = np.ascontiguousarray(block.index, dtype=np.uint32)
+    flags = 0
+    parts = []
+    if block.weight is not None:
+        flags |= HAS_WEIGHT
+    if block.qid is not None:
+        flags |= HAS_QID
+    if block.value is not None:
+        flags |= HAS_VALUE
+    parts.append(_HEADER.pack(ROW_GROUP_TAG, flags, 0, n, nnz))
+    parts.append(np.ascontiguousarray(block.label, np.float32).tobytes())
+    if block.weight is not None:
+        parts.append(np.ascontiguousarray(block.weight, np.float32).tobytes())
+    if block.qid is not None:
+        parts.append(np.ascontiguousarray(block.qid, np.int64).tobytes())
+    row_nnz = np.diff(np.asarray(block.offset, np.int64)).astype(np.uint32)
+    parts.append(row_nnz.tobytes())
+    parts.append(index.tobytes())
+    if block.value is not None:
+        parts.append(np.ascontiguousarray(block.value, np.float32).tobytes())
+    return b"".join(parts)
+
+
+def decode_row_group(payload: bytes) -> RowBlock:
+    """Pure-Python twin of pipeline.cc ParseRecordIOChunk's per-record
+    decode (the no-native fallback)."""
+    if len(payload) < _HEADER.size:
+        raise DMLCError("row-group record too short")
+    tag, flags, _resv, n, nnz = _HEADER.unpack_from(payload, 0)
+    if tag != ROW_GROUP_TAG:
+        raise DMLCError("not a row-group record (bad tag)")
+    pos = _HEADER.size
+
+    def take(count: int, dtype) -> np.ndarray:
+        nonlocal pos
+        nbytes = count * np.dtype(dtype).itemsize
+        if pos + nbytes > len(payload):
+            raise DMLCError("truncated row-group record")
+        out = np.frombuffer(payload, dtype=dtype, count=count, offset=pos)
+        pos += nbytes
+        return out
+
+    label = take(n, np.float32)
+    weight = take(n, np.float32) if flags & HAS_WEIGHT else None
+    qid = take(n, np.int64) if flags & HAS_QID else None
+    row_nnz = take(n, np.uint32)
+    index = take(nnz, np.uint32)
+    value = take(nnz, np.float32) if flags & HAS_VALUE else None
+    if pos != len(payload):
+        raise DMLCError("row-group record has trailing bytes")
+    offset = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(row_nnz, out=offset[1:])
+    if int(offset[-1]) != nnz:
+        raise DMLCError("row-group nnz mismatch")
+    return RowBlock(
+        offset=offset, label=label, index=index,
+        value=value, weight=weight, qid=qid,
+    )
+
+
+class RowGroupWriter:
+    """Write RowBlocks as row-group records over a Stream.
+
+    ``rows_per_group`` bounds record size so partitioning stays balanced
+    (the recordio splitter partitions by records).
+    """
+
+    def __init__(self, stream, rows_per_group: int = 1024):
+        check(rows_per_group > 0, "rows_per_group must be positive")
+        self._writer = RecordIOWriter(stream)
+        self._rows_per_group = rows_per_group
+
+    def write_block(self, block: RowBlock) -> None:
+        for start in range(0, len(block), self._rows_per_group):
+            stop = min(start + self._rows_per_group, len(block))
+            self._writer.write_record(encode_row_group(block.slice(start, stop)))
+
+
+def write_recordio_rows(
+    uri: str, blocks: Iterable[RowBlock], rows_per_group: int = 1024
+) -> None:
+    """Write an iterable of RowBlocks to ``uri`` as a row-group RecordIO
+    file (any registered filesystem)."""
+    from dmlc_tpu.io.filesystem import create_stream
+
+    with create_stream(uri, "w") as out:
+        writer = RowGroupWriter(out, rows_per_group=rows_per_group)
+        for block in blocks:
+            writer.write_block(block)
+
+
+def convert_to_recordio(
+    src_uri: str,
+    dst_uri: str,
+    data_format: str = "auto",
+    rows_per_group: int = 1024,
+    nthread: int = 2,
+) -> int:
+    """Convert any parseable dataset to the binary row-group format
+    (the one-time cost that buys scan-free epochs). Returns rows written."""
+    from dmlc_tpu.data.parsers import create_parser
+
+    parser = create_parser(src_uri, 0, 1, data_format=data_format,
+                           nthread=nthread)
+    rows = 0
+
+    def _blocks():
+        nonlocal rows
+        for block in parser:
+            rows += len(block)
+            yield block
+
+    try:
+        write_recordio_rows(dst_uri, _blocks(), rows_per_group=rows_per_group)
+    finally:
+        parser.close()
+    return rows
+
+
+class RecordIORowParser:
+    """Python-stack parser for row-group RecordIO datasets (no-native
+    fallback; the native path is pipeline.cc format=3)."""
+
+    def __init__(self, source, args=None, nthread: int = 2):
+        self._source = source
+        self._bytes_read = 0
+
+    @property
+    def bytes_read(self) -> int:
+        # payload bytes consumed (InputSplit sources don't expose a byte
+        # counter; framing overhead is excluded)
+        return self._bytes_read
+
+    def next_block(self) -> Optional[RowBlock]:
+        while True:
+            rec = self._source.next_record()
+            if rec is None:
+                return None
+            self._bytes_read += len(rec)
+            block = decode_row_group(bytes(rec))
+            if len(block):
+                return block
+
+    def __iter__(self):
+        while True:
+            block = self.next_block()
+            if block is None:
+                return
+            yield block
+
+    def before_first(self) -> None:
+        self._source.before_first()
+
+    def close(self) -> None:
+        self._source.close()

@@ -92,8 +92,12 @@ class RemotePartitionReader:
         nparts: int,
         range_bytes: int = DEFAULT_RANGE_BYTES,
         connections: int = DEFAULT_CONNECTIONS,
+        record_format: str = "text",
     ):
         check(0 <= part < nparts, "bad part %d/%d", part, nparts)
+        check(record_format in ("text", "recordio"),
+              "unknown record_format %r", record_format)
+        self._record_format = record_format
         self._fs = fs
         self._cancel = threading.Event()
         # duck-typed filesystems may not take the cancelled kwarg
@@ -112,6 +116,11 @@ class RemotePartitionReader:
         self._connections = max(1, int(connections))
         total = self._offsets[-1]
         nstep = (total + nparts - 1) // nparts
+        # recordio steps stay 4B-aligned, matching input_split.py
+        # reset_partition and pipeline.cc ReaderMain (same-part guarantee
+        # for boundary records across all three stacks)
+        align = 4 if record_format == "recordio" else 1
+        nstep = (nstep + align - 1) // align * align
         raw_begin = min(nstep * part, total)
         raw_end = min(nstep * (part + 1), total)
         if raw_begin >= raw_end:
@@ -139,13 +148,18 @@ class RemotePartitionReader:
         return bytes(out)
 
     def _adjust_boundary(self, pos: int) -> int:
-        """adj(x): first record begin at global offset >= x (0 stays 0) —
-        probe forward past the next end-of-line run (line_split.cc:9-26)."""
+        """adj(x): first record begin at global offset >= x (0 stays 0).
+        Text probes forward past the next end-of-line run
+        (line_split.cc:9-26); recordio scans aligned words for a head frame
+        (recordio_split.cc:9-25 — exact, since packing elides aligned
+        embedded magics; see cpp/pipeline.cc AdjustBoundaryRecordIO)."""
         if pos <= 0:
             return 0
         total = self._offsets[-1]
         if pos >= total:
             return total
+        if self._record_format == "recordio":
+            return self._adjust_boundary_recordio(pos, total)
         seen_eol = False
         while pos < total:
             probe = self._global_read(pos, 4096)
@@ -157,6 +171,33 @@ class RemotePartitionReader:
                 elif seen_eol:
                     return pos + i
             pos += len(probe)
+        return total
+
+    def _adjust_boundary_recordio(self, pos: int, total: int) -> int:
+        import numpy as np
+
+        from dmlc_tpu.io import recordio as _rio
+
+        base = (pos + 3) & ~3  # heads sit on 4B alignment
+        carry = b""
+        while base + len(carry) < total:
+            probe = self._global_read(base + len(carry), 1 << 16)
+            if not probe:
+                break
+            buf = carry + probe
+            words = np.frombuffer(
+                buf[: len(buf) & ~3], dtype="<u4"
+            )
+            if len(words) >= 2:
+                hits = np.nonzero(words[:-1] == _rio.RECORDIO_MAGIC)[0]
+                flags = (words[hits + 1] >> 29) & 7
+                good = hits[(flags == 0) | (flags == 1)]
+                if good.size:
+                    return base + (int(good[0]) << 2)
+            # keep the unscanned aligned tail (< 8 bytes)
+            processed = max(0, (len(buf) - 4) & ~3)
+            carry = buf[processed:]
+            base += processed
         return total
 
     # ---- ranged fetch plan -------------------------------------------

@@ -408,6 +408,7 @@ def recordio_unpack_chunk(chunk: bytes) -> Optional[tuple]:
 INGEST_LIBSVM = 0
 INGEST_LIBFM = 1
 INGEST_CSV = 2
+INGEST_RECORDIO = 3  # row-group records (data/rowrec.py layout)
 
 
 class _NativeBlock:
@@ -542,7 +543,7 @@ class IngestPipeline:
             ).reshape(n, ncols.value)
             return {"table": table}
 
-        is_svm = self._fmt == INGEST_LIBSVM
+        is_svm = self._fmt in (INGEST_LIBSVM, INGEST_RECORDIO)
         out = {
             "labels": _block_view(owner, labels_p, n, ctypes.c_float,
                                   np.float32),
