@@ -242,22 +242,28 @@ def _bench_remote_ingest(path: str) -> float:
         with open(path, "rb") as fh:
             store.objects[("bench", "higgs.svm")] = fh.read()
         size = os.path.getsize(path)
+        nthread = 1 if (os.cpu_count() or 1) <= 2 else 2
         best = 0.0
         for conns in (1, 4):
             os.environ["DMLC_TPU_READAHEAD_CONNS"] = str(conns)
-            t0 = time.time()
-            parser = create_parser("s3://bench/higgs.svm", 0, 1, nthread=2)
-            if not isinstance(parser, NativePipelineParser):
-                parser.close()
-                raise RuntimeError(
-                    "native remote routing declined; got "
-                    + type(parser).__name__
+            runs = []
+            for _ in range(2):
+                t0 = time.time()
+                parser = create_parser(
+                    "s3://bench/higgs.svm", 0, 1, nthread=nthread
                 )
-            rows = sum(len(b) for b in parser)
-            dt = time.time() - t0
-            parser.close()
-            assert rows == ROWS, f"remote row count mismatch: {rows}"
-            best = max(best, size / (1 << 20) / dt)
+                if not isinstance(parser, NativePipelineParser):
+                    parser.close()
+                    raise RuntimeError(
+                        "native remote routing declined; got "
+                        + type(parser).__name__
+                    )
+                rows = sum(len(b) for b in parser)
+                dt = time.time() - t0
+                parser.close()
+                assert rows == ROWS, f"remote row count mismatch: {rows}"
+                runs.append(size / (1 << 20) / dt)
+            best = max(best, statistics.median(runs))
         return best
     finally:
         server.shutdown()

@@ -329,6 +329,41 @@ class Pipeline {
     return 0;
   }
 
+  // Zero-copy variant of Push: the caller writes into the pipeline's own
+  // tail buffer (HTTP readinto lands remote bytes directly in native
+  // memory) and commits. The returned pointer is valid only until the
+  // next Reserve/Commit/Push call. NULL on OOM or a failed pipeline.
+  char* PushReserve(int64_t want) {
+    if (!push_mode_ || want < 0) return nullptr;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stop_ || error_ != 0) return nullptr;
+    }
+    if (!push_tail_.Reserve(push_tail_.size + want)) {
+      Fail(kEOom);
+      return nullptr;
+    }
+    return push_tail_.p + push_tail_.size;
+  }
+
+  // Append n caller-written bytes to the tail and emit any complete
+  // chunks (same cut discipline as Push; blocks for backpressure).
+  int PushCommit(int64_t n) {
+    if (!push_mode_ || n < 0) return kEIo;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stop_) return kEIo;
+      if (error_ != 0) return error_;
+    }
+    push_tail_.size += n;
+    while (push_tail_.size >= chunk_bytes_) {
+      int64_t cut = LastRecordBegin(push_tail_);
+      if (cut == 0) break;  // no boundary yet: keep accumulating
+      if (!EmitPushChunk(cut)) return kEIo;
+    }
+    return 0;
+  }
+
   // Flush the remaining tail (the caller guarantees the pushed range ends
   // at a record boundary, so the tail is whole records) and close the
   // stream. Idempotent. Returns 0, or the pipeline's error code.
@@ -1196,6 +1231,17 @@ int ingest_push(void* handle, const char* data, int64_t len) {
 
 int ingest_push_eof(void* handle) {
   return static_cast<Pipeline*>(handle)->PushEof();
+}
+
+// Zero-copy push: reserve tail space to write into (valid until the next
+// reserve/commit/push), then commit the bytes written. Feeders use this to
+// readinto() remote responses directly into pipeline memory.
+void* ingest_push_reserve(void* handle, int64_t want) {
+  return static_cast<Pipeline*>(handle)->PushReserve(want);
+}
+
+int ingest_push_commit(void* handle, int64_t n) {
+  return static_cast<Pipeline*>(handle)->PushCommit(n);
 }
 
 void ingest_push_abort(void* handle) {

@@ -423,11 +423,27 @@ def _feed_pipeline(pipe, reader, error_holder: list) -> None:
     from dmlc_tpu.utils.logging import DMLCError as _DMLCError
 
     try:
-        for buf in reader:
+        if getattr(reader, "prefers_direct_feed", False) and hasattr(
+            pipe, "push_reserve"
+        ):
+            from dmlc_tpu.io.readahead import PushRejected
+
+            # single connection: stream each range straight into native
+            # push memory (readinto), no per-range Python buffers. Fetch
+            # errors fall through to the abort path below; a rejected
+            # push means the pipeline already failed — record nothing so
+            # its own error wins at the consumer (same contract as the
+            # pipe.push loop).
             try:
-                pipe.push(buf)
-            except _DMLCError:
-                return  # pipeline already failed/closed; its error wins
+                reader.feed_into(pipe)
+            except PushRejected:
+                return
+        else:
+            for buf in reader:
+                try:
+                    pipe.push(buf)
+                except _DMLCError:
+                    return
         try:
             pipe.push_eof()
         except _DMLCError:

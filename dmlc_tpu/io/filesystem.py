@@ -306,7 +306,8 @@ def read_range_with_retry(
     max_retry: int = 50,
     retry_sleep_s: float = 0.1,
     cancelled=None,
-) -> bytes:
+    into=None,
+):
     """One logical bounded range read over HTTP-shaped backends, with
     per-range retry — the single copy of the remote ``read_range`` loop
     shared by the object stores and WebHDFS.
@@ -326,23 +327,47 @@ def read_range_with_retry(
     import time as _time
     import urllib.error
 
-    out = bytearray()
+    # single preallocated buffer + readinto: the ingest hot path hands
+    # every fetched byte to the native pipeline, so the fetch layer must
+    # not stack per-chunk bytes + extend + final-join copies on top.
+    # `into` (a writable memoryview >= length) skips even that buffer —
+    # the response body lands in caller memory and the return is the count.
+    if into is None:
+        out = bytearray(length)
+        view = memoryview(out)
+    else:
+        out = None
+        view = into[:length]
+    filled = 0
     retries = max_retry
-    while len(out) < length:
+    while filled < length:
         if cancelled is not None and cancelled():
             raise DMLCError(f"range read of {display} cancelled")
-        want = length - len(out)
+        want = length - filled
+        got = 0  # bytes this attempt delivered (read in the except path)
         try:
-            with open_ranged(offset + len(out), offset + length) as resp:
+            with open_ranged(offset + filled, offset + length) as resp:
                 header = resp.headers.get("Content-Length")
                 expected = int(header) if header is not None else None
-                got = 0
+                readinto = getattr(resp, "readinto", None)
+                # `filled` advances as bytes land so a truncated response
+                # keeps its partial progress across the retry (the
+                # reconnect-from-where-we-stopped shape of
+                # s3_filesys.cc:319-342)
                 while got < want:
-                    chunk = resp.read(want - got)
-                    if not chunk:
-                        break
-                    out.extend(chunk)
-                    got += len(chunk)
+                    if readinto is not None:
+                        n = readinto(view[filled : filled + (want - got)])
+                        if not n:
+                            break
+                        got += n
+                        filled += n
+                    else:  # duck-typed responses without readinto
+                        chunk = resp.read(want - got)
+                        if not chunk:
+                            break
+                        view[filled : filled + len(chunk)] = chunk
+                        got += len(chunk)
+                        filled += len(chunk)
                 if expected is not None and got < min(expected, want):
                     # server promised more than it sent: dropped connection,
                     # NOT end-of-object (HTTPResponse.read returns short
@@ -350,7 +375,7 @@ def read_range_with_retry(
                     raise OSError(
                         f"truncated response: {got} of {expected} bytes"
                     )
-            if got < want:
+            if filled < length and got < want:
                 break  # clean short bounded response: range hit EOF
         except (urllib.error.URLError, OSError, _hc.HTTPException) as err:
             if isinstance(err, urllib.error.HTTPError):
@@ -359,6 +384,11 @@ def read_range_with_retry(
                     break
                 if err.code < 500 and err.code not in (408, 429):
                     raise  # 4xx (except throttling): not transient
+            if got > 0:
+                # the connection delivered bytes before dropping: that is
+                # progress, not a stall — a long object over a flaky link
+                # must not exhaust the budget while still advancing
+                retries = max_retry
             retries -= 1
             if retries <= 0:
                 raise DMLCError(
@@ -366,7 +396,11 @@ def read_range_with_retry(
                     f"{max_retry} retries: {err}"
                 ) from err
             _time.sleep(retry_sleep_s)
-    return bytes(out)
+    if into is not None:
+        return filled
+    if filled == length:
+        return out  # bytes-like; no final copy on the full-range hot path
+    return bytes(view[:filled])
 
 
 class RangedReadStream(SeekStream):

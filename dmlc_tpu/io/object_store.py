@@ -33,8 +33,10 @@ from __future__ import annotations
 import datetime as _dt
 import hashlib
 import hmac
+import io
 import json
 import os
+import threading
 import time
 import urllib.error
 import urllib.parse
@@ -71,6 +73,95 @@ def _http(req: urllib.request.Request, timeout: float = 60,
         ctx.verify_mode = ssl.CERT_NONE
         return urllib.request.urlopen(req, timeout=timeout, context=ctx)
     return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _keepalive_get(url: str, headers: Dict[str, str], timeout: float = 60,
+                   verify_ssl: bool = True, max_redirects: int = 5):
+    """Bounded ranged GET over a per-thread persistent connection.
+
+    urllib opens (and the server tears down) a fresh TCP connection per
+    request; at one bounded range-GET every 8 MiB that is a connect
+    handshake, a server accept-thread spawn and a slow-start restart per
+    range. Connections are keyed per (scheme, netloc) in thread-local
+    storage — the readahead pool's fetch threads each keep their own. A
+    stale kept-alive connection (server closed between ranges) retries
+    once on a fresh one; 3xx follows Location like urlopen's redirect
+    handler; HTTP >= 400 raises urllib's HTTPError so the shared retry
+    loop's status handling applies unchanged.
+
+    Only for bounded ranges (the body is always drained): open-ended
+    stream responses must NOT share these connections — an undrained body
+    would poison the next request on the same thread. When an egress
+    proxy applies to the URL's scheme, falls back to urlopen (which
+    routes through ProxyHandler).
+    """
+    import http.client
+    import ssl
+
+    if urllib.request.getproxies().get(
+        urllib.parse.urlsplit(url).scheme
+    ):
+        req = urllib.request.Request(url, headers=headers)
+        return _http(req, timeout=timeout, verify_ssl=verify_ssl)
+
+    conns = getattr(_keepalive_local, "conns", None)
+    if conns is None:
+        conns = _keepalive_local.conns = {}
+    last_err = None
+    for _hop in range(max_redirects):
+        parsed = urllib.parse.urlsplit(url)
+        key = (parsed.scheme, parsed.netloc)
+        path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+        resp = None
+        for _attempt in range(2):
+            conn = conns.get(key)
+            if conn is None:
+                if parsed.scheme == "https":
+                    ctx = ssl.create_default_context()
+                    if not verify_ssl:
+                        ctx.check_hostname = False
+                        ctx.verify_mode = ssl.CERT_NONE
+                    conn = http.client.HTTPSConnection(
+                        parsed.netloc, timeout=timeout, context=ctx
+                    )
+                else:
+                    conn = http.client.HTTPConnection(
+                        parsed.netloc, timeout=timeout
+                    )
+                conns[key] = conn
+            try:
+                conn.request("GET", path, headers=headers)
+                resp = conn.getresponse()
+                break
+            except (OSError, http.client.HTTPException) as err:
+                # stale keep-alive: drop, retry once on a fresh connection
+                conn.close()
+                conns.pop(key, None)
+                last_err = err
+        if resp is None:
+            raise last_err
+        if 300 <= resp.status < 400:
+            location = resp.headers.get("Location")
+            resp.read()
+            resp.close()
+            if not location:
+                raise urllib.error.HTTPError(
+                    url, resp.status, resp.reason, resp.headers, None
+                )
+            url = urllib.parse.urljoin(url, location)
+            continue
+        if resp.status >= 400:
+            body = resp.read()
+            resp.close()
+            raise urllib.error.HTTPError(
+                url, resp.status, resp.reason, resp.headers,
+                io.BytesIO(body),
+            )
+        return resp
+    raise DMLCError(f"too many redirects fetching {url}")
+
+
+_keepalive_local = threading.local()
 
 
 # ---------------------------------------------------------------------------
@@ -232,15 +323,17 @@ class _ObjectStoreBase(FileSystem):
         return f"bytes={start}-" if end is None else f"bytes={start}-{end - 1}"
 
     def read_range(
-        self, path: URI, offset: int, length: int, cancelled=None
-    ) -> bytes:
+        self, path: URI, offset: int, length: int, cancelled=None, into=None
+    ):
         """One bounded range GET per call — the parallel-readahead
-        primitive, with per-range retry (s3_filesys.cc:319-342 shape)."""
+        primitive, with per-range retry (s3_filesys.cc:319-342 shape).
+        With ``into`` (writable memoryview) the body lands in caller
+        memory and the byte count is returned."""
         return read_range_with_retry(
             lambda start, end: self._open_ranged(path, start, end),
             offset, length, self._display(path),
             max_retry=READ_MAX_RETRY, retry_sleep_s=READ_RETRY_SLEEP_S,
-            cancelled=cancelled,
+            cancelled=cancelled, into=into,
         )
 
     def _stat_object(self, path: URI) -> Optional[int]:
@@ -363,6 +456,8 @@ class S3FileSystem(_ObjectStoreBase):
                 "GET", url, self.region, self.access_key, self.secret_key,
                 b"", self.session_token,
             ))
+        if end is not None:  # bounded: body fully drained, safe to reuse
+            return _keepalive_get(url, hdrs, verify_ssl=self.verify_ssl)
         req = urllib.request.Request(url, headers=hdrs)
         return _http(req, verify_ssl=self.verify_ssl)
 
@@ -526,11 +621,11 @@ class GCSFileSystem(_ObjectStoreBase):
 
     def _open_ranged(self, path: URI, start: int, end: Optional[int] = None):
         bucket, key = self._bucket_key(path)
-        req = urllib.request.Request(
-            self._media_url(bucket, key),
-            headers=self._headers({"Range": self._range_header(start, end)}),
-        )
-        return _http(req)
+        url = self._media_url(bucket, key)
+        hdrs = self._headers({"Range": self._range_header(start, end)})
+        if end is not None:  # bounded: body fully drained, safe to reuse
+            return _keepalive_get(url, hdrs)
+        return _http(urllib.request.Request(url, headers=hdrs))
 
     def _stat_object(self, path: URI) -> Optional[int]:
         bucket, key = self._bucket_key(path)

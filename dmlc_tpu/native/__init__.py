@@ -98,9 +98,15 @@ def _bind(lib) -> None:
         ctypes.c_int32, ctypes.c_int32, i64, ctypes.c_int32, i64,
     ]
     lib.ingest_push.restype = ctypes.c_int
-    lib.ingest_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+    # data arg is c_void_p (not c_char_p) so writable buffers pass without
+    # a bytes copy; bytes still pass directly
+    lib.ingest_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, i64]
     lib.ingest_push_eof.restype = ctypes.c_int
     lib.ingest_push_eof.argtypes = [ctypes.c_void_p]
+    lib.ingest_push_reserve.restype = ctypes.c_void_p
+    lib.ingest_push_reserve.argtypes = [ctypes.c_void_p, i64]
+    lib.ingest_push_commit.restype = ctypes.c_int
+    lib.ingest_push_commit.argtypes = [ctypes.c_void_p, i64]
     lib.ingest_push_abort.restype = None
     lib.ingest_push_abort.argtypes = [ctypes.c_void_p]
     lib.ingest_peek.restype = ctypes.c_int
@@ -493,12 +499,34 @@ class IngestPipeline:
 
     # ---- push mode (remote ingest feeders) ---------------------------
 
-    def push(self, data: bytes) -> None:
-        """Append partition-stream bytes; blocks for backpressure when the
-        parse workers are behind (the ctypes call releases the GIL)."""
-        rc = self._lib.ingest_push(self._handle, bytes(data), len(data))
+    def push(self, data) -> None:
+        """Append partition-stream bytes (any buffer-protocol object,
+        zero-copy handoff); blocks for backpressure when the parse workers
+        are behind (the ctypes call releases the GIL)."""
+        n = len(data)
+        if isinstance(data, bytes):
+            buf = data  # pointer to the bytes object's storage
+        else:
+            # writable buffers (bytearray from the readinto fetch path):
+            # borrow the memory without a copy for the call's duration
+            buf = ctypes.addressof((ctypes.c_char * n).from_buffer(data))
+        rc = self._lib.ingest_push(self._handle, buf, n)
         if rc != 0:
             raise DMLCError(f"native ingest push failed rc={rc}")
+
+    def push_reserve(self, want: int):
+        """Writable memoryview over `want` bytes of the pipeline's own tail
+        buffer (valid only until the next reserve/commit/push): remote
+        responses readinto() native memory with zero Python-side copies."""
+        ptr = self._lib.ingest_push_reserve(self._handle, want)
+        if not ptr:
+            raise DMLCError("native ingest push_reserve failed")
+        return memoryview((ctypes.c_char * want).from_address(ptr)).cast("B")
+
+    def push_commit(self, n: int) -> None:
+        rc = self._lib.ingest_push_commit(self._handle, n)
+        if rc != 0:
+            raise DMLCError(f"native ingest push_commit failed rc={rc}")
 
     def push_eof(self) -> None:
         rc = self._lib.ingest_push_eof(self._handle)

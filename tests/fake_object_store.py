@@ -88,7 +88,9 @@ class Handler(BaseHTTPRequestHandler):
             start = int(m.group(1))
             if m.group(2):  # inclusive end bound
                 stop = min(stop, int(m.group(2)) + 1)
-        body = data[start:stop]
+        # memoryview: no per-range slice copy (the server shares the bench
+        # host's CPU; a copy here taxes the client's measured throughput)
+        body = memoryview(data)[start:stop]
         if st.fail_after_bytes is not None and len(body) > st.fail_after_bytes:
             # send a truncated response then drop the connection
             self.send_response(206 if rng else 200)

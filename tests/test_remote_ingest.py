@@ -243,3 +243,72 @@ class TestMemRouting:
         assert parser._remote_fs is not None
         assert sum(len(b) for b in parser) == 300
         parser.close()
+
+
+class TestDirectFeed:
+    """conns=1 streams ranges straight into native push memory
+    (ingest_push_reserve/commit + HTTP readinto)."""
+
+    def _put(self, s3, nrows):
+        data = _libsvm_lines(nrows)
+        s3.objects[("data", "d.svm")] = data
+        return data
+
+    def test_direct_feed_engaged_and_correct(self, s3, monkeypatch):
+        self._put(s3, 3000)
+        monkeypatch.setenv("DMLC_TPU_READAHEAD_CONNS", "1")
+        import dmlc_tpu.io.readahead as ra
+
+        called = {}
+        orig = ra.RemotePartitionReader.feed_into
+
+        def spy(self, pipe):
+            called["yes"] = True
+            return orig(self, pipe)
+
+        monkeypatch.setattr(ra.RemotePartitionReader, "feed_into", spy)
+        parser = create_parser("s3://data/d.svm")
+        assert isinstance(parser, NativePipelineParser)
+        total = sum(len(b) for b in parser)
+        parser.close()
+        assert total == 3000
+        assert called.get("yes"), "direct feed path not taken at conns=1"
+
+    def test_direct_feed_reconnects_under_fault(self, s3, monkeypatch):
+        """Truncated responses retry with partial progress kept — the
+        readinto path advances `filled` as bytes land."""
+        data = self._put(s3, 2000)
+        monkeypatch.setenv("DMLC_TPU_READAHEAD_CONNS", "1")
+        s3.fail_after_bytes = max(1 << 10, len(data) // 8)
+        parser = create_parser("s3://data/d.svm")
+        assert isinstance(parser, NativePipelineParser)
+        total = sum(len(b) for b in parser)
+        parser.close()
+        assert total == 2000
+
+    def test_direct_feed_partitions(self, s3, monkeypatch):
+        self._put(s3, 2500)
+        monkeypatch.setenv("DMLC_TPU_READAHEAD_CONNS", "1")
+        got = 0
+        for part in range(3):
+            parser = create_parser("s3://data/d.svm", part, 3)
+            got += sum(len(b) for b in parser)
+            parser.close()
+        assert got == 2500
+
+    def test_direct_feed_parse_error_wins(self, s3, monkeypatch):
+        """A malformed record fails the pipeline; the consumer must see the
+        pipeline's parse error, not a masking 'push failed' feeder error."""
+        s3.objects[("data", "bad.svm")] = (
+            _libsvm_lines(2000) + b"not a libsvm line at all\n"
+            + _libsvm_lines(2000)
+        )
+        monkeypatch.setenv("DMLC_TPU_READAHEAD_CONNS", "1")
+        # small chunks so the parse failure lands while pushes continue
+        parser = create_parser("s3://data/bad.svm")
+        assert isinstance(parser, NativePipelineParser)
+        with pytest.raises(DMLCError) as exc_info:
+            for _ in parser:
+                pass
+        parser.close()
+        assert "feeder failed" not in str(exc_info.value)
