@@ -210,13 +210,30 @@ def _bench_device_feed(path: str) -> dict:
         sgd_runs.append(round(size_mb / (time.time() - t0), 1))
         feed.close()
 
-    return {
+    out = {
         "feed_dense_mbps": round(statistics.median(feed_runs[1:]), 1),
         "feed_dense_trials_mbps": feed_runs[1:],
         "sgd_e2e_mbps": round(statistics.median(sgd_runs[1:]), 1),
         "sgd_e2e_trials_mbps": sgd_runs[1:],
         "device": str(jax.devices()[0].platform),
     }
+    # Sharded sparse H2D accounting (one batch, host-side): per-device
+    # entry bytes under the 8-shard partition vs the replicated layout.
+    # Native-only (the sharded fill lives in pipeline.cc); its absence
+    # must not discard the timing metrics above.
+    parser = create_parser(path, 0, 1, nthread=nthread)
+    try:
+        if hasattr(parser, "read_batch_coo_sharded"):
+            sharded = parser.read_batch_coo_sharded(16384, 8)
+            out["csr_batch_nnz"] = sharded.num_nonzero
+            out["csr_nnz_per_device_8shard"] = sharded.nnz_bucket
+            out["csr_h2d_bytes_per_device"] = sharded.nnz_bucket * 12
+            out["csr_h2d_bytes_per_device_replicated"] = (
+                sharded.num_nonzero * 12
+            )
+    finally:
+        parser.close()
+    return out
 
 
 def _bench_remote_ingest(path: str) -> float:

@@ -561,6 +561,86 @@ class Pipeline {
     return out_row;
   }
 
+  // Max per-shard nnz of the staged batch when its rows are split into
+  // num_shards contiguous row ranges (the mesh dp sharding): the caller
+  // sizes the shared per-shard bucket from this.
+  int64_t StagedMaxShardNnz(int64_t batch_size, int64_t num_shards) const {
+    if (num_shards <= 0 || batch_size % num_shards != 0) return -1;
+    int64_t rows_per_shard = batch_size / num_shards;
+    int64_t max_nnz = 0, cur = 0;
+    int64_t row = 0, left = std::min<int64_t>(batch_size, staged_rows_);
+    for (const Span& sp : staged_) {
+      if (left <= 0) break;
+      int64_t take = std::min<int64_t>(left, sp.block->rows - sp.row);
+      for (int64_t i = 0; i < take; ++i) {
+        int64_t r = sp.row + i;
+        cur += sp.block->offsets[r + 1] - sp.block->offsets[r];
+        if ((row + 1) % rows_per_shard == 0) {
+          max_nnz = std::max(max_nnz, cur);
+          cur = 0;
+        }
+        ++row;
+      }
+      left -= take;
+    }
+    return std::max(max_nnz, cur);
+  }
+
+  // Sharded COO fill: entries are partitioned by destination shard (row
+  // range r/rows_per_shard) into per-shard sections of the flat
+  // [num_shards * nnz_bucket] arrays, with LOCAL row ids — each device
+  // receives only its own entries when the leading dim is sharded
+  // (in_specs P(axis)), so per-device H2D is ∝ global_nnz / world instead
+  // of replicating every entry to every shard. Padding entries are
+  // (local row 0, feature 0, value 0) no-ops. Fails with kEOverflow
+  // (consuming nothing) when any shard's nnz exceeds nnz_bucket.
+  int64_t FetchBatchCooSharded(float* labels, float* weights,
+                               int32_t* indices, float* values,
+                               int32_t* row_ids, int64_t batch_size,
+                               int64_t num_shards, int64_t nnz_bucket) {
+    if (format_ == kCsv) return kEIo;
+    if (num_shards <= 0 || batch_size % num_shards != 0) return kEIo;
+    if (StagedMaxShardNnz(batch_size, num_shards) > nnz_bucket) {
+      return kEOverflow;
+    }
+    int64_t rows_per_shard = batch_size / num_shards;
+    std::memset(labels, 0, static_cast<size_t>(batch_size) * 4);
+    std::memset(weights, 0, static_cast<size_t>(batch_size) * 4);
+    std::memset(indices, 0,
+                static_cast<size_t>(num_shards * nnz_bucket) * 4);
+    std::memset(values, 0, static_cast<size_t>(num_shards * nnz_bucket) * 4);
+    std::memset(row_ids, 0,
+                static_cast<size_t>(num_shards * nnz_bucket) * 4);
+    int64_t out_row = 0;
+    int64_t cur = 0;  // entry cursor within the current shard's section
+    while (out_row < batch_size && !staged_.empty()) {
+      Span& sp = staged_.front();
+      Block* b = sp.block;
+      bool has_w = (b->flags & kHasWeight) != 0;
+      bool has_v = format_ == kLibfm || (b->flags & kHasValue) != 0;
+      const uint32_t* idx = reinterpret_cast<const uint32_t*>(b->indices);
+      int64_t take = std::min<int64_t>(batch_size - out_row, b->rows - sp.row);
+      for (int64_t i = 0; i < take; ++i) {
+        int64_t r = sp.row + i;
+        labels[out_row] = b->labels[r];
+        weights[out_row] = has_w ? b->weights[r] : 1.0f;
+        int64_t shard = out_row / rows_per_shard;
+        int64_t local_row = out_row - shard * rows_per_shard;
+        int64_t base = shard * nnz_bucket;
+        for (int64_t k = b->offsets[r]; k < b->offsets[r + 1]; ++k) {
+          indices[base + cur] = static_cast<int32_t>(idx[k]);
+          values[base + cur] = has_v ? b->values[k] : 1.0f;
+          row_ids[base + cur] = static_cast<int32_t>(local_row);
+          ++cur;
+        }
+        ++out_row;
+        if (out_row % rows_per_shard == 0) cur = 0;  // next shard section
+      }
+      ConsumeSpan(take);
+    }
+    return out_row;
+  }
+
   // Per-stage counters for bench/diagnosis (SURVEY §5.1): where does wall
   // time go between reading, parsing and the consumer?
   void Stats(double* out, int32_t n) const {
@@ -1330,6 +1410,29 @@ int64_t ingest_fetch_batch_coo(void* handle, float* labels, float* weights,
                                int64_t nnz_bucket) {
   return static_cast<Pipeline*>(handle)->FetchBatchCoo(
       labels, weights, indices, values, row_ids, batch_size, nnz_bucket);
+}
+
+// Max per-shard nnz of the staged batch under a num_shards row-range
+// split (for sizing the shared per-shard bucket). -1 on bad arguments.
+int64_t ingest_staged_max_shard_nnz(void* handle, int64_t batch_size,
+                                    int64_t num_shards) {
+  return static_cast<Pipeline*>(handle)->StagedMaxShardNnz(batch_size,
+                                                           num_shards);
+}
+
+// Consume the staged rows into a mesh-sharded COO batch: labels/weights
+// [batch_size]; indices/values/row_ids flat [num_shards * nnz_bucket] with
+// per-shard sections and LOCAL row ids (shard = row / (batch/num_shards)).
+// Fails with -1 (consuming nothing) when any shard overflows nnz_bucket.
+int64_t ingest_fetch_batch_coo_sharded(void* handle, float* labels,
+                                       float* weights, int32_t* indices,
+                                       float* values, int32_t* row_ids,
+                                       int64_t batch_size,
+                                       int64_t num_shards,
+                                       int64_t nnz_bucket) {
+  return static_cast<Pipeline*>(handle)->FetchBatchCooSharded(
+      labels, weights, indices, values, row_ids, batch_size, num_shards,
+      nnz_bucket);
 }
 
 // Per-stage counters: out[0]=bytes_read, [1]=chunks, [2]=reader_io_ns,

@@ -675,6 +675,38 @@ class NativePipelineParser:
             row_ids=row_ids, num_rows=rows, num_nonzero=nnz,
         )
 
+    def read_batch_coo_sharded(
+        self,
+        batch_size: int,
+        num_shards: int,
+        nnz_bucket=None,
+        nnz_floor: int = 256,
+    ):
+        """→ ShardedCSRBatch (per-shard entry sections, local row ids) or
+        None at end of stream. Bucket = power-of-two over the max shard
+        nnz unless fixed."""
+        from dmlc_tpu.device.csr import ShardedCSRBatch, round_up_bucket
+
+        staged = self._stage(batch_size)
+        if staged is None:
+            return None
+        _rows, nnz = staged
+        bucket = (
+            nnz_bucket if nnz_bucket is not None
+            else round_up_bucket(
+                self._pipe.staged_max_shard_nnz(batch_size, num_shards),
+                nnz_floor,
+            )
+        )
+        labels, weights, indices, values, row_ids, rows = (
+            self._pipe.fetch_batch_coo_sharded(batch_size, num_shards, bucket)
+        )
+        return ShardedCSRBatch(
+            labels=labels, weights=weights, indices=indices, values=values,
+            row_ids=row_ids, num_rows=rows, num_nonzero=nnz,
+            num_shards=num_shards, nnz_bucket=bucket,
+        )
+
     def stats(self) -> Optional[dict]:
         """Per-stage pipeline counters (ns), or None when closed."""
         return self._pipe.stats() if self._pipe is not None else None

@@ -8,7 +8,13 @@ prefetcher (the ThreadedIter role from threadediter.h, now hiding H2D DMA),
 and laid out with per-host batch sharding over a jax.sharding.Mesh.
 """
 
-from dmlc_tpu.device.csr import DeviceCSRBatch, pad_to_bucket, round_up_bucket
+from dmlc_tpu.device.csr import (
+    DeviceCSRBatch,
+    ShardedCSRBatch,
+    pad_to_bucket,
+    pad_to_bucket_sharded,
+    round_up_bucket,
+)
 from dmlc_tpu.device.feed import DeviceFeed, BatchSpec
 
 __all__ = [

@@ -97,6 +97,99 @@ def pad_to_bucket(
     )
 
 
+@dataclass
+class ShardedCSRBatch:
+    """A static-shape COO batch partitioned by destination mesh shard.
+
+    indices/values/row_ids are flat [num_shards * nnz_bucket] with
+    contiguous per-shard sections and LOCAL row ids (shard s owns rows
+    [s*rows_per_shard, (s+1)*rows_per_shard)); sharding the leading dim
+    with P(axis) ships each device only its own entries, so per-device
+    H2D is ∝ global_nnz / world — the Criteo-scale requirement the
+    replicated layout breaks (every device paying global nnz).
+    """
+
+    labels: np.ndarray  # [batch] f32
+    weights: np.ndarray  # [batch] f32 (0.0 for padded rows)
+    indices: np.ndarray  # [num_shards * nnz_bucket] i32
+    values: np.ndarray  # [num_shards * nnz_bucket] f32
+    row_ids: np.ndarray  # [num_shards * nnz_bucket] i32, LOCAL per shard
+    num_rows: int
+    num_nonzero: int
+    num_shards: int
+    nnz_bucket: int  # per shard
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.labels)
+
+
+def pad_to_bucket_sharded(
+    block: RowBlock,
+    batch_size: int,
+    num_shards: int,
+    nnz_bucket: Optional[int] = None,
+    nnz_floor: int = 256,
+) -> ShardedCSRBatch:
+    """Partition a RowBlock's entries by destination shard (row-range
+    split) into per-shard padded sections — the pure-Python twin of
+    pipeline.cc FetchBatchCooSharded."""
+    n = len(block)
+    check(n <= batch_size, "block larger than batch_size")
+    check(batch_size % num_shards == 0,
+          "batch_size %d must divide over %d shards", batch_size, num_shards)
+    rows_per_shard = batch_size // num_shards
+
+    labels = np.zeros(batch_size, dtype=np.float32)
+    labels[:n] = block.label
+    weights = np.zeros(batch_size, dtype=np.float32)
+    weights[:n] = 1.0 if block.weight is None else block.weight
+
+    rows = np.repeat(
+        np.arange(n, dtype=np.int64), np.diff(block.offset).astype(np.int64)
+    )
+    vals = (
+        np.ones(block.num_nonzero, dtype=np.float32)
+        if block.value is None
+        else np.asarray(block.value, np.float32)
+    )
+    shard_of = rows // rows_per_shard
+    counts = np.bincount(shard_of, minlength=num_shards) if len(rows) else (
+        np.zeros(num_shards, dtype=np.int64)
+    )
+    bucket = (
+        nnz_bucket if nnz_bucket is not None
+        else round_up_bucket(int(counts.max()) if len(rows) else 0, nnz_floor)
+    )
+    check(int(counts.max() if len(rows) else 0) <= bucket,
+          "a shard's nnz exceeds the bucket")
+
+    indices = np.zeros(num_shards * bucket, dtype=np.int32)
+    values = np.zeros(num_shards * bucket, dtype=np.float32)
+    row_ids = np.zeros(num_shards * bucket, dtype=np.int32)
+    # entries arrive row-major, so each shard's entries are contiguous
+    start = 0
+    for s in range(num_shards):
+        c = int(counts[s])
+        seg = slice(start, start + c)
+        out = slice(s * bucket, s * bucket + c)
+        indices[out] = block.index[seg]
+        values[out] = vals[seg]
+        row_ids[out] = rows[seg] - s * rows_per_shard
+        start += c
+    return ShardedCSRBatch(
+        labels=labels,
+        weights=weights,
+        indices=indices,
+        values=values,
+        row_ids=row_ids,
+        num_rows=n,
+        num_nonzero=block.num_nonzero,
+        num_shards=num_shards,
+        nnz_bucket=bucket,
+    )
+
+
 def block_to_dense(
     block: RowBlock, batch_size: int, num_features: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

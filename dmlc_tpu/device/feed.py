@@ -25,7 +25,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
 from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
-from dmlc_tpu.device.csr import DeviceCSRBatch, block_to_dense, pad_to_bucket
+from dmlc_tpu.device.csr import (
+    DeviceCSRBatch,
+    ShardedCSRBatch,
+    block_to_dense,
+    pad_to_bucket,
+    pad_to_bucket_sharded,
+)
 from dmlc_tpu.utils.logging import check
 from dmlc_tpu.utils.threaded_iter import ThreadedIter
 
@@ -111,11 +117,18 @@ class DeviceFeed:
     def _host_batches_native(self) -> Iterator:
         spec = self.spec
         bs = spec.batch_size
+        shards = self._mesh.shape[self._axis] if self._mesh is not None else 1
         while True:
             if spec.layout == "dense":
                 check(spec.num_features > 0,
                       "dense layout requires num_features")
                 out = self._parser.read_batch_dense(bs, spec.num_features)
+            elif shards > 1:
+                # mesh csr: entries partitioned per shard on the host so
+                # each device receives only its own nnz
+                out = self._parser.read_batch_coo_sharded(
+                    bs, shards, nnz_bucket=spec.nnz_bucket
+                )
             else:
                 out = self._parser.read_batch_coo(
                     bs, nnz_bucket=spec.nnz_bucket
@@ -161,8 +174,8 @@ class DeviceFeed:
             )
             out["num_rows"] = rows
             return out
-        if isinstance(block, DeviceCSRBatch):  # native COO batch, pre-padded
-            return self._put_csr(block)
+        if isinstance(block, (DeviceCSRBatch, ShardedCSRBatch)):
+            return self._put_csr(block)  # native COO batch, pre-padded
         if spec.layout == "dense":
             check(spec.num_features > 0, "dense layout requires num_features")
             x, labels, weights = block_to_dense(
@@ -176,16 +189,28 @@ class DeviceFeed:
             out["num_rows"] = len(block)
             return out
         if spec.layout == "csr":
-            batch: DeviceCSRBatch = pad_to_bucket(
-                block, spec.batch_size, nnz_bucket=spec.nnz_bucket
+            shards = (
+                self._mesh.shape[self._axis] if self._mesh is not None else 1
             )
+            if shards > 1:
+                batch = pad_to_bucket_sharded(
+                    block, spec.batch_size, shards,
+                    nnz_bucket=spec.nnz_bucket,
+                )
+            else:
+                batch = pad_to_bucket(
+                    block, spec.batch_size, nnz_bucket=spec.nnz_bucket
+                )
             return self._put_csr(batch)
         raise ValueError(f"unknown layout {spec.layout!r}")
 
-    def _put_csr(self, batch: DeviceCSRBatch):
-        # Entries are replicated over the mesh (row_ids address the global
-        # batch); rows are sharded. Sparse sharded SpMV splits by rows in
-        # ops.spmv via shard_map.
+    def _put_csr(self, batch):
+        # ShardedCSRBatch: per-shard entry sections with local row ids —
+        # P(axis) on the flat entry arrays ships each device only its own
+        # nnz (H2D ∝ global_nnz / world). DeviceCSRBatch (no mesh /
+        # single shard): entries replicated, global row ids.
+        sharded = isinstance(batch, ShardedCSRBatch)
+        entry_spec = P(self._axis) if sharded else P()
         out = self._put_tree(
             {
                 "label": batch.labels,
@@ -197,9 +222,9 @@ class DeviceFeed:
             {
                 "label": P(self._axis),
                 "weight": P(self._axis),
-                "indices": P(),
-                "values": P(),
-                "row_ids": P(),
+                "indices": entry_spec,
+                "values": entry_spec,
+                "row_ids": entry_spec,
             },
         )
         out["num_rows"] = batch.num_rows

@@ -340,6 +340,7 @@ def read_range_with_retry(
         view = into[:length]
     filled = 0
     retries = max_retry
+    total_attempts = 0
     while filled < length:
         if cancelled is not None and cancelled():
             raise DMLCError(f"range read of {display} cancelled")
@@ -390,10 +391,13 @@ def read_range_with_retry(
                 # must not exhaust the budget while still advancing
                 retries = max_retry
             retries -= 1
-            if retries <= 0:
+            total_attempts += 1
+            # absolute ceiling: progress resets must not turn a server
+            # that drips one byte per connection into a multi-day hang
+            if retries <= 0 or total_attempts >= max_retry * 10:
                 raise DMLCError(
                     f"range read of {display} failed after "
-                    f"{max_retry} retries: {err}"
+                    f"{total_attempts} attempts: {err}"
                 ) from err
             _time.sleep(retry_sleep_s)
     if into is not None:

@@ -280,6 +280,13 @@ class RemotePartitionReader:
                                if self._supports_cancel else None),
                     into=view,
                 )
+            elif self._supports_cancel:
+                data = self._fs.read_range(
+                    self._paths[idx], local, length,
+                    cancelled=self._cancel.is_set,
+                )
+                got = len(data)
+                view[:got] = data
             else:
                 data = self._fs.read_range(self._paths[idx], local, length)
                 got = len(data)

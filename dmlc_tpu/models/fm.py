@@ -104,24 +104,19 @@ def make_fm_train_step(
 
         return step
 
+    # Entries arrive SHARDED (ShardedCSRBatch: per-shard sections, local
+    # row ids) — each device holds only its own nnz; no global mask.
     batch_specs = {
         "label": P(axis),
         "weight": P(axis),
-        "indices": P(),
-        "values": P(),
-        "row_ids": P(),
+        "indices": P(axis),
+        "values": P(axis),
+        "row_ids": P(axis),
     }
 
     def _sharded(params, batch):
-        n_local = batch["label"].shape[0]
-        base = jax.lax.axis_index(axis) * n_local
-        local_ids = batch["row_ids"] - base
-        oob = (local_ids < 0) | (local_ids >= n_local)
-        local = dict(batch)
-        local["row_ids"] = jnp.where(oob, 0, local_ids)
-        local["values"] = jnp.where(oob, 0.0, batch["values"])
         gw, gb, gv, loss_sum, wsum = _fm_forward_grads(
-            params, local, objective, num_features
+            params, batch, objective, num_features
         )
         gw, gb, gv, loss_sum, wsum = jax.lax.psum(
             (gw, gb, gv, loss_sum, wsum), axis_name=axis

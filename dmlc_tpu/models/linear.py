@@ -186,10 +186,11 @@ def make_linear_train_step(
 
         return step
 
-    # Mesh path: one shard_map; batch rows sharded, params replicated. For
-    # the csr layout entries are replicated and each shard reduces its row
-    # range (ops.spmv sharded variant would shard entries too; here the
-    # per-batch entry arrays are small relative to the gradient).
+    # Mesh path: one shard_map; batch rows sharded, params replicated. The
+    # csr layout ships SHARDED entries (ShardedCSRBatch: per-shard entry
+    # sections with local row ids, device/csr.py), so each device receives
+    # only its own nnz and the segment-sum is purely local — per-device
+    # H2D ∝ global_nnz / world, the Criteo-scale contract.
     if layout == "dense":
         batch_specs = {
             "x": P(axis),
@@ -200,22 +201,12 @@ def make_linear_train_step(
         batch_specs = {
             "label": P(axis),
             "weight": P(axis),
-            "indices": P(),
-            "values": P(),
-            "row_ids": P(),
+            "indices": P(axis),
+            "values": P(axis),
+            "row_ids": P(axis),
         }
 
     def _sharded(params, velocity, batch):
-        if layout == "csr":
-            # Global row_ids → this shard's local range.
-            n_local = batch["label"].shape[0]
-            base = jax.lax.axis_index(axis) * n_local
-            local_ids = batch["row_ids"] - base
-            oob = (local_ids < 0) | (local_ids >= n_local)
-            local = dict(batch)
-            local["row_ids"] = jnp.where(oob, 0, local_ids)
-            local["values"] = jnp.where(oob, 0.0, batch["values"])
-            batch = local
         gw, gb, loss_sum, wsum = _local_grads(params, batch)
         # ONE fused allreduce for everything that crosses ICI.
         gw, gb, loss_sum, wsum = jax.lax.psum(

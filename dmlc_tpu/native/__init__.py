@@ -141,6 +141,13 @@ def _bind(lib) -> None:
     lib.ingest_stats.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
     ]
+    lib.ingest_staged_max_shard_nnz.restype = i64
+    lib.ingest_staged_max_shard_nnz.argtypes = [ctypes.c_void_p, i64, i64]
+    lib.ingest_fetch_batch_coo_sharded.restype = i64
+    lib.ingest_fetch_batch_coo_sharded.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64,
+    ]
     lib.ingest_bytes_read.restype = i64
     lib.ingest_bytes_read.argtypes = [ctypes.c_void_p]
     lib.ingest_close.restype = None
@@ -642,6 +649,35 @@ class IngestPipeline:
         )
         if rows < 0:
             raise DMLCError(f"native coo batch fetch failed rc={rows}")
+        return labels, weights, indices, values, row_ids, int(rows)
+
+    def staged_max_shard_nnz(self, batch_size: int, num_shards: int) -> int:
+        """Max per-shard nnz of the staged batch under a row-range split."""
+        out = self._lib.ingest_staged_max_shard_nnz(
+            self._handle, batch_size, num_shards
+        )
+        if out < 0:
+            raise DMLCError("bad sharded staging arguments")
+        return int(out)
+
+    def fetch_batch_coo_sharded(
+        self, batch_size: int, num_shards: int, nnz_bucket: int
+    ):
+        """Consume the staged batch partitioned per shard; → (labels,
+        weights, indices, values, row_ids, rows) with flat
+        [num_shards*nnz_bucket] entry arrays and LOCAL row ids."""
+        labels = np.empty(batch_size, dtype=np.float32)
+        weights = np.empty(batch_size, dtype=np.float32)
+        total = num_shards * nnz_bucket
+        indices = np.empty(total, dtype=np.int32)
+        values = np.empty(total, dtype=np.float32)
+        row_ids = np.empty(total, dtype=np.int32)
+        rows = self._lib.ingest_fetch_batch_coo_sharded(
+            self._handle, _ptr(labels), _ptr(weights), _ptr(indices),
+            _ptr(values), _ptr(row_ids), batch_size, num_shards, nnz_bucket,
+        )
+        if rows < 0:
+            raise DMLCError(f"native sharded coo fetch failed rc={rows}")
         return labels, weights, indices, values, row_ids, int(rows)
 
     def stats(self) -> dict:

@@ -43,12 +43,14 @@ def spmv_transpose(values, indices, row_ids, row_grads, num_features: int):
 
 
 def make_sharded_spmv(mesh, num_rows: int, axis: str = "dp"):
-    """SpMV with entries replicated and output rows sharded over ``axis``.
+    """SpMV with entries AND output rows sharded over ``axis``.
 
-    Each shard computes the segment-sum for its row range only (row_ids are
-    global; entries outside the shard's range contribute to masked-out
-    segments). Returns f(values, indices, row_ids, weight_vec) -> [num_rows]
-    sharded on the leading axis.
+    Consumes the ShardedCSRBatch layout (device/csr.py): entry arrays are
+    flat [num_shards * nnz_bucket] with per-shard sections and LOCAL row
+    ids, so each device receives only its own entries (per-device H2D ∝
+    global_nnz / world) and the segment-sum is purely local — no global
+    mask, no replication. Returns f(values, indices, row_ids, weight_vec)
+    -> [num_rows] sharded on the leading axis; weight_vec is replicated.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -57,23 +59,16 @@ def make_sharded_spmv(mesh, num_rows: int, axis: str = "dp"):
     rows_local = num_rows // n_shards
 
     def _local(values, indices, row_ids, weight_vec):
-        shard = jax.lax.axis_index(axis)
-        base = shard * rows_local
-        local_ids = row_ids - base
-        # entries outside this shard land in segment rows_local (dropped)
-        oob = (local_ids < 0) | (local_ids >= rows_local)
-        local_ids = jnp.where(oob, rows_local, local_ids)
         contrib = values * jnp.take(weight_vec, indices, axis=0)
-        summed = jax.ops.segment_sum(
-            contrib, local_ids, num_segments=rows_local + 1
+        return jax.ops.segment_sum(
+            contrib, row_ids, num_segments=rows_local
         )
-        return summed[:rows_local]
 
     return jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P()),
+            in_specs=(P(axis), P(axis), P(axis), P()),
             out_specs=P(axis),
         )
     )

@@ -99,7 +99,7 @@ class TestLinearMeshParity:
 
     def test_csr_mesh_matches_single(self):
         from dmlc_tpu.data.row_block import RowBlockContainer
-        from dmlc_tpu.device.csr import pad_to_bucket
+        from dmlc_tpu.device.csr import pad_to_bucket, pad_to_bucket_sharded
 
         rng = np.random.RandomState(3)
         nfeat = 40
@@ -109,7 +109,8 @@ class TestLinearMeshParity:
             cont.push_row(
                 float(rng.randint(0, 2)), feats, value=rng.rand(5).astype(np.float32)
             )
-        dev = pad_to_bucket(cont.to_block(), 32, nnz_bucket=256)
+        block = cont.to_block()
+        dev = pad_to_bucket(block, 32, nnz_bucket=256)
         batch = {
             "label": jnp.asarray(dev.labels),
             "weight": jnp.asarray(dev.weights),
@@ -118,6 +119,7 @@ class TestLinearMeshParity:
             "row_ids": jnp.asarray(dev.row_ids),
         }
         mesh = data_parallel_mesh()
+        nshards = mesh.shape["dp"]
         single = make_linear_train_step(
             None, layout="csr", num_features=nfeat, learning_rate=0.2
         )
@@ -130,9 +132,28 @@ class TestLinearMeshParity:
         v2 = jax.tree.map(jnp.copy, v1)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        b2 = dict(batch)
-        for key in ("label", "weight"):
-            b2[key] = jax.device_put(batch[key], NamedSharding(mesh, P("dp")))
+        # mesh step consumes SHARDED entries: per-shard sections, local ids
+        sh = pad_to_bucket_sharded(block, 32, nshards)
+        b2 = {
+            "label": jax.device_put(
+                jnp.asarray(sh.labels), NamedSharding(mesh, P("dp"))
+            ),
+            "weight": jax.device_put(
+                jnp.asarray(sh.weights), NamedSharding(mesh, P("dp"))
+            ),
+            "indices": jax.device_put(
+                jnp.asarray(sh.indices), NamedSharding(mesh, P("dp"))
+            ),
+            "values": jax.device_put(
+                jnp.asarray(sh.values), NamedSharding(mesh, P("dp"))
+            ),
+            "row_ids": jax.device_put(
+                jnp.asarray(sh.row_ids), NamedSharding(mesh, P("dp"))
+            ),
+        }
+        # per-device H2D ∝ global_nnz / world: each device holds one
+        # bucket of entries, not the global nnz
+        assert b2["values"].addressable_shards[0].data.shape[0] == sh.nnz_bucket
         for _ in range(3):
             p1, v1, _ = single(p1, v1, batch)
             p2, v2, _ = sharded(p2, v2, b2)
@@ -171,12 +192,19 @@ class TestFM:
 
         mesh = data_parallel_mesh()
         sharded = make_fm_train_step(mesh, nfeat, learning_rate=0.2)
+        from dmlc_tpu.device.csr import pad_to_bucket_sharded
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         p2 = init_fm_params(nfeat, 4)
-        b2 = dict(batch)
-        for key in ("label", "weight"):
-            b2[key] = jax.device_put(batch[key], NamedSharding(mesh, P("dp")))
+        sh = pad_to_bucket_sharded(cont.to_block(), 64, mesh.shape["dp"])
+        b2 = {
+            k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, P("dp")))
+            for k, v in (
+                ("label", sh.labels), ("weight", sh.weights),
+                ("indices", sh.indices), ("values", sh.values),
+                ("row_ids", sh.row_ids),
+            )
+        }
         p1b = init_fm_params(nfeat, 4)
         for _ in range(3):
             p1b, _ = single(p1b, batch)
@@ -292,3 +320,78 @@ class TestFeatureShardedStep:
         ws = jax.device_put(np.ones(batch, np.float32), sh["weight"])
         p, _ = step(p, xs, ys, ws)
         assert p["w"].sharding.spec == sh["w"].spec
+
+
+class TestShardedCSRFeed:
+    """Entries partitioned per shard through the whole stack: native
+    sharded COO fetch == pure-python pad_to_bucket_sharded, and a DeviceFeed
+    + mesh train run matches the single-device run (VERDICT r2 item 3)."""
+
+    def _svm_file(self, tmp_path, rows=512, nfeat=24):
+        rng = np.random.RandomState(11)
+        path = tmp_path / "s.svm"
+        with open(path, "w") as fh:
+            for i in range(rows):
+                nf = 1 + (i * 7) % 6
+                feats = sorted(rng.choice(nfeat, size=nf, replace=False))
+                fh.write(
+                    f"{i % 2} "
+                    + " ".join(f"{j}:{rng.rand():.4f}" for j in feats)
+                    + "\n"
+                )
+        return str(path)
+
+    def test_native_sharded_fetch_matches_python(self, tmp_path):
+        from dmlc_tpu import native
+        from dmlc_tpu.data import create_parser
+        from dmlc_tpu.data.parsers import NativePipelineParser
+        from dmlc_tpu.device.csr import pad_to_bucket_sharded
+
+        if not native.available():
+            pytest.skip("native library not built")
+        path = self._svm_file(tmp_path)
+        blocks = list(create_parser(path, 0, 1))
+
+        parser = create_parser(path, 0, 1)
+        assert isinstance(parser, NativePipelineParser)
+        got = parser.read_batch_coo_sharded(512, 4)
+        parser.close()
+
+        from dmlc_tpu.data.row_block import RowBlockContainer
+
+        cont = RowBlockContainer()
+        for b in blocks:
+            cont.push_block(b)
+        want = pad_to_bucket_sharded(
+            cont.to_block(), 512, 4, nnz_bucket=got.nnz_bucket
+        )
+        np.testing.assert_array_equal(got.labels, want.labels)
+        np.testing.assert_array_equal(got.indices, want.indices)
+        np.testing.assert_allclose(got.values, want.values, rtol=1e-6)
+        np.testing.assert_array_equal(got.row_ids, want.row_ids)
+        assert got.num_nonzero == want.num_nonzero
+
+    def test_feed_mesh_csr_end_to_end_matches_single(self, tmp_path):
+        from dmlc_tpu.data import create_parser
+        from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+        path = self._svm_file(tmp_path)
+        nfeat = 24
+        mesh = data_parallel_mesh()
+
+        def run(mesh_arg):
+            feed = DeviceFeed(
+                create_parser(path, 0, 1),
+                BatchSpec(batch_size=128, layout="csr", num_features=nfeat),
+                mesh=mesh_arg,
+            )
+            learner = LinearLearner(
+                mesh=mesh_arg, learning_rate=0.3, num_features=nfeat
+            )
+            learner.fit_feed(feed, epochs=2)
+            feed.close()
+            return np.asarray(learner.params["w"])
+
+        w_single = run(None)
+        w_mesh = run(mesh)
+        np.testing.assert_allclose(w_single, w_mesh, rtol=1e-4, atol=1e-6)
