@@ -174,6 +174,65 @@ def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
     return metrics
 
 
+def device_engine_allreduce_metrics(
+    payload_mb: float = 32.0, iters: int = 20
+) -> dict:
+    """DeviceEngine.allreduce's jitted reduction path: a [world, N] array
+    with its leading dim sharded over the process axis, reduced to a
+    replicated output (the O(N) XLA AllReduce the engine runs for host
+    arrays — the data plane, not just control scalars). With one process
+    the measured figure is the on-chip reduction + replication rate; with
+    more it is the cross-host AllReduce."""
+    import jax
+    import numpy as np
+
+    from dmlc_tpu.collective.device import DeviceEngine
+
+    eng = DeviceEngine()
+    elems = int(payload_mb * (1 << 20) // 4)
+    arr = np.ones(elems, dtype=np.float32)
+
+    if eng.world_size > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(eng._process_mesh(), P("proc"))
+        garr = jax.make_array_from_process_local_data(
+            sharding, arr[None], (eng.world_size,) + arr.shape
+        )
+        moved = elems * 4  # per-link payload of the cross-host AllReduce
+        key = "engine_allreduce_gbps"
+    else:
+        # one process: the engine short-circuits, and a [1, N] reduce
+        # compiles to a no-op — measure a real W-way on-chip reduction
+        # instead (the compute half of the allreduce; HBM-bound figure)
+        W = 8
+        garr = jax.device_put(np.ones((W, elems), dtype=np.float32))
+        moved = W * elems * 4
+        key = "engine_reduce_single_process_gbps"
+    fn = eng._reduce_fn("sum")
+    # amortized pipelined timing with a value readback fence: through a
+    # tunneled runtime, per-call block_until_ready can cost a ~66 ms round
+    # trip (or return early) regardless of compute, so neither per-call
+    # timing nor trusting the fence is sound; dispatch iters back-to-back
+    # and end on a 1-element D2H read, which cannot complete early. On a
+    # local host this converges to the HBM-bound figure.
+    float(fn(garr)[0])  # compile + warmup + fence
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(garr)
+        float(out[0])  # readback fence
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return {
+        "engine_allreduce_world": eng.world_size,
+        "engine_allreduce_payload_mb": round(elems * 4 / (1 << 20), 1),
+        key: round(moved / best / 1e9, 3),
+    }
+
+
 def collective_metrics() -> dict:
     """The bench.py hook: flat metric dict; failures are per-tier so one
     broken tier cannot hide the other."""
@@ -186,6 +245,10 @@ def collective_metrics() -> dict:
         out.update(device_psum_metrics())
     except Exception as err:
         out["psum_error"] = str(err)
+    try:
+        out.update(device_engine_allreduce_metrics())
+    except Exception as err:
+        out["engine_allreduce_error"] = str(err)
     return out
 
 

@@ -604,13 +604,7 @@ class Pipeline {
       return kEOverflow;
     }
     int64_t rows_per_shard = batch_size / num_shards;
-    std::memset(labels, 0, static_cast<size_t>(batch_size) * 4);
-    std::memset(weights, 0, static_cast<size_t>(batch_size) * 4);
-    std::memset(indices, 0,
-                static_cast<size_t>(num_shards * nnz_bucket) * 4);
-    std::memset(values, 0, static_cast<size_t>(num_shards * nnz_bucket) * 4);
-    std::memset(row_ids, 0,
-                static_cast<size_t>(num_shards * nnz_bucket) * 4);
+    std::vector<int64_t> filled(static_cast<size_t>(num_shards), 0);
     int64_t out_row = 0;
     int64_t cur = 0;  // entry cursor within the current shard's section
     while (out_row < batch_size && !staged_.empty()) {
@@ -634,9 +628,29 @@ class Pipeline {
           ++cur;
         }
         ++out_row;
-        if (out_row % rows_per_shard == 0) cur = 0;  // next shard section
+        if (out_row % rows_per_shard == 0) {
+          filled[static_cast<size_t>(shard)] = cur;
+          cur = 0;  // next shard section
+        }
       }
       ConsumeSpan(take);
+    }
+    if (out_row > 0 && out_row % rows_per_shard != 0) {
+      filled[static_cast<size_t>(out_row / rows_per_shard)] = cur;
+    }
+    // zero only the padding: row tail + each shard section's unfilled tail
+    // (a full up-front memset would write most of the hot-path bytes twice)
+    std::memset(labels + out_row, 0,
+                static_cast<size_t>(batch_size - out_row) * 4);
+    std::memset(weights + out_row, 0,
+                static_cast<size_t>(batch_size - out_row) * 4);
+    for (int64_t s = 0; s < num_shards; ++s) {
+      int64_t base = s * nnz_bucket + filled[static_cast<size_t>(s)];
+      size_t pad = static_cast<size_t>(
+          nnz_bucket - filled[static_cast<size_t>(s)]);
+      std::memset(indices + base, 0, pad * 4);
+      std::memset(values + base, 0, pad * 4);
+      std::memset(row_ids + base, 0, pad * 4);
     }
     return out_row;
   }

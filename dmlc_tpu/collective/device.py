@@ -70,6 +70,39 @@ class DeviceEngine:
         self.rank = jax.process_index()
         self.world_size = jax.process_count()
         self._aborted = False
+        self._proc_mesh: Optional[Mesh] = None
+        self._reduce_fns: dict = {}
+
+    def _process_mesh(self) -> Mesh:
+        """(nproc, local) mesh with processes contiguous on the first axis
+        — the layout for arrays whose leading dim is one shard per
+        process."""
+        if self._proc_mesh is None:
+            devs = sorted(
+                jax.devices(), key=lambda d: (d.process_index, d.id)
+            )
+            arr = np.asarray(devs).reshape(self.world_size, -1)
+            self._proc_mesh = Mesh(arr, ("proc", "_local"))
+        return self._proc_mesh
+
+    def _reduce_fn(self, op: str):
+        """Jitted [world, ...]-sharded → replicated reduction over dim 0.
+        XLA lowers it to a real AllReduce over ICI/DCN: O(N) bytes per
+        link, never a [world, N] materialization per host."""
+        fn = self._reduce_fns.get(op)
+        if fn is None:
+            from jax.sharding import NamedSharding
+
+            ops = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+                   "prod": jnp.prod}
+            reduce_fn = ops[op]
+            out_sharding = NamedSharding(self._process_mesh(), P())
+            fn = jax.jit(
+                lambda x: reduce_fn(x, axis=0),
+                out_shardings=out_sharding,
+            )
+            self._reduce_fns[op] = fn
+        return fn
 
     def _check_live(self) -> None:
         if self._aborted:
@@ -101,26 +134,35 @@ class DeviceEngine:
         return arr
 
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
-        """Allreduce a host array across all processes' devices."""
-        from jax.experimental import multihost_utils
+        """Allreduce a host array across all processes' devices.
 
+        Each process contributes one shard of a [world, ...] device array
+        (its leading dim sharded over the process axis) and a jitted
+        replicated-output reduction runs as a true XLA AllReduce: O(N)
+        traffic and memory per host. This is the data-plane path — large
+        gradient arrays ride it, not just control-plane scalars.
+        """
         self._check_live()
         arr = self._validate(array)
         if self.world_size == 1:
             # Single process owns every device: nothing to reduce across
             # processes; return as-is (matches rabit world=1 semantics).
             return arr
-        ops = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min, "prod": jnp.prod}
-        if op not in ops:
+        if op not in ("sum", "max", "min", "prod"):
             raise ValueError(f"unknown op {op!r}")
-        # stack contributions along a new leading axis sharded over processes,
-        # then reduce it with a jitted global reduction (XLA AllReduce).
         try:
-            stacked = multihost_utils.process_allgather(arr)
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(self._process_mesh(), P("proc"))
+            garr = jax.make_array_from_process_local_data(
+                sharding, arr[None], (self.world_size,) + arr.shape
+            )
+            out = self._reduce_fn(op)(garr)
+            return np.asarray(out)
+        except (TypeError, ValueError):
+            raise  # deterministic user/shape errors: no recovery cascade
         except Exception as err:  # noqa: BLE001 — backend error translation
             raise self._translate(err, "allreduce") from err
-        reduce_fn = ops[op]
-        return np.asarray(reduce_fn(stacked, axis=0))
 
     # fixed-size broadcast header: [ndim, dims[0..7], dtype_num]
     _HDR_SLOTS = 10

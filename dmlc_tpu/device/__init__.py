@@ -19,7 +19,9 @@ from dmlc_tpu.device.feed import DeviceFeed, BatchSpec
 
 __all__ = [
     "DeviceCSRBatch",
+    "ShardedCSRBatch",
     "pad_to_bucket",
+    "pad_to_bucket_sharded",
     "round_up_bucket",
     "DeviceFeed",
     "BatchSpec",

@@ -159,6 +159,12 @@ class FMLearner:
         from dmlc_tpu.models.linear import EpochMetrics
 
         check(feed.spec.layout == "csr", "FM consumes csr batches")
+        # see LinearLearner.fit_feed: mesh steps need the sharded layout
+        check(
+            getattr(feed, "_mesh", None) is self.mesh,
+            "feed mesh and learner mesh must match (csr entry layouts "
+            "differ between mesh and single-device runs)",
+        )
         history = []
         for epoch in range(epochs):
             acc = EpochMetrics()

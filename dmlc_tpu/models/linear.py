@@ -350,6 +350,14 @@ class LinearLearner:
         from dmlc_tpu.utils.logging import log_info
 
         layout = feed.spec.layout
+        # mesh csr steps consume the SHARDED entry layout (local row ids);
+        # a feed built without the mesh would deliver replicated entries
+        # whose global row ids silently corrupt every shard's segment-sum
+        check(
+            getattr(feed, "_mesh", None) is self.mesh,
+            "feed mesh and learner mesh must match (csr entry layouts "
+            "differ between mesh and single-device runs)",
+        )
         history = []
         for epoch in range(epochs):
             acc = EpochMetrics()

@@ -30,3 +30,12 @@ class TestDeviceTier:
         assert out["psum_step_ms"] > 0
         assert out["psum_algo_gbps"] > 0
         assert "psum_ici_utilization" not in out  # cpu: no ICI estimate
+
+    def test_engine_allreduce_metric(self):
+        from bench_collective import device_engine_allreduce_metrics
+
+        out = device_engine_allreduce_metrics(payload_mb=1.0, iters=3)
+        assert out["engine_allreduce_world"] >= 1
+        key = ("engine_allreduce_gbps" if out["engine_allreduce_world"] > 1
+               else "engine_reduce_single_process_gbps")
+        assert out[key] > 0
