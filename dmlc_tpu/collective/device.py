@@ -159,9 +159,11 @@ class DeviceEngine:
             )
             out = self._reduce_fn(op)(garr)
             return np.asarray(out)
-        except (TypeError, ValueError):
-            raise  # deterministic user/shape errors: no recovery cascade
         except Exception as err:  # noqa: BLE001 — backend error translation
+            # deterministic user errors were screened by _validate/op-check
+            # above; what reaches here is transport-shaped (ValueError
+            # included — see _translate's contract), so mark the engine
+            # aborted and let run_with_recovery catch it
             raise self._translate(err, "allreduce") from err
 
     # fixed-size broadcast header: [ndim, dims[0..7], dtype_num]

@@ -1,0 +1,291 @@
+"""Azure Blob Storage backend: ``azure://container/blob``.
+
+The reference ships listing only, through the Azure C++ SDK
+(/root/reference/src/io/azure_filesys.cc:32-92, account/key from
+AZURE_STORAGE_ACCOUNT / AZURE_STORAGE_ACCESS_KEY). This build implements
+the Blob REST dialect directly — list, stat, ranged reads (the parallel
+readahead primitive) AND block-committed writes — through the same
+``_ObjectStoreBase`` machinery as S3/GCS, so every ingest path (local
+InputSplit stack, native push-mode readahead) works over azure:// too.
+
+Auth, either of:
+- shared key: AZURE_STORAGE_ACCOUNT + AZURE_STORAGE_ACCESS_KEY (base64),
+  signing requests per the SharedKey scheme;
+- SAS: AZURE_STORAGE_ACCOUNT + AZURE_STORAGE_SAS_TOKEN appended to each
+  request's query string;
+- neither set: anonymous (public containers, or a fake test endpoint).
+
+AZURE_STORAGE_ENDPOINT overrides ``https://{account}.blob.core.windows.net``
+(hermetic tests point it at tests/fake_azure.py).
+"""
+
+from __future__ import annotations
+
+import base64  # noqa: I001
+import hashlib
+import hmac
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from email.utils import formatdate
+from typing import Dict, List, Optional, Tuple
+
+from dmlc_tpu.io.filesystem import URI
+from dmlc_tpu.io.object_store import (
+    DEFAULT_WRITE_BUFFER_MB,
+    ObjectWriteStream,
+    _http,
+    _keepalive_get,
+    _ObjectStoreBase,
+    _retry_call,
+)
+from dmlc_tpu.io.stream import Stream
+from dmlc_tpu.utils.logging import check
+
+_API_VERSION = "2021-08-06"
+
+# headers that participate in the SharedKey string-to-sign, in order
+_SIGNED_STD_HEADERS = (
+    "Content-Encoding", "Content-Language", "Content-Length", "Content-MD5",
+    "Content-Type", "Date", "If-Modified-Since", "If-Match", "If-None-Match",
+    "If-Unmodified-Since", "Range",
+)
+
+
+def _rfc1123_now() -> str:
+    return formatdate(timeval=None, localtime=False, usegmt=True)
+
+
+class AzureBlobFileSystem(_ObjectStoreBase):
+    """``azure://container/blob`` via Blob service REST."""
+
+    def __init__(self):
+        env = os.environ
+        self.account = env.get("AZURE_STORAGE_ACCOUNT", "")
+        self.key = env.get(
+            "AZURE_STORAGE_ACCESS_KEY", env.get("AZURE_STORAGE_KEY", "")
+        )
+        self.sas = env.get("AZURE_STORAGE_SAS_TOKEN", "").lstrip("?")
+        endpoint = env.get("AZURE_STORAGE_ENDPOINT")
+        if not endpoint:
+            check(
+                bool(self.account),
+                "set AZURE_STORAGE_ACCOUNT (and ACCESS_KEY or SAS_TOKEN) "
+                "to use azure:// (azure_filesys.cc:32-39 contract)",
+            )
+            endpoint = f"https://{self.account}.blob.core.windows.net"
+        self.endpoint = endpoint.rstrip("/")
+        self.part_bytes = (
+            int(env.get("DMLC_AZURE_WRITE_BUFFER_MB",
+                        env.get("DMLC_S3_WRITE_BUFFER_MB",
+                                DEFAULT_WRITE_BUFFER_MB)))
+            << 20
+        )
+
+    # ---- request plumbing ---------------------------------------------
+
+    def _url(self, container: str, key: str, query: str = "") -> str:
+        path = f"/{container}"
+        if key:
+            path += f"/{urllib.parse.quote(key)}"
+        if self.sas:
+            query = f"{query}&{self.sas}" if query else self.sas
+        return self.endpoint + path + (f"?{query}" if query else "")
+
+    def _auth_headers(
+        self, method: str, url: str, headers: Dict[str, str],
+        content_length: int = 0,
+    ) -> Dict[str, str]:
+        """x-ms-date/version plus the SharedKey Authorization header
+        (skipped under SAS/anonymous auth)."""
+        out = dict(headers)
+        out.setdefault("x-ms-date", _rfc1123_now())
+        out.setdefault("x-ms-version", _API_VERSION)
+        if not (self.account and self.key) or self.sas:
+            return out
+        parsed = urllib.parse.urlsplit(url)
+        canon_headers = "".join(
+            f"{k.lower()}:{v.strip()}\n"
+            for k, v in sorted(out.items())
+            if k.lower().startswith("x-ms-")
+        )
+        # canonicalized resource: /account/path + sorted query params
+        resource = f"/{self.account}{parsed.path}"
+        params = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+        for name, value in sorted(params):
+            resource += f"\n{name.lower()}:{value}"
+        values = dict.fromkeys(_SIGNED_STD_HEADERS, "")
+        values["Content-Length"] = (
+            str(content_length) if content_length else ""
+        )
+        for k, v in out.items():
+            title = k.title()
+            if title in values and not title.startswith("X-Ms-"):
+                values[title] = v
+        to_sign = (
+            method + "\n"
+            + "\n".join(values[h] for h in _SIGNED_STD_HEADERS) + "\n"
+            + canon_headers + resource
+        )
+        sig = base64.b64encode(
+            hmac.new(
+                base64.b64decode(self.key), to_sign.encode("utf-8"),
+                hashlib.sha256,
+            ).digest()
+        ).decode()
+        out["Authorization"] = f"SharedKey {self.account}:{sig}"
+        return out
+
+    def _request(self, method: str, url: str, payload: bytes = b"",
+                 headers: Optional[Dict[str, str]] = None):
+        hdrs = dict(headers or {})
+        if payload:
+            # urllib injects Content-Type on bodied requests; it must be
+            # explicit so the SharedKey string-to-sign matches the wire
+            hdrs.setdefault("Content-Type", "application/octet-stream")
+        hdrs = self._auth_headers(
+            method, url, hdrs, content_length=len(payload)
+        )
+        req = urllib.request.Request(
+            url, data=payload if payload else None, headers=hdrs,
+            method=method,
+        )
+        return _http(req)
+
+    # ---- reads ---------------------------------------------------------
+
+    def _open_ranged(self, path: URI, start: int, end: Optional[int] = None):
+        container, key = self._bucket_key(path)
+        url = self._url(container, key)
+        hdrs = self._auth_headers(
+            "GET", url, {"Range": self._range_header(start, end)}
+        )
+        if end is not None:  # bounded: body fully drained, safe to reuse
+            return _keepalive_get(url, hdrs)
+        return _http(urllib.request.Request(url, headers=hdrs))
+
+    def _stat_object(self, path: URI) -> Optional[int]:
+        container, key = self._bucket_key(path)
+        if not key:
+            return None
+        url = self._url(container, key)
+        try:
+            with self._request("HEAD", url) as resp:
+                return int(resp.headers.get("Content-Length", 0))
+        except urllib.error.HTTPError as err:
+            if err.code in (404, 403):
+                return None
+            raise
+
+    def _list(self, container: str, prefix: str, delimiter: str):
+        """List Blobs (flat, hierarchical with delimiter): the capability
+        the reference's ListDirectory provides (azure_filesys.cc:42-92)."""
+        files: List[Tuple[str, int]] = []
+        prefixes: List[str] = []
+        marker = None
+        while True:
+            q = [("restype", "container"), ("comp", "list")]
+            if prefix:
+                q.append(("prefix", prefix))
+            if delimiter:
+                q.append(("delimiter", delimiter))
+            if marker:
+                q.append(("marker", marker))
+            url = self._url(container, "", urllib.parse.urlencode(q))
+            with self._request("GET", url) as resp:
+                tree = ET.fromstring(resp.read())
+            blobs = tree.find("Blobs")
+            if blobs is not None:
+                for item in blobs.findall("Blob"):
+                    name = item.findtext("Name")
+                    size = int(
+                        item.findtext("Properties/Content-Length", "0")
+                    )
+                    files.append((name, size))
+                for item in blobs.findall("BlobPrefix"):
+                    prefixes.append(item.findtext("Name"))
+            marker = tree.findtext("NextMarker")
+            if not marker:
+                break
+        return files, prefixes
+
+    # ---- writes: Put Block + Put Block List ---------------------------
+
+    class _AzureWriteStream(ObjectWriteStream):
+        def __init__(self, fs: "AzureBlobFileSystem", path: URI):
+            super().__init__(fs.part_bytes)
+            self._fs = fs
+            self._path = path
+            self._block_ids: List[str] = []
+
+        def _upload_part(self, data: bytes, last: bool) -> None:
+            fs, (container, key) = self._fs, self._fs._bucket_key(self._path)
+            if last and not self._block_ids:
+                # single-shot Put Blob (the common small-object case)
+                url = fs._url(container, key)
+
+                def _put():
+                    with fs._request(
+                        "PUT", url, payload=data,
+                        headers={"x-ms-blob-type": "BlockBlob"},
+                    ):
+                        pass
+
+                _retry_call(_put, f"azure Put Blob {key}")
+                self._block_ids = None  # finalize becomes a no-op
+                return
+            if not data and last:
+                return
+            block_id = base64.b64encode(
+                f"{len(self._block_ids):010d}".encode()
+            ).decode()
+
+            def _put_block():
+                url = fs._url(
+                    container, key,
+                    urllib.parse.urlencode(
+                        [("comp", "block"), ("blockid", block_id)]
+                    ),
+                )
+                with fs._request("PUT", url, payload=data):
+                    pass
+
+            _retry_call(_put_block, f"azure Put Block {key}")
+            self._block_ids.append(block_id)
+
+        def _finalize(self) -> None:
+            if self._block_ids is None:
+                return  # single-shot Put Blob path
+            fs, (container, key) = self._fs, self._fs._bucket_key(self._path)
+            body = (
+                "<?xml version=\"1.0\" encoding=\"utf-8\"?><BlockList>"
+                + "".join(
+                    f"<Latest>{b}</Latest>" for b in self._block_ids
+                )
+                + "</BlockList>"
+            ).encode()
+
+            def _commit():
+                url = fs._url(
+                    container, key, urllib.parse.urlencode([("comp",
+                                                             "blocklist")])
+                )
+                with fs._request("PUT", url, payload=body):
+                    pass
+
+            _retry_call(_commit, f"azure Put Block List {key}")
+
+    def _open_write(self, path: URI) -> Stream:
+        return self._AzureWriteStream(self, path)
+
+    def delete(self, path: URI) -> None:
+        container, key = self._bucket_key(path)
+        with self._request("DELETE", self._url(container, key)):
+            pass
+
+
+from dmlc_tpu.io.filesystem import register_filesystem  # noqa: E402
+
+register_filesystem("azure://", lambda uri: AzureBlobFileSystem())

@@ -555,6 +555,8 @@ def get_filesystem(path: URI) -> FileSystem:
         import dmlc_tpu.io.object_store  # noqa: F401  (self-registers)
     if proto == "hdfs://" and "hdfs://" not in _fs_factories:
         import dmlc_tpu.io.webhdfs  # noqa: F401  (self-registers)
+    if proto == "azure://" and "azure://" not in _fs_factories:
+        import dmlc_tpu.io.azure  # noqa: F401  (self-registers)
     with _fs_lock:
         key = (proto, path.host)
         inst = _fs_instances.get(key)
@@ -593,11 +595,8 @@ register_filesystem(
     _gated_backend("viewfs://", "resolve the mounttable to a concrete "
                    "hdfs:// namenode, or use an hdfs gateway mount"),
 )
-register_filesystem(
-    "azure://",
-    _gated_backend("azure://", "use gs:// or s3:// (Azure Blob's S3-"
-                   "compatible gateways work with s3:// + S3_ENDPOINT)"),
-)
+# azure:// resolves lazily to the Blob REST backend (io/azure.py) on
+# first use — see get_filesystem
 
 
 def create_stream(uri: str, flag: str, allow_null: bool = False) -> Optional[Stream]:

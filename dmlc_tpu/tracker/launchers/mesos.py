@@ -1,18 +1,30 @@
 """Mesos launcher (tracker/dmlc_tracker/mesos.py).
 
-The reference drives pymesos (or plain subprocess fallback) to launch one
-task per worker/server with cpus/mem resources. pymesos is not available in
-this image, so this launcher provides the task-plan surface (pure, tested)
-and executes it through pymesos only when importable; otherwise it raises
-with a clear message.
+The reference drives one task per worker/server with cpus/mem resources
+through pymesos.subprocess, falling back to the ``mesos-execute`` CLI when
+pymesos is absent (mesos.py:17-57), under a started tracker
+(mesos.py:66-104). Same structure here: ``plan()`` is the pure task list
+(surface-tested without a cluster), ``submit()`` starts the tracker and
+drives every task on a daemon thread through the best available runner.
+The runner is injectable (``runner=`` / ``_pick_runner``) so the drive
+loop itself is unit-testable with a fake scheduler.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import json
+import os
+import shutil
+import subprocess
+import threading
+import uuid
+from typing import Callable, Dict, List, Optional
 
 from dmlc_tpu.tracker.launchers.common import task_env
 from dmlc_tpu.tracker.rendezvous import submit_with_tracker
+
+# env passed through to tasks beyond the DMLC_* contract (mesos.py:60-63)
+_PASSTHROUGH = ("OMP_NUM_THREADS", "KMP_AFFINITY", "LD_LIBRARY_PATH")
 
 
 def plan(args, nworker: int, nserver: int, envs: Dict[str, object]) -> List[Dict]:
@@ -22,6 +34,9 @@ def plan(args, nworker: int, nserver: int, envs: Dict[str, object]) -> List[Dict
         role = "worker" if i < nworker else "server"
         tid = i if i < nworker else i - nworker
         env = task_env(envs, tid, role, "mesos", extra=args.env_map)
+        for key in _PASSTHROUGH:
+            if key in os.environ:
+                env.setdefault(key, os.environ[key])
         tasks.append({
             "name": f"{args.jobname or 'dmlc-job'}-{role}-{tid}",
             "role": role,
@@ -35,23 +50,103 @@ def plan(args, nworker: int, nserver: int, envs: Dict[str, object]) -> List[Dict
     return tasks
 
 
-def submit(args) -> None:
-    if not args.mesos_master:
-        raise ValueError("mesos cluster needs --mesos-master")
+def _run_pymesos(task: Dict) -> None:
+    import pymesos.subprocess  # noqa: PLC0415 — optional dependency
+
+    env = {str(k): str(v) for k, v in task["env"].items()}
+    pymesos.subprocess.check_call(
+        task["command"], shell=True, env=env, cwd=os.getcwd(),
+        cpus=task["cpus"], mem=task["mem_mb"],
+    )
+
+
+def _run_mesos_execute(task: Dict) -> None:
+    """CLI fallback: the reference's mesos-execute shape (mesos.py:32-56)."""
+    master = os.environ["MESOS_MASTER"]
+    if ":" not in master:
+        master += ":5050"
+    env = {str(k): str(v) for k, v in task["env"].items()}
+    prog = f"cd {os.getcwd()} && {task['command']}"
+    resources = f"cpus:{task['cpus']};mem:{task['mem_mb']}"
+    cmd = [
+        "mesos-execute",
+        f"--master={master}",
+        f"--name={task['name']}-{uuid.uuid4()}",
+        f"--command={prog}",
+        f"--env={json.dumps(env)}",
+        f"--resources={resources}",
+    ]
+    subprocess.check_call(
+        cmd, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT
+    )
+
+
+def _pick_runner() -> Callable[[Dict], None]:
     try:
-        import pymesos  # noqa: F401
-    except ImportError as err:
-        raise RuntimeError(
-            "mesos launcher requires the pymesos package, which is not "
-            "installed in this environment"
-        ) from err
+        import pymesos.subprocess  # noqa: F401
+
+        return _run_pymesos
+    except ImportError:
+        pass
+    if shutil.which("mesos-execute"):
+        return _run_mesos_execute
+    raise RuntimeError(
+        "mesos launcher needs either the pymesos package or the "
+        "mesos-execute CLI on PATH"
+    )
+
+
+def submit(args, runner: Optional[Callable[[Dict], None]] = None) -> None:
+    if not (args.mesos_master or os.environ.get("MESOS_MASTER")):
+        raise ValueError("mesos cluster needs --mesos-master")
+    if args.mesos_master:
+        os.environ["MESOS_MASTER"] = args.mesos_master
+    run_task = runner if runner is not None else _pick_runner()
+    threads: List[threading.Thread] = []
+    errors: List[tuple] = []
+
+    def run_wrapped(task: Dict) -> None:
+        # a swallowed launch failure would leave the tracker waiting for
+        # a worker that never comes — record it so submit() can raise
+        try:
+            run_task(task)
+        except BaseException as err:  # noqa: BLE001 — crosses the thread
+            errors.append((task["name"], err))
 
     def fun_submit(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
-        raise NotImplementedError(
-            "pymesos scheduler drive-loop not wired in this build"
-        )
+        for task in plan(args, nworker, nserver, envs):
+            t = threading.Thread(target=run_wrapped, args=(task,), daemon=True)
+            t.start()
+            threads.append(t)
 
-    submit_with_tracker(
-        args.num_workers, args.num_servers, fun_submit,
-        host_ip=args.host_ip or "auto",
-    )
+    from dmlc_tpu.tracker.rendezvous import RabitTracker, get_host_ip
+
+    if args.num_servers:
+        # PS jobs keep the reference's tracker composition
+        submit_with_tracker(
+            args.num_workers, args.num_servers, fun_submit,
+            host_ip=args.host_ip or "auto",
+        )
+        for t in threads:
+            t.join()
+    else:
+        # rabit jobs: join the TASK threads before the tracker so a failed
+        # launch raises instead of hanging the tracker's rendezvous wait
+        ip = get_host_ip(args.host_ip or "auto")
+        tracker = RabitTracker(host_ip=ip, num_workers=args.num_workers)
+        envs: Dict[str, object] = {
+            "DMLC_NUM_WORKER": args.num_workers,
+            "DMLC_NUM_SERVER": 0,
+        }
+        envs.update(tracker.worker_envs())
+        tracker.start(args.num_workers)
+        fun_submit(args.num_workers, 0, envs)
+        for t in threads:
+            t.join()
+        if not errors:
+            tracker.join()
+    if errors:
+        name, err = errors[0]
+        raise RuntimeError(
+            f"mesos task {name} failed ({len(errors)} task(s) total): {err}"
+        ) from err

@@ -5,9 +5,11 @@ The reference builds a Java ApplicationMaster jar and submits via
 launcher reproduces the submission surface — the ``hadoop jar`` command
 line, file/archive localization, per-role cores+memory env — against any
 dmlc-compatible YARN AM jar (``DMLC_YARN_JAR`` env or --yarn-app-classpath);
-it does not vendor the Java AM itself. The per-container retry/blacklist
-policy (ApplicationMaster.java:76,212-213,332-354) is the AM's job and is
-honored via DMLC_MAX_ATTEMPT.
+it does not vendor the Java AM itself. The AM's retry/blacklist policy
+(ApplicationMaster.java:76,212-213,332-354) exists in-repo too:
+``yarn_controller.RetryController`` is the pure policy, ``drive_app``
+polls the RM REST API for application-level retries, and this submit
+retries the blocking submission itself up to DMLC_MAX_ATTEMPT.
 """
 
 from __future__ import annotations
@@ -60,8 +62,24 @@ def submit(args) -> None:
             "DMLC_YARN_JAR or --yarn-app-classpath to its path"
         )
 
+    from dmlc_tpu.tracker.launchers.yarn_controller import default_max_attempt
+    from dmlc_tpu.utils.logging import log_info
+
+    budget = args.max_attempts or default_max_attempt()
+
     def fun_submit(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
-        subprocess.check_call(plan_hadoop_jar(args, nworker, nserver, envs, jar))
+        argv = plan_hadoop_jar(args, nworker, nserver, envs, jar)
+        for attempt in range(budget):
+            try:
+                subprocess.check_call(argv)
+                return
+            except subprocess.CalledProcessError as err:
+                if attempt + 1 >= budget:
+                    raise
+                log_info(
+                    "yarn submission failed (rc=%d), attempt %d/%d",
+                    err.returncode, attempt + 1, budget,
+                )
 
     submit_with_tracker(
         args.num_workers, args.num_servers, fun_submit,

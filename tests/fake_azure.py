@@ -1,0 +1,186 @@
+"""In-process fake Azure Blob service for hermetic azure:// tests.
+
+Implements the REST subset dmlc_tpu.io.azure uses: ranged GET, HEAD blob,
+List Blobs (flat + delimiter, with marker paging), Put Blob, Put Block /
+Put Block List, Delete Blob. Requests are accepted with or without auth
+headers (signature validation is out of scope; the client's header
+construction is covered by unit tests against the string-to-sign)."""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from xml.sax.saxutils import escape
+
+
+class FakeAzureStore:
+    def __init__(self):
+        self.blobs: Dict[Tuple[str, str], bytes] = {}
+        self.blocks: Dict[Tuple[str, str, str], bytes] = {}
+        self.request_count = 0
+        self.max_list_results = 1000  # lower in tests to force paging
+        self.lock = threading.Lock()
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: FakeAzureStore = None  # set by serve()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _parts(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        segs = parsed.path.lstrip("/").split("/", 1)
+        container = segs[0] if segs and segs[0] else ""
+        key = urllib.parse.unquote(segs[1]) if len(segs) > 1 else ""
+        return q, container, key
+
+    def _send(self, code: int, body: bytes = b"",
+              headers: Optional[Dict[str, str]] = None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    # ---- GET: ranged blob read or listing ------------------------------
+
+    def do_GET(self):
+        st = self.store
+        st.request_count += 1
+        q, container, key = self._parts()
+        if q.get("comp") == "list":
+            return self._list(container, q)
+        data = st.blobs.get((container, key))
+        if data is None:
+            return self._send(404)
+        start, stop = 0, len(data)
+        rng = self.headers.get("Range") or self.headers.get("x-ms-range")
+        if rng:
+            spec = rng.split("=", 1)[1]
+            lo, _, hi = spec.partition("-")
+            start = int(lo)
+            if hi:
+                stop = min(stop, int(hi) + 1)
+            if start >= len(data):
+                return self._send(416)
+        body = memoryview(data)[start:stop]
+        self._send(206 if rng else 200, body)
+
+    def _list(self, container: str, q):
+        st = self.store
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        marker = q.get("marker", "")
+        names = sorted(
+            k for (c, k) in st.blobs if c == container and k.startswith(prefix)
+        )
+        files = []
+        prefixes = []
+        seen = set()
+        for name in names:
+            if delimiter:
+                rest = name[len(prefix):]
+                cut = rest.find(delimiter)
+                if cut >= 0:
+                    p = prefix + rest[: cut + 1]
+                    if p not in seen:
+                        seen.add(p)
+                        prefixes.append(p)
+                    continue
+            files.append(name)
+        entries = [("blob", n) for n in files] + [
+            ("prefix", p) for p in prefixes
+        ]
+        entries.sort(key=lambda e: e[1])
+        if marker:
+            entries = [e for e in entries if e[1] > marker]
+        page = entries[: st.max_list_results]
+        next_marker = page[-1][1] if len(entries) > len(page) else ""
+        blobs_xml = []
+        for kind, name in page:
+            if kind == "blob":
+                size = len(st.blobs[(container, name)])
+                blobs_xml.append(
+                    f"<Blob><Name>{escape(name)}</Name><Properties>"
+                    f"<Content-Length>{size}</Content-Length>"
+                    f"</Properties></Blob>"
+                )
+            else:
+                blobs_xml.append(
+                    f"<BlobPrefix><Name>{escape(name)}</Name></BlobPrefix>"
+                )
+        body = (
+            "<?xml version=\"1.0\" encoding=\"utf-8\"?>"
+            f"<EnumerationResults ContainerName=\"{escape(container)}\">"
+            f"<Blobs>{''.join(blobs_xml)}</Blobs>"
+            f"<NextMarker>{escape(next_marker)}</NextMarker>"
+            "</EnumerationResults>"
+        ).encode()
+        self._send(200, body, {"Content-Type": "application/xml"})
+
+    # ---- HEAD -----------------------------------------------------------
+
+    def do_HEAD(self):
+        st = self.store
+        st.request_count += 1
+        _q, container, key = self._parts()
+        data = st.blobs.get((container, key))
+        if data is None:
+            return self._send(404)
+        self._send(200, b"", {"Content-Length": str(len(data))})
+
+    # ---- PUT: blob / block / block list ---------------------------------
+
+    def do_PUT(self):
+        st = self.store
+        st.request_count += 1
+        q, container, key = self._parts()
+        body = self._read_body()
+        comp = q.get("comp")
+        if comp == "block":
+            st.blocks[(container, key, q["blockid"])] = body
+            return self._send(201)
+        if comp == "blocklist":
+            import re
+
+            ids = re.findall(rb"<Latest>([^<]+)</Latest>", body)
+            data = b"".join(
+                st.blocks[(container, key, bid.decode())] for bid in ids
+            )
+            st.blobs[(container, key)] = data
+            return self._send(201)
+        # Put Blob
+        if self.headers.get("x-ms-blob-type") != "BlockBlob":
+            return self._send(400)
+        st.blobs[(container, key)] = body
+        self._send(201)
+
+    def do_DELETE(self):
+        st = self.store
+        st.request_count += 1
+        _q, container, key = self._parts()
+        if st.blobs.pop((container, key), None) is None:
+            return self._send(404)
+        self._send(202)
+
+
+def serve():
+    """→ (server, store, base_url); caller calls server.shutdown()."""
+    store = FakeAzureStore()
+    handler = type("BoundHandler", (Handler,), {"store": store})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    return server, store, base

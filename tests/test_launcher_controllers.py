@@ -1,0 +1,296 @@
+"""Mesos drive loop + YARN retry/blacklist controller (VERDICT r2 item 8).
+
+The mesos test runs the REAL drive loop — tracker + per-task threads —
+with a fake scheduler runner that executes tasks as local subprocesses, so
+the workers genuinely rendezvous and allreduce. The YARN tests pin the
+AM policy (ApplicationMaster.java:76,212-213,332-354) and drive the REST
+controller against a fake ResourceManager.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_tpu.tracker.opts import get_opts
+from dmlc_tpu.utils.logging import DMLCError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SCRIPT = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from dmlc_tpu.collective.socket_engine import SocketEngine
+    import numpy as np
+    eng = SocketEngine()
+    out = eng.allreduce(np.ones(3, dtype=np.float32))
+    eng.shutdown()
+    sys.exit(0 if float(out[0]) == 2.0 else 1)
+""")
+
+WORKER_SCRIPT_W1 = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from dmlc_tpu.collective.socket_engine import SocketEngine
+    import numpy as np
+    eng = SocketEngine()
+    out = eng.allreduce(np.ones(3, dtype=np.float32))
+    eng.shutdown()
+    sys.exit(0 if float(out[0]) == 1.0 else 1)
+""")
+
+
+def _parse(argv):
+    return get_opts(argv)
+
+
+class TestMesosDriveLoop:
+    def test_plan_is_pure(self):
+        args = _parse([
+            "--cluster", "mesos", "-n", "2", "-s", "1",
+            "--mesos-master", "zk://m:5050", "--worker-cores", "2",
+            "--worker-memory", "1g", "echo", "hi",
+        ])
+        from dmlc_tpu.tracker.launchers.mesos import plan
+
+        tasks = plan(args, 2, 1, {"DMLC_NUM_WORKER": 2, "DMLC_NUM_SERVER": 1})
+        assert len(tasks) == 3
+        assert tasks[0]["cpus"] == 2 and tasks[0]["mem_mb"] == 1024
+        assert tasks[2]["role"] == "server"
+        assert tasks[0]["env"]["DMLC_ROLE"] == "worker"
+
+    def test_drive_loop_with_fake_scheduler(self, tmp_path):
+        """submit() drives every planned task through the injected runner
+        and the job completes: workers rendezvous through the tracker and
+        allreduce (the full mesos.py:66-104 shape, scheduler faked)."""
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_SCRIPT.format(repo=REPO))
+        args = _parse([
+            "--cluster", "mesos", "-n", "2",
+            "--mesos-master", "127.0.0.1:5050", "--host-ip", "127.0.0.1",
+            sys.executable, str(script),
+        ])
+        from dmlc_tpu.tracker.launchers.mesos import submit
+
+        launched = []
+
+        def fake_runner(task):
+            launched.append((task["role"], task["task_id"], task["cpus"]))
+            env = {**os.environ, **{k: str(v) for k, v in task["env"].items()}}
+            subprocess.check_call(task["command"], shell=True, env=env)
+
+        submit(args, runner=fake_runner)
+        assert sorted(launched) == [("worker", 0, 1), ("worker", 1, 1)]
+
+    def test_submit_requires_master(self):
+        args = _parse(["--cluster", "mesos", "-n", "1", "echo", "hi"])
+        os.environ.pop("MESOS_MASTER", None)
+        from dmlc_tpu.tracker.launchers.mesos import submit
+
+        with pytest.raises(ValueError, match="mesos-master"):
+            submit(args, runner=lambda task: None)
+
+
+class TestYarnRetryPolicy:
+    def test_success_path(self):
+        from dmlc_tpu.tracker.launchers.yarn_controller import RetryController
+
+        ctl = RetryController(num_tasks=2, max_attempt=3)
+        assert ctl.pending() == [0, 1]
+        ctl.assigned(0, "node-a")
+        ctl.assigned(1, "node-b")
+        assert ctl.pending() == []
+        ctl.completed(0, 0)
+        ctl.completed(1, 0)
+        assert ctl.finished
+        ctl.check_healthy()
+
+    def test_failure_blacklists_and_requeues(self):
+        from dmlc_tpu.tracker.launchers.yarn_controller import RetryController
+
+        ctl = RetryController(num_tasks=1, max_attempt=3)
+        ctl.assigned(0, "node-a")
+        ctl.completed(0, 1)
+        assert not ctl.allowed_node("node-a")  # blacklisted
+        assert ctl.pending() == [0]  # re-queued
+        ctl.check_healthy()  # still within budget
+        ctl.assigned(0, "node-b")
+        ctl.completed(0, 0)
+        assert ctl.finished
+
+    def test_abort_past_budget(self):
+        from dmlc_tpu.tracker.launchers.yarn_controller import RetryController
+
+        ctl = RetryController(num_tasks=1, max_attempt=2)
+        for node in ("n1", "n2"):
+            ctl.assigned(0, node)
+            ctl.completed(0, 1)
+        assert ctl.aborted
+        with pytest.raises(DMLCError, match="failed 2 times"):
+            ctl.check_healthy()
+
+    def test_max_attempt_env_default(self, monkeypatch):
+        from dmlc_tpu.tracker.launchers.yarn_controller import (
+            RetryController,
+            default_max_attempt,
+        )
+
+        monkeypatch.setenv("DMLC_MAX_ATTEMPT", "5")
+        assert default_max_attempt() == 5
+        assert RetryController(num_tasks=1).max_attempt == 5
+
+
+class _FakeRM:
+    """Minimal RM REST: /ws/v1/cluster/apps/{id} (+/appattempts). Apps are
+    scripted: submit_outcomes pops (state, finalStatus, node) per app."""
+
+    def __init__(self):
+        self.apps = {}
+        self._id = 0
+        self.lock = threading.Lock()
+
+    def next_app(self, outcome):
+        with self.lock:
+            self._id += 1
+            app_id = f"application_1_{self._id:04d}"
+        state, final, node = outcome
+        self.apps[app_id] = {
+            "state": state, "finalStatus": final,
+            "diagnostics": f"{app_id} {final}", "node": node,
+        }
+        return app_id
+
+
+def _rm_server(rm: _FakeRM):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            parts = self.path.strip("/").split("/")
+            # ws/v1/cluster/apps/{id}[/appattempts]
+            app_id = parts[4] if len(parts) > 4 else ""
+            app = rm.apps.get(app_id)
+            if app is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            if len(parts) > 5 and parts[5] == "appattempts":
+                body = json.dumps({
+                    "appAttempts": {"appAttempt": [
+                        {"nodeHttpAddress": app["node"]}
+                    ]}
+                }).encode()
+            else:
+                body = json.dumps({"app": app}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+class TestYarnRestDriver:
+    def test_retry_until_success_with_blacklist(self):
+        from dmlc_tpu.tracker.launchers.yarn_controller import drive_app
+
+        rm = _FakeRM()
+        server, url = _rm_server(rm)
+        outcomes = [
+            ("FAILED", "FAILED", "bad-node-1:8042"),
+            ("FAILED", "FAILED", "bad-node-2:8042"),
+            ("FINISHED", "SUCCEEDED", "good-node:8042"),
+        ]
+        seen_blacklists = []
+
+        def submit_fn(blacklist):
+            seen_blacklists.append(set(blacklist))
+            return rm.next_app(outcomes[len(seen_blacklists) - 1])
+
+        try:
+            app_id = drive_app(url, submit_fn, max_attempt=3,
+                               poll_interval_s=0.01)
+        finally:
+            server.shutdown()
+        assert app_id.endswith("0003")
+        assert seen_blacklists[0] == set()
+        assert seen_blacklists[1] == {"bad-node-1:8042"}
+        assert seen_blacklists[2] == {"bad-node-1:8042", "bad-node-2:8042"}
+
+    def test_budget_exhaustion_raises(self):
+        from dmlc_tpu.tracker.launchers.yarn_controller import drive_app
+
+        rm = _FakeRM()
+        server, url = _rm_server(rm)
+
+        def submit_fn(blacklist):
+            return rm.next_app(("FAILED", "FAILED", "n:8042"))
+
+        try:
+            with pytest.raises(DMLCError, match="failed 2 times"):
+                drive_app(url, submit_fn, max_attempt=2, poll_interval_s=0.01)
+        finally:
+            server.shutdown()
+
+
+class TestYarnSubmitRetry:
+    def test_submission_retries_then_succeeds(self, monkeypatch, tmp_path):
+        """submit() retries the blocking hadoop-jar call within the
+        DMLC_MAX_ATTEMPT budget; the succeeding attempt's worker
+        rendezvouses so the tracker completes."""
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_SCRIPT_W1.format(repo=REPO))
+        calls = []
+        real_check_call = subprocess.check_call  # patched module-wide below
+
+        def fake_check_call(argv):
+            calls.append(argv)
+            if len(calls) < 2:
+                raise subprocess.CalledProcessError(1, argv)
+            # success path: behave like the YARN job — launch the worker
+            # with the DMLC env the submission carries
+            env_arg = argv[argv.index("-env") + 1]
+            env = {**os.environ}
+            for pair in env_arg.split(","):
+                k, _, v = pair.partition("=")
+                env[k] = v
+            env["DMLC_TASK_ID"] = "0"
+            env["DMLC_ROLE"] = "worker"
+            real_check_call([sys.executable, str(script)], env=env)
+
+        import dmlc_tpu.tracker.launchers.yarn as yarn_mod
+
+        monkeypatch.setattr(yarn_mod.subprocess, "check_call",
+                            fake_check_call)
+        monkeypatch.setenv("DMLC_YARN_JAR", str(tmp_path / "dmlc.jar"))
+        args = _parse([
+            "--cluster", "yarn", "-n", "1", "--max-attempts", "3",
+            "--host-ip", "127.0.0.1", "echo", "hi",
+        ])
+        yarn_mod.submit(args)
+        assert len(calls) == 2
+        assert calls[0][:2] == ["hadoop", "jar"]
+
+    def test_failed_launch_raises_not_hangs(self, tmp_path):
+        """A runner failure surfaces as an error instead of leaving the
+        tracker waiting forever for the missing worker."""
+        args = _parse([
+            "--cluster", "mesos", "-n", "2",
+            "--mesos-master", "127.0.0.1:5050", "--host-ip", "127.0.0.1",
+            "echo", "hi",
+        ])
+        from dmlc_tpu.tracker.launchers.mesos import submit
+
+        def broken_runner(task):
+            raise RuntimeError("no offers")
+
+        with pytest.raises(RuntimeError, match="no offers"):
+            submit(args, runner=broken_runner)
