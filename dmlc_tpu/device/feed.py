@@ -24,7 +24,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
-from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
+from dmlc_tpu.data.row_block import RowBlockContainer
 from dmlc_tpu.device.csr import (
     DeviceCSRBatch,
     ShardedCSRBatch,

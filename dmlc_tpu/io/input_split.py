@@ -37,7 +37,6 @@ import numpy as np
 from dmlc_tpu.io import recordio as _rio
 from dmlc_tpu.io.filesystem import (
     FileInfo,
-    URI,
     create_stream,
     get_filesystem,
     list_split_files,

@@ -25,7 +25,6 @@ runs on ``init`` and on attribute assignment of parsed values.
 
 from __future__ import annotations
 
-import json
 import math
 from typing import Any, Dict, Mapping, Optional, Sequence
 
@@ -36,6 +35,14 @@ from dmlc_tpu.utils.logging import DMLCError
 # (unittest_param.cc:13-21 pins this behavior).
 _FLT_MIN = 1.17549435e-38
 _DBL_MIN = 2.2250738585072014e-308
+
+
+def _dmlc_json():
+    """The io/json.py streaming layer, imported lazily: params is a base
+    layer and must not pull the whole io package at import time."""
+    from dmlc_tpu.io import json as dmlc_json
+
+    return dmlc_json
 
 
 class ParamError(DMLCError):
@@ -352,18 +359,20 @@ class Parameter(metaclass=_ParameterMeta):
         target.update(self.to_dict())
 
     def save(self, fp) -> None:
-        """Save as a JSON object of string values (parameter.h:185-190)."""
-        json.dump(self.to_dict(), fp)
+        """Save as a JSON object of string values (parameter.h:185-190),
+        through the in-repo streaming writer (io/json.py — json.h:188)."""
+        _dmlc_json().dump(self.to_dict(), fp)
 
     def load(self, fp) -> None:
-        """Load from JSON written by ``save`` (parameter.h:193-197)."""
-        self.init(json.load(fp))
+        """Load from JSON written by ``save`` (parameter.h:193-197),
+        through the in-repo streaming reader (io/json.py — json.h:43)."""
+        self.init(_dmlc_json().load(fp))
 
     def saves(self) -> str:
-        return json.dumps(self.to_dict())
+        return _dmlc_json().dumps(self.to_dict())
 
     def loads(self, text: str) -> None:
-        self.init(json.loads(text))
+        self.init(_dmlc_json().loads(text))
 
     @classmethod
     def fields(cls) -> Dict[str, FieldInfo]:

@@ -10,7 +10,6 @@ from dmlc_tpu.data import (
     DiskRowIter,
     LibFMParser,
     LibSVMParser,
-    RowBlock,
     RowBlockContainer,
     ThreadedParser,
     create_parser,

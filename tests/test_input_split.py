@@ -10,10 +10,7 @@ from dmlc_tpu.io import MemoryStream, RecordIOWriter, create_input_split
 from dmlc_tpu.io.filesystem import MemoryFileSystem
 from dmlc_tpu.io.input_split import (
     CachedInputSplit,
-    IndexedRecordIOSplitter,
     InputSplitShuffle,
-    LineSplitter,
-    RecordIOSplitter,
     ThreadedInputSplit,
 )
 

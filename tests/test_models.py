@@ -9,7 +9,6 @@ import numpy as np
 import pytest
 
 from dmlc_tpu.models import (
-    FMLearner,
     LinearLearner,
     init_fm_params,
     init_linear_params,

@@ -12,7 +12,7 @@ import sys
 import numpy as np
 import pytest
 
-from dmlc_tpu.io import RECORDIO_MAGIC, RecordIOWriter, create_stream
+from dmlc_tpu.io import RecordIOWriter, create_stream
 from dmlc_tpu.tools import main as tools_main
 from dmlc_tpu.tools import (
     dataiter as tool_dataiter,
