@@ -184,7 +184,9 @@ def _bench_device_feed(path: str) -> dict:
         return DeviceFeed(create_parser(path, 0, 1, nthread=nthread), spec)
 
     feed_runs = []
-    for _ in range(TRIALS + 1):  # first pass is compile/cache warmup
+    stage_samples = {"host_batch_ns": [], "dispatch_ns": [],
+                     "host_wait_ns": []}
+    for trial in range(TRIALS + 1):  # first pass is compile/cache warmup
         feed = _feed()
         t0 = time.time()
         last = None
@@ -192,7 +194,15 @@ def _bench_device_feed(path: str) -> dict:
             last = batch
         jax.block_until_ready(last["x"])
         feed_runs.append(round(size_mb / (time.time() - t0), 1))
+        stats = feed.stats()
+        if trial > 0:  # per-stage medians over the same trials as the MB/s
+            for key in stage_samples:
+                stage_samples[key].append(stats[key])
         feed.close()
+    feed_stages = {
+        key.replace("_ns", "_s"): round(statistics.median(vals) / 1e9, 3)
+        for key, vals in stage_samples.items()
+    }
 
     params = init_linear_params(29)
     velocity = {"w": jnp.zeros_like(params["w"]),
@@ -213,6 +223,7 @@ def _bench_device_feed(path: str) -> dict:
     out = {
         "feed_dense_mbps": round(statistics.median(feed_runs[1:]), 1),
         "feed_dense_trials_mbps": feed_runs[1:],
+        "feed_stages": feed_stages,
         "sgd_e2e_mbps": round(statistics.median(sgd_runs[1:]), 1),
         "sgd_e2e_trials_mbps": sgd_runs[1:],
         "device": str(jax.devices()[0].platform),

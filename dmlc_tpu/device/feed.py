@@ -17,6 +17,7 @@ Batch layouts:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
@@ -79,6 +80,13 @@ class DeviceFeed:
                 axis,
                 mesh.shape[axis],
             )
+        # per-stage wall time (SURVEY §5.1: "where does feed time go?");
+        # host_ns accumulates on the ThreadedIter thread, the rest on the
+        # consuming thread — initialized BEFORE the producer thread starts
+        self._host_ns = 0
+        self._dispatch_ns = 0
+        self._wait_ns = 0
+        self._batches = 0
         self._host_iter = ThreadedIter(
             self._host_batches, max_capacity=prefetch, name="device-feed"
         )
@@ -94,9 +102,22 @@ class DeviceFeed:
         )
 
     def _host_batches(self) -> Iterator:
-        if self._use_native_batches():
-            yield from self._host_batches_native()
-            return
+        producer = (
+            self._host_batches_native()
+            if self._use_native_batches()
+            else self._host_batches_python()
+        )
+        while True:
+            t0 = time.monotonic_ns()
+            try:
+                item = next(producer)
+            except StopIteration:
+                return
+            finally:
+                self._host_ns += time.monotonic_ns() - t0
+            yield item
+
+    def _host_batches_python(self) -> Iterator:
         bs = self.spec.batch_size
         pending = RowBlockContainer()
         for block in self._parser:
@@ -234,17 +255,52 @@ class DeviceFeed:
     def __iter__(self):
         """Yield device batches with one transfer in flight ahead."""
         pending = None
-        for block in self._host_iter:
+        it = iter(self._host_iter)
+        while True:
+            t0 = time.monotonic_ns()
+            try:
+                block = next(it)
+            except StopIteration:
+                break
+            finally:
+                self._wait_ns += time.monotonic_ns() - t0
             ready = pending
+            t1 = time.monotonic_ns()
             pending = self._to_device(block)  # async dispatch
+            self._dispatch_ns += time.monotonic_ns() - t1
+            self._batches += 1
             if ready is not None:
                 yield ready
         if pending is not None:
             yield pending
 
+    def stats(self) -> dict:
+        """Per-stage wall time (ns): host batch production (parse+densify),
+        device dispatch, and time this consumer spent waiting on the host
+        thread — plus the native pipeline's own stage counters when the
+        parser exposes them (SURVEY §5.1)."""
+        out = {
+            "batches": self._batches,
+            "host_batch_ns": self._host_ns,
+            "dispatch_ns": self._dispatch_ns,
+            "host_wait_ns": self._wait_ns,
+        }
+        parser_stats = getattr(self._parser, "stats", None)
+        if callable(parser_stats):
+            pipeline = parser_stats()
+            if pipeline:
+                out["pipeline"] = pipeline
+        return out
+
     def before_first(self) -> None:
         self._host_iter.close()
         self._parser.before_first()
+        # counters window-align with the native pipeline's (which reset on
+        # reopen): stats() always describes the current epoch
+        self._host_ns = 0
+        self._dispatch_ns = 0
+        self._wait_ns = 0
+        self._batches = 0
         self._host_iter.before_first()
 
     @property

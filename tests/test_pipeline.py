@@ -340,3 +340,24 @@ def test_device_feed_native_path_matches_legacy(svm_file):
     assert len(native_batches) == len(py_batches)
     for a, b in zip(native_batches, py_batches):
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_device_feed_stats(svm_file):
+    """Feed-level stage timers (SURVEY §5.1): host batch, dispatch, wait,
+    plus the native pipeline's counters."""
+    import jax  # noqa: F401 — feed touches the device layer
+
+    from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+    feed = DeviceFeed(
+        create_parser(svm_file, 0, 1),
+        BatchSpec(batch_size=128, layout="dense", num_features=6),
+    )
+    n = sum(b["num_rows"] for b in feed)
+    stats = feed.stats()
+    feed.close()
+    assert n == 997
+    assert stats["batches"] == 8
+    assert stats["host_batch_ns"] > 0
+    assert stats["dispatch_ns"] > 0
+    assert stats["pipeline"]["bytes_read"] > 0
