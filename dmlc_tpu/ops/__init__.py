@@ -1,9 +1,11 @@
-"""TPU compute ops over device-resident CSR batches.
+"""TPU compute ops over device-resident CSR batches and long sequences.
 
 The reference stops at host CSR (`RowBlock`, data.h:170) and leaves compute to
 downstream learners; here the framework supplies the TPU-shaped kernels those
 learners need: COO/segment-sum SpMV (forward) and its transpose (gradient
-scatter), plus mesh-sharded variants.
+scatter) plus mesh-sharded variants, and the sequence-parallel attention
+schedules (ring / all-to-all) for long-context training — SURVEY §5.7's
+extension point, realized.
 """
 
 from dmlc_tpu.ops.spmv import (
@@ -11,5 +13,17 @@ from dmlc_tpu.ops.spmv import (
     spmv_transpose,
     make_sharded_spmv,
 )
+from dmlc_tpu.ops.sequence_parallel import (
+    full_attention,
+    make_ring_attention,
+    make_ulysses_attention,
+)
 
-__all__ = ["spmv", "spmv_transpose", "make_sharded_spmv"]
+__all__ = [
+    "spmv",
+    "spmv_transpose",
+    "make_sharded_spmv",
+    "full_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
+]

@@ -1,0 +1,177 @@
+"""Sequence parallelism (ops/sequence_parallel.py): ring attention and
+all-to-all (Ulysses) attention must equal exact full attention on the
+8-device mesh — SURVEY §5.7's extension point, realized."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.ops.sequence_parallel import (
+    full_attention,
+    make_ring_attention,
+    make_ulysses_attention,
+)
+
+
+def _mesh(axis="sp"):
+    devs = np.asarray(jax.devices())
+    return Mesh(devs, (axis,))
+
+
+def _qkv(rng, b, t, h, d):
+    shape = (b, t, h, d)
+    return (
+        jnp.asarray(rng.randn(*shape).astype(np.float32)),
+        jnp.asarray(rng.randn(*shape).astype(np.float32)),
+        jnp.asarray(rng.randn(*shape).astype(np.float32)),
+    )
+
+
+def _shard_seq(mesh, x, axis="sp"):
+    return jax.device_put(x, NamedSharding(mesh, P(None, axis)))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(0)
+        q, k, v = _qkv(rng, b=2, t=8 * n, h=4, d=16)
+        want = full_attention(q, k, v, causal=causal)
+
+        ring = make_ring_attention(mesh, causal=causal)
+        got = ring(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_output_stays_sequence_sharded(self):
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(1)
+        q, k, v = _qkv(rng, b=1, t=4 * n, h=2, d=8)
+        ring = make_ring_attention(mesh)
+        out = ring(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        # each device holds only its sequence shard of the output
+        assert out.addressable_shards[0].data.shape[1] == 4
+
+    def test_long_sequence_never_materializes_full_scores(self):
+        """The schedule's point: T x T never exists. Indirect check — a
+        sequence whose full score matrix would be big still runs, and the
+        jitted HLO contains no [T, T]-shaped intermediate."""
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        t = 64 * n
+        rng = np.random.RandomState(2)
+        q, k, v = _qkv(rng, b=1, t=t, h=1, d=8)
+        ring = make_ring_attention(mesh)
+        lowered = jax.jit(ring).lower(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        text = lowered.as_text()
+        # MLIR renders shapes as NxM: the global score matrix would appear
+        # as e.g. tensor<...512x512xf32> (it does in full_attention's HLO)
+        assert f"{t}x{t}" not in text
+        assert f"{t}x{t}" in jax.jit(full_attention).lower(q, k, v).as_text()
+        out = ring(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        want = full_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(3)
+        # heads must divide over the axis
+        q, k, v = _qkv(rng, b=2, t=4 * n, h=n, d=16)
+        want = full_attention(q, k, v, causal=causal)
+        ulysses = make_ulysses_attention(mesh, causal=causal)
+        got = ulysses(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_head_divisibility_checked(self):
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        if n == 1:
+            pytest.skip("needs >1 device to violate divisibility")
+        rng = np.random.RandomState(4)
+        q, k, v = _qkv(rng, b=1, t=2 * n, h=n + 1, d=8)
+        ulysses = make_ulysses_attention(mesh)
+        from dmlc_tpu.utils.logging import DMLCError
+
+        with pytest.raises(DMLCError, match="heads"):
+            ulysses(q, k, v)
+
+    def test_custom_local_kernel_plugs_in(self):
+        """local_attention hook: a Pallas flash kernel would slot in the
+        same way this scaled replacement does."""
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(5)
+        q, k, v = _qkv(rng, b=1, t=2 * n, h=n, d=8)
+
+        calls = []
+
+        def spy_kernel(q_, k_, v_):
+            calls.append(q_.shape)
+            return full_attention(q_, k_, v_)
+
+        ulysses = make_ulysses_attention(mesh, local_attention=spy_kernel)
+        got = ulysses(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        want = full_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+        # the kernel saw the full sequence with the head shard
+        assert calls and calls[0][1] == 2 * n and calls[0][2] == 1
+
+
+class TestGradients:
+    def test_ring_attention_differentiable(self):
+        """The schedule must train, not just infer: grads flow through the
+        scan + ppermute and match full attention's grads."""
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(6)
+        q, k, v = _qkv(rng, b=1, t=4 * n, h=2, d=8)
+        ring = make_ring_attention(mesh)
+
+        def loss_ring(q_, k_, v_):
+            return jnp.sum(ring(q_, k_, v_) ** 2)
+
+        def loss_full(q_, k_, v_):
+            return jnp.sum(full_attention(q_, k_, v_) ** 2)
+
+        g_ring = jax.grad(loss_ring)(q, k, v)
+        g_full = jax.grad(loss_full)(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(g_ring), np.asarray(g_full), rtol=5e-4, atol=5e-5
+        )
+
+    def test_causal_with_custom_kernel_rejected(self):
+        from dmlc_tpu.utils.logging import DMLCError
+
+        mesh = _mesh()
+        with pytest.raises(DMLCError, match="local_attention"):
+            make_ulysses_attention(
+                mesh, causal=True, local_attention=full_attention
+            )
