@@ -169,8 +169,6 @@ inline const char* scan_u64(const char* p, const char* end, uint64_t* out) {
 
 }  // namespace
 
-extern "C" {
-
 // Status codes shared by all parsers.
 enum {
   DMLC_TPU_OK = 0,
@@ -185,14 +183,64 @@ enum {
   DMLC_TPU_HAS_VALUE = 4,
 };
 
-// Parse libsvm text: "label[:weight] [qid:n] idx[:val] ..." per line.
-// Outputs: labels/weights [max_rows], qids [max_rows], row_nnz [max_rows],
-// indices/values [max_nnz]. Rows with no explicit weight get 1.0; bare
-// indices get value 1.0. Returns DMLC_TPU_OK/errors; *out_rows, *out_nnz,
-// *out_flags are filled on success.
-int parse_libsvm(const char* data, int64_t len,
+
+// Parse libfm text: "label field:idx:val ..." per line. Outputs as libsvm
+// plus fields [max_nnz].
+template <typename IndexT>
+static int parse_libfm_impl(const char* data, int64_t len,
+                float* labels, int64_t* row_nnz,
+                IndexT* fields, IndexT* indices, float* values,
+                int64_t max_rows, int64_t max_nnz,
+                int64_t* out_rows, int64_t* out_nnz) {
+  const char* p = data;
+  const char* end = data + len;
+  int64_t rows = 0, nnz = 0;
+  while (p != end) {
+    while (p != end && (is_space(*p) || is_eol(*p))) ++p;
+    if (p == end) break;
+    double label;
+    const char* q = scan_double(p, end, &label);
+    if (q == nullptr) return DMLC_TPU_EPARSE;
+    p = q;
+    if (rows >= max_rows) return DMLC_TPU_EOVERFLOW;
+    int64_t row_start = nnz;
+    for (;;) {
+      while (p != end && is_space(*p)) ++p;
+      if (p == end || is_eol(*p)) {
+        if (p != end) ++p;
+        break;
+      }
+      uint64_t field, idx;
+      double val;
+      q = scan_u64(p, end, &field);
+      if (q == nullptr || q == end || *q != ':') return DMLC_TPU_EPARSE;
+      q = scan_u64(q + 1, end, &idx);
+      if (q == nullptr || q == end || *q != ':') return DMLC_TPU_EPARSE;
+      q = scan_double(q + 1, end, &val);
+      if (q == nullptr) return DMLC_TPU_EPARSE;
+      p = q;
+      if (nnz >= max_nnz) return DMLC_TPU_EOVERFLOW;
+      fields[nnz] = static_cast<IndexT>(field);
+      indices[nnz] = static_cast<IndexT>(idx);
+      values[nnz] = static_cast<float>(val);
+      ++nnz;
+    }
+    labels[rows] = static_cast<float>(label);
+    row_nnz[rows] = nnz - row_start;
+    ++rows;
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  return DMLC_TPU_OK;
+}
+
+// Templated over the index width: the pipeline consumes u32 indices, and
+// writing them directly saves a whole narrowing pass over nnz (the
+// NarrowU64ToU32 sweep used to re-read 8 and re-write 4 bytes per entry).
+template <typename IndexT>
+static int parse_libsvm_impl(const char* data, int64_t len,
                  float* labels, float* weights, int64_t* qids,
-                 int64_t* row_nnz, uint64_t* indices, float* values,
+                 int64_t* row_nnz, IndexT* indices, float* values,
                  int64_t max_rows, int64_t max_nnz,
                  int64_t* out_rows, int64_t* out_nnz, int* out_flags) {
   const char* p = data;
@@ -249,7 +297,7 @@ int parse_libsvm(const char* data, int64_t len,
         flags |= DMLC_TPU_HAS_VALUE;
       }
       if (nnz >= max_nnz) return DMLC_TPU_EOVERFLOW;
-      indices[nnz] = idx;
+      indices[nnz] = static_cast<IndexT>(idx);
       values[nnz] = static_cast<float>(val);
       ++nnz;
     }
@@ -265,53 +313,55 @@ int parse_libsvm(const char* data, int64_t len,
   return DMLC_TPU_OK;
 }
 
-// Parse libfm text: "label field:idx:val ..." per line. Outputs as libsvm
-// plus fields [max_nnz].
+
+extern "C" {
+
+// Parse libsvm text: "label[:weight] [qid:n] idx[:val] ..." per line.
+// Outputs: labels/weights [max_rows], qids [max_rows], row_nnz [max_rows],
+// indices/values [max_nnz] — u64 indices (the original ctypes ABI). Rows
+// with no explicit weight get 1.0; bare indices get value 1.0. Returns
+// DMLC_TPU_OK/errors; *out_rows, *out_nnz, *out_flags filled on success.
+int parse_libsvm(const char* data, int64_t len,
+                 float* labels, float* weights, int64_t* qids,
+                 int64_t* row_nnz, uint64_t* indices, float* values,
+                 int64_t max_rows, int64_t max_nnz,
+                 int64_t* out_rows, int64_t* out_nnz, int* out_flags) {
+  return parse_libsvm_impl<uint64_t>(
+      data, len, labels, weights, qids, row_nnz, indices, values, max_rows,
+      max_nnz, out_rows, out_nnz, out_flags);
+}
+
+// u32-index variant for the native pipeline's device-layout buffers
+// (values past 2^32 truncate exactly like the old narrowing pass did).
+int parse_libsvm32(const char* data, int64_t len,
+                   float* labels, float* weights, int64_t* qids,
+                   int64_t* row_nnz, uint32_t* indices, float* values,
+                   int64_t max_rows, int64_t max_nnz,
+                   int64_t* out_rows, int64_t* out_nnz, int* out_flags) {
+  return parse_libsvm_impl<uint32_t>(
+      data, len, labels, weights, qids, row_nnz, indices, values, max_rows,
+      max_nnz, out_rows, out_nnz, out_flags);
+}
+
 int parse_libfm(const char* data, int64_t len,
                 float* labels, int64_t* row_nnz,
                 uint64_t* fields, uint64_t* indices, float* values,
                 int64_t max_rows, int64_t max_nnz,
                 int64_t* out_rows, int64_t* out_nnz) {
-  const char* p = data;
-  const char* end = data + len;
-  int64_t rows = 0, nnz = 0;
-  while (p != end) {
-    while (p != end && (is_space(*p) || is_eol(*p))) ++p;
-    if (p == end) break;
-    double label;
-    const char* q = scan_double(p, end, &label);
-    if (q == nullptr) return DMLC_TPU_EPARSE;
-    p = q;
-    if (rows >= max_rows) return DMLC_TPU_EOVERFLOW;
-    int64_t row_start = nnz;
-    for (;;) {
-      while (p != end && is_space(*p)) ++p;
-      if (p == end || is_eol(*p)) {
-        if (p != end) ++p;
-        break;
-      }
-      uint64_t field, idx;
-      double val;
-      q = scan_u64(p, end, &field);
-      if (q == nullptr || q == end || *q != ':') return DMLC_TPU_EPARSE;
-      q = scan_u64(q + 1, end, &idx);
-      if (q == nullptr || q == end || *q != ':') return DMLC_TPU_EPARSE;
-      q = scan_double(q + 1, end, &val);
-      if (q == nullptr) return DMLC_TPU_EPARSE;
-      p = q;
-      if (nnz >= max_nnz) return DMLC_TPU_EOVERFLOW;
-      fields[nnz] = field;
-      indices[nnz] = idx;
-      values[nnz] = static_cast<float>(val);
-      ++nnz;
-    }
-    labels[rows] = static_cast<float>(label);
-    row_nnz[rows] = nnz - row_start;
-    ++rows;
-  }
-  *out_rows = rows;
-  *out_nnz = nnz;
-  return DMLC_TPU_OK;
+  return parse_libfm_impl<uint64_t>(data, len, labels, row_nnz, fields,
+                                    indices, values, max_rows, max_nnz,
+                                    out_rows, out_nnz);
+}
+
+// u32 variant for the native pipeline (see parse_libsvm32).
+int parse_libfm32(const char* data, int64_t len,
+                  float* labels, int64_t* row_nnz,
+                  uint32_t* fields, uint32_t* indices, float* values,
+                  int64_t max_rows, int64_t max_nnz,
+                  int64_t* out_rows, int64_t* out_nnz) {
+  return parse_libfm_impl<uint32_t>(data, len, labels, row_nnz, fields,
+                                    indices, values, max_rows, max_nnz,
+                                    out_rows, out_nnz);
 }
 
 // Parse dense CSV (no quoting — numeric data files): every line becomes

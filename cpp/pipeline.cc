@@ -35,14 +35,17 @@
 
 extern "C" {
 // parse.cc hot loops (same translation unit boundary as the ctypes ABI).
-int parse_libsvm(const char* data, int64_t len, float* labels, float* weights,
-                 int64_t* qids, int64_t* row_nnz, uint64_t* indices,
-                 float* values, int64_t max_rows, int64_t max_nnz,
-                 int64_t* out_rows, int64_t* out_nnz, int* out_flags);
-int parse_libfm(const char* data, int64_t len, float* labels, int64_t* row_nnz,
-                uint64_t* fields, uint64_t* indices, float* values,
-                int64_t max_rows, int64_t max_nnz, int64_t* out_rows,
-                int64_t* out_nnz);
+// The u32-index variants write device-layout indices directly — no
+// narrowing pass over nnz afterwards.
+int parse_libsvm32(const char* data, int64_t len, float* labels,
+                   float* weights, int64_t* qids, int64_t* row_nnz,
+                   uint32_t* indices, float* values, int64_t max_rows,
+                   int64_t max_nnz, int64_t* out_rows, int64_t* out_nnz,
+                   int* out_flags);
+int parse_libfm32(const char* data, int64_t len, float* labels,
+                  int64_t* row_nnz, uint32_t* fields, uint32_t* indices,
+                  float* values, int64_t max_rows, int64_t max_nnz,
+                  int64_t* out_rows, int64_t* out_nnz);
 int parse_csv(const char* data, int64_t len, float* out, int64_t max_rows,
               int64_t expect_cols, int64_t* out_rows, int64_t* out_cols);
 void count_tokens(const char* data, int64_t len, int64_t* out_rows,
@@ -130,17 +133,16 @@ struct Chunk {
 // One parsed CSR batch. Buffers are malloc'd to a generous bound derived
 // from the chunk length (every row and every token is >= 2 bytes, so
 // len/2+2 bounds both) — untouched slack pages are virtual-only, which
-// beats pre-scanning the chunk to size exactly. Indices/fields are written
-// as u64 by the parse then narrowed to u32 in place (forward pass: the
-// write offset never passes the read offset).
+// beats pre-scanning the chunk to size exactly. Indices/fields are u32
+// storage written directly by the 32-bit parse variants.
 struct Block {
   float* labels = nullptr;
   float* weights = nullptr;
   float* values = nullptr;
   int64_t* qids = nullptr;
   int64_t* offsets = nullptr;
-  uint64_t* indices = nullptr;  // u32-packed after NarrowIndices
-  uint64_t* fields = nullptr;   // u32-packed after NarrowIndices
+  uint32_t* indices = nullptr;
+  uint32_t* fields = nullptr;
   int64_t rows = 0, nnz = 0, ncols = 0;
   int flags = 0;
   int64_t seq = 0;
@@ -155,11 +157,6 @@ struct Block {
     std::free(fields);
   }
 };
-
-inline void NarrowU64ToU32(uint64_t* buf, int64_t n) {
-  uint32_t* dst = reinterpret_cast<uint32_t*>(buf);
-  for (int64_t i = 0; i < n; ++i) dst[i] = static_cast<uint32_t>(buf[i]);
-}
 
 template <typename T>
 T* AllocArray(int64_t n) {
@@ -498,7 +495,7 @@ class Pipeline {
       Block* b = sp.block;
       bool has_w = (b->flags & kHasWeight) != 0;
       bool has_v = format_ == kLibfm || (b->flags & kHasValue) != 0;
-      const uint32_t* idx = reinterpret_cast<const uint32_t*>(b->indices);
+      const uint32_t* idx = b->indices;
       int64_t take = std::min<int64_t>(batch_size - out_row, b->rows - sp.row);
       for (int64_t i = 0; i < take; ++i) {
         int64_t r = sp.row + i;
@@ -537,7 +534,7 @@ class Pipeline {
       Block* b = sp.block;
       bool has_w = (b->flags & kHasWeight) != 0;
       bool has_v = format_ == kLibfm || (b->flags & kHasValue) != 0;
-      const uint32_t* idx = reinterpret_cast<const uint32_t*>(b->indices);
+      const uint32_t* idx = b->indices;
       int64_t take = std::min<int64_t>(batch_size - out_row, b->rows - sp.row);
       for (int64_t i = 0; i < take; ++i) {
         int64_t r = sp.row + i;
@@ -612,7 +609,7 @@ class Pipeline {
       Block* b = sp.block;
       bool has_w = (b->flags & kHasWeight) != 0;
       bool has_v = format_ == kLibfm || (b->flags & kHasValue) != 0;
-      const uint32_t* idx = reinterpret_cast<const uint32_t*>(b->indices);
+      const uint32_t* idx = b->indices;
       int64_t take = std::min<int64_t>(batch_size - out_row, b->rows - sp.row);
       for (int64_t i = 0; i < take; ++i) {
         int64_t r = sp.row + i;
@@ -1053,7 +1050,9 @@ class Pipeline {
     int64_t bound = len / 2 + 2;  // rows and nnz are both >= 2 bytes each
     b->labels = AllocArray<float>(bound);
     b->offsets = AllocArray<int64_t>(bound + 1);
-    b->indices = AllocArray<uint64_t>(bound);
+    // u32 storage, filled directly by the 32-bit parse variants (no
+    // narrowing pass); Block::indices stays a u64* holder by type only
+    b->indices = AllocArray<uint32_t>(bound);
     b->values = AllocArray<float>(bound);
     if (b->labels == nullptr || b->offsets == nullptr ||
         b->indices == nullptr || b->values == nullptr) {
@@ -1065,14 +1064,16 @@ class Pipeline {
       b->weights = AllocArray<float>(bound);
       b->qids = AllocArray<int64_t>(bound);
       if (b->weights == nullptr || b->qids == nullptr) return kEOom;
-      rc = parse_libsvm(p, len, b->labels, b->weights, b->qids,
-                        b->offsets + 1, b->indices, b->values, bound, bound,
-                        &rows, &nnz, &b->flags);
+      rc = parse_libsvm32(p, len, b->labels, b->weights, b->qids,
+                          b->offsets + 1,
+                          b->indices, b->values,
+                          bound, bound, &rows, &nnz, &b->flags);
     } else {
-      b->fields = AllocArray<uint64_t>(bound);
+      b->fields = AllocArray<uint32_t>(bound);
       if (b->fields == nullptr) return kEOom;
-      rc = parse_libfm(p, len, b->labels, b->offsets + 1, b->fields,
-                       b->indices, b->values, bound, bound, &rows, &nnz);
+      rc = parse_libfm32(p, len, b->labels, b->offsets + 1,
+                         b->fields, b->indices, b->values,
+                         bound, bound, &rows, &nnz);
     }
     if (rc != kOk) return rc;
     b->rows = rows;
@@ -1080,8 +1081,6 @@ class Pipeline {
     // counts -> offsets prefix sum in place
     b->offsets[0] = 0;
     for (int64_t i = 1; i <= rows; ++i) b->offsets[i] += b->offsets[i - 1];
-    NarrowU64ToU32(b->indices, nnz);
-    if (b->fields != nullptr) NarrowU64ToU32(b->fields, nnz);
     return kOk;
   }
 
@@ -1147,7 +1146,7 @@ class Pipeline {
     }
     b->labels = AllocArray<float>(rows + 1);
     b->offsets = AllocArray<int64_t>(rows + 1);
-    b->indices = reinterpret_cast<uint64_t*>(AllocArray<uint32_t>(nnz + 1));
+    b->indices = AllocArray<uint32_t>(nnz + 1);
     if (b->labels == nullptr || b->offsets == nullptr ||
         b->indices == nullptr) {
       std::free(offsets);
@@ -1163,7 +1162,7 @@ class Pipeline {
       return kEOom;
     }
     // pass 2: memcpy the sections
-    uint32_t* idx_out = reinterpret_cast<uint32_t*>(b->indices);
+    uint32_t* idx_out = b->indices;
     int64_t row_at = 0, nnz_at = 0;
     b->offsets[0] = 0;
     for (int64_t r = 0; r < nrec; ++r) {
@@ -1385,9 +1384,9 @@ void* ingest_fetch_view(void* handle, float** labels, float** weights,
   *weights = b->weights;
   *qids = b->qids;
   *offsets = b->offsets;
-  *indices = reinterpret_cast<uint32_t*>(b->indices);
+  *indices = b->indices;
   *values = b->values;
-  *fields = reinterpret_cast<uint32_t*>(b->fields);
+  *fields = b->fields;
   return b;
 }
 

@@ -118,9 +118,24 @@ def make_ring_attention(
             # started at ring position (idx - step_idx) mod size
             src = (idx - step_idx) % size
             k_pos = src * t_local + jnp.arange(t_local)
-            m, l, o = _block_accumulate(
-                q, k_cur, v_cur, m, l, o, q_pos, k_pos, causal, scale
-            )
+            if causal:
+                # a block entirely in this device's future is fully masked:
+                # skip its einsum/exp work (the rotation still runs — the
+                # ring schedule needs every hop). Divergent across devices
+                # by design; no collectives inside the branches.
+                m, l, o = jax.lax.cond(
+                    src <= idx,
+                    lambda ops: _block_accumulate(
+                        q, ops[0], ops[1], ops[2], ops[3], ops[4],
+                        q_pos, k_pos, causal, scale,
+                    ),
+                    lambda ops: (ops[2], ops[3], ops[4]),
+                    (k_cur, v_cur, m, l, o),
+                )
+            else:
+                m, l, o = _block_accumulate(
+                    q, k_cur, v_cur, m, l, o, q_pos, k_pos, causal, scale
+                )
             return (k_cur, v_cur, m, l, o), None
 
         (k, v, m, l, o), _ = jax.lax.scan(
