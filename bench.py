@@ -181,8 +181,25 @@ def _bench_device_feed(path: str) -> dict:
     # measured on the 1-core driver box, nthread=1 beats 2 by ~1.5x here
     nthread = 1 if (os.cpu_count() or 1) <= 2 else 2
 
-    def _feed():
-        return DeviceFeed(create_parser(path, 0, 1, nthread=nthread), spec)
+    def _feed(feed_spec=spec):
+        return DeviceFeed(
+            create_parser(path, 0, 1, nthread=nthread), feed_spec
+        )
+
+    def _timed_sgd_epochs(feed_spec, step_fn, layout, params, velocity):
+        """TRIALS+1 timed epochs (first = warmup) through one jitted step."""
+        runs = []
+        for _ in range(TRIALS + 1):
+            feed = _feed(feed_spec)
+            t0 = time.time()
+            for batch in feed:
+                params, velocity, _m = step_fn(
+                    params, velocity, step_batch(batch, layout)
+                )
+            jax.block_until_ready(params)
+            runs.append(round(size_mb / (time.time() - t0), 1))
+            feed.close()
+        return runs
 
     feed_runs = []
     stage_samples = {"host_batch_ns": [], "dispatch_ns": [],
@@ -209,17 +226,19 @@ def _bench_device_feed(path: str) -> dict:
     velocity = {"w": jnp.zeros_like(params["w"]),
                 "b": jnp.zeros_like(params["b"])}
     step = make_linear_train_step(None, learning_rate=0.1, layout="dense")
-    sgd_runs = []
-    for _ in range(TRIALS + 1):
-        feed = _feed()
-        t0 = time.time()
-        for batch in feed:
-            params, velocity, _m = step(
-                params, velocity, step_batch(batch, "dense")
-            )
-        jax.block_until_ready(params)
-        sgd_runs.append(round(size_mb / (time.time() - t0), 1))
-        feed.close()
+    sgd_runs = _timed_sgd_epochs(spec, step, "dense", params, velocity)
+
+    # sparse path e2e: csr layout (native COO staging) through the csr
+    # train step — the genuinely-sparse Criteo-class shape
+    cparams = init_linear_params(29)
+    cvel = {"w": jnp.zeros_like(cparams["w"]),
+            "b": jnp.zeros_like(cparams["b"])}
+    csr_step = make_linear_train_step(
+        None, learning_rate=0.1, layout="csr", num_features=29
+    )
+    csr_spec = BatchSpec(batch_size=16384, layout="csr", num_features=29,
+                         nnz_bucket=1 << 19)
+    csr_runs = _timed_sgd_epochs(csr_spec, csr_step, "csr", cparams, cvel)
 
     out = {
         "feed_dense_mbps": round(statistics.median(feed_runs[1:]), 1),
@@ -227,6 +246,8 @@ def _bench_device_feed(path: str) -> dict:
         "feed_stages": feed_stages,
         "sgd_e2e_mbps": round(statistics.median(sgd_runs[1:]), 1),
         "sgd_e2e_trials_mbps": sgd_runs[1:],
+        "sgd_csr_e2e_mbps": round(statistics.median(csr_runs[1:]), 1),
+        "sgd_csr_e2e_trials_mbps": csr_runs[1:],
         "device": str(jax.devices()[0].platform),
     }
     # Sharded sparse H2D accounting (one batch, host-side): per-device

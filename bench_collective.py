@@ -103,6 +103,12 @@ def socket_allreduce_metrics(
                 p.terminate()
         tracker.close()
     out["socket_world"] = world
+    # honesty marker: `world` processes + tracker share this host's CPUs,
+    # so loopback figures are contention floors, not network bandwidth
+    out["socket_note"] = (
+        f"loopback, {world} procs on {os.cpu_count() or 1} cpu(s): "
+        "contention floor"
+    )
     return out
 
 
