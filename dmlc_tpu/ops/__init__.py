@@ -15,6 +15,7 @@ from dmlc_tpu.ops.spmv import (
 )
 from dmlc_tpu.ops.sequence_parallel import (
     full_attention,
+    make_pallas_flash_local,
     make_ring_attention,
     make_ulysses_attention,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "spmv_transpose",
     "make_sharded_spmv",
     "full_attention",
+    "make_pallas_flash_local",
     "make_ring_attention",
     "make_ulysses_attention",
 ]
