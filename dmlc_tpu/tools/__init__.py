@@ -38,6 +38,7 @@ _COMMANDS = {
     "stream_read": "dmlc_tpu.tools.stream_read",
     "dataiter": "dmlc_tpu.tools.dataiter",
     "strtonum": "dmlc_tpu.tools.strtonum",
+    "rowrec": "dmlc_tpu.tools.rowrec",
 }
 
 

@@ -6,7 +6,7 @@ count, MB/s), ``test/libfm_parser_test.cc``, ``test/csv_parser_test.cc``.
 Usage::
 
     python -m dmlc_tpu.tools parse <uri> [part] [nparts] \
-        [--format auto|libsvm|libfm|csv] [--nthread N]
+        [--format auto|libsvm|libfm|csv|recordio] [--nthread N]
 """
 
 from __future__ import annotations
@@ -25,7 +25,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("part", type=int, nargs="?", default=0)
     ap.add_argument("nparts", type=int, nargs="?", default=1)
     ap.add_argument("--format", default="auto",
-                    choices=["auto", "libsvm", "libfm", "csv"])
+                    choices=["auto", "libsvm", "libfm", "csv", "recordio"])
     ap.add_argument("--nthread", type=int, default=2)
     args = ap.parse_args(argv)
 
