@@ -52,6 +52,9 @@ def submit(args) -> None:
         args.num_servers,
         fun_submit,
         host_ip=args.host_ip or "auto",
+        # threads own the worker processes: once they are all done while the
+        # tracker still waits, the job can never finish — fail fast.
+        tasks_alive=lambda: any(t.is_alive() for t in threads),
     )
     for t in threads:
         t.join()

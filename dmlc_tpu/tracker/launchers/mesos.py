@@ -126,6 +126,7 @@ def submit(args, runner: Optional[Callable[[Dict], None]] = None) -> None:
         submit_with_tracker(
             args.num_workers, args.num_servers, fun_submit,
             host_ip=args.host_ip or "auto",
+            tasks_alive=lambda: any(t.is_alive() for t in threads),
         )
         for t in threads:
             t.join()
@@ -144,7 +145,9 @@ def submit(args, runner: Optional[Callable[[Dict], None]] = None) -> None:
         for t in threads:
             t.join()
         if not errors:
-            tracker.join()
+            # all task threads are done here; if rendezvous never completed
+            # the join aborts instead of hanging (RabitTracker.join)
+            tracker.join(tasks_alive=lambda: any(t.is_alive() for t in threads))
     if errors:
         name, err = errors[0]
         raise RuntimeError(

@@ -95,6 +95,9 @@ def submit(args) -> None:
     submit_with_tracker(
         args.num_workers, args.num_servers, fun_submit,
         host_ip=args.host_ip or "auto",
+        # every ssh session exiting while rendezvous is incomplete means the
+        # job can never start — abort instead of hanging (rendezvous.join)
+        tasks_alive=lambda: any(t.is_alive() for t in threads),
     )
     for t in threads:
         t.join()

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from dmlc_tpu.utils.logging import DMLCError
+
 MAGIC = 0xFF99
 
 logger = logging.getLogger("dmlc_tpu.tracker")
@@ -371,9 +373,47 @@ class RabitTracker:
         )
         self.thread.start()
 
-    def join(self) -> None:
+    def join(self, tasks_alive: Optional[Callable[[], bool]] = None,
+             grace_s: float = 5.0) -> None:
+        """Wait for the job to finish.
+
+        ``tasks_alive`` (from the launcher) reports whether any worker
+        process is still running. The reference tracker blocks forever if
+        workers die before rendezvous (tracker.py:329-331 joins
+        unconditionally); here, once every launcher task has exited while
+        the accept loop is still waiting, the job can never complete — fail
+        fast with a diagnostic instead of hanging.
+        """
+        deadline = None
+        warned = False
         while self.thread is not None and self.thread.is_alive():
             self.thread.join(0.1)
+            if tasks_alive is None or tasks_alive():
+                deadline = None
+                continue
+            now = time.time()
+            if deadline is None:
+                deadline = now + grace_s  # let in-flight shutdowns drain
+            elif now > deadline:
+                if self.start_time is None:
+                    # Rendezvous never completed: the job cannot make
+                    # progress, abort.
+                    raise DMLCError(
+                        "all worker processes exited but the tracker is "
+                        "still waiting for rendezvous — workers likely "
+                        "crashed before connecting (check their logs)"
+                    )
+                # The job DID start; the launched commands may have
+                # detached (wrapper scripts, nohup) with real workers
+                # still connected — warn once and keep waiting, matching
+                # the reference's unconditional join (tracker.py:329-331).
+                if not warned:
+                    logger.warning(
+                        "launcher tasks exited but the job started and has "
+                        "not sent all shutdowns; assuming detached workers "
+                        "and waiting"
+                    )
+                    warned = True
 
     def alive(self) -> bool:
         return self.thread is not None and self.thread.is_alive()
@@ -442,9 +482,12 @@ def submit_with_tracker(
     fun_submit: Callable[[int, int, Dict[str, object]], None],
     host_ip: str = "auto",
     pscmd: Optional[str] = None,
+    tasks_alive: Optional[Callable[[], bool]] = None,
 ) -> None:
     """Start a tracker, hand env vars to the launcher callback, join
-    (tracker.py:410-433)."""
+    (tracker.py:410-433). ``tasks_alive`` lets process-owning launchers
+    (local) report worker liveness so a pre-rendezvous crash aborts the
+    job instead of hanging the tracker forever."""
     envs: Dict[str, object] = {
         "DMLC_NUM_WORKER": nworker,
         "DMLC_NUM_SERVER": nserver,
@@ -456,7 +499,7 @@ def submit_with_tracker(
         tracker.start(nworker)
         if tracker.alive():
             fun_submit(nworker, nserver, envs)
-        tracker.join()
+        tracker.join(tasks_alive=tasks_alive)
     else:
         ps = PSTracker(host_ip=ip, cmd=pscmd, envs=envs)
         envs.update(ps.worker_envs())

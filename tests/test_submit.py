@@ -269,6 +269,26 @@ class TestLocalEndToEnd:
         assert proc.returncode == 0, proc.stderr
         assert "all 3 workers started" in proc.stderr + proc.stdout
 
+    def test_workers_crash_before_rendezvous_fails_fast(self, tmp_path):
+        """All workers dying pre-rendezvous must ABORT the job, not hang.
+
+        The reference tracker joins unconditionally (tracker.py:329-331) and
+        hangs forever in this scenario; our local launcher reports task
+        liveness to RabitTracker.join, which raises once every worker
+        process has exited while the accept loop is still waiting.
+        """
+        script = tmp_path / "crash.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "dmlc-submit"),
+             "--cluster", "local", "-n", "2", "--host-ip", "127.0.0.1",
+             sys.executable, str(script)],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode != 0
+        assert "tracker is still waiting" in proc.stderr
+
     def test_local_launcher_retry(self, tmp_path):
         """A task failing on attempt 0 succeeds on retry (local.py:25-44).
 
