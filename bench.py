@@ -302,9 +302,15 @@ def _bench_device_feed(path: str) -> dict:
                 sharded = parser.read_batch_coo_sharded(16384, 8)
                 out["csr_batch_nnz"] = sharded.num_nonzero
                 out["csr_nnz_per_device_8shard"] = sharded.nnz_bucket
-                out["csr_h2d_bytes_per_device"] = sharded.nnz_bucket * 12
+                # shipped per entry: indices + values (8 B); the row
+                # mapping crosses H2D as per-shard CSR offsets (4 B/row),
+                # not per-entry row_ids (device/feed._put_csr)
+                rows_local = 16384 // 8
+                out["csr_h2d_bytes_per_device"] = (
+                    sharded.nnz_bucket * 8 + (rows_local + 1) * 4
+                )
                 out["csr_h2d_bytes_per_device_replicated"] = (
-                    sharded.num_nonzero * 12
+                    sharded.num_nonzero * 8 + (16384 + 1) * 4
                 )
         finally:
             parser.close()

@@ -441,6 +441,6 @@ void count_tokens(const char* data, int64_t len,
   *out_tokens = tokens;
 }
 
-int dmlc_tpu_abi_version() { return 3; }
+int dmlc_tpu_abi_version() { return 4; }
 
 }  // extern "C"

@@ -516,19 +516,24 @@ class Pipeline {
   }
 
   // Fill a padded COO batch (labels/weights [batch_size]; indices/values/
-  // row_ids [nnz_bucket]) from the staged rows, consuming them. Padded
-  // entries are (row 0, feature 0, value 0) — arithmetic no-ops for
-  // segment-sum SpMV. Fails with kEOverflow (consuming nothing) when the
-  // batch's nnz exceeds nnz_bucket. Returns rows consumed, or <0.
+  // row_ids [nnz_bucket]; offsets [batch_size + 1] CSR) from the staged
+  // rows, consuming them. Padded entries are (row 0, feature 0, value 0) —
+  // arithmetic no-ops for segment-sum SpMV; padded rows' offsets repeat the
+  // valid nnz. The feed ships the small offsets array instead of the
+  // per-entry row_ids (H2D ∝ rows, not nnz) and expands row ids on device;
+  // row_ids stays filled for host-side consumers. Fails with kEOverflow
+  // (consuming nothing) when the batch's nnz exceeds nnz_bucket. Returns
+  // rows consumed, or <0.
   int64_t FetchBatchCoo(float* labels, float* weights, int32_t* indices,
-                        float* values, int32_t* row_ids, int64_t batch_size,
-                        int64_t nnz_bucket) {
+                        float* values, int32_t* row_ids, int32_t* offsets,
+                        int64_t batch_size, int64_t nnz_bucket) {
     if (format_ == kCsv) return kEIo;
     int64_t rows = std::min<int64_t>(batch_size, staged_rows_);
     if (NnzOfFirst(rows) > nnz_bucket) return kEOverflow;
     std::memset(labels, 0, static_cast<size_t>(batch_size) * 4);
     std::memset(weights, 0, static_cast<size_t>(batch_size) * 4);
     int64_t out_row = 0, out_k = 0;
+    offsets[0] = 0;
     while (out_row < batch_size && !staged_.empty()) {
       Span& sp = staged_.front();
       Block* b = sp.block;
@@ -547,8 +552,12 @@ class Pipeline {
           ++out_k;
         }
         ++out_row;
+        offsets[out_row] = static_cast<int32_t>(out_k);
       }
       ConsumeSpan(take);
+    }
+    for (int64_t r = out_row + 1; r <= batch_size; ++r) {
+      offsets[r] = static_cast<int32_t>(out_k);
     }
     for (int64_t k = out_k; k < nnz_bucket; ++k) {
       indices[k] = 0;
@@ -593,14 +602,20 @@ class Pipeline {
   // (consuming nothing) when any shard's nnz exceeds nnz_bucket.
   int64_t FetchBatchCooSharded(float* labels, float* weights,
                                int32_t* indices, float* values,
-                               int32_t* row_ids, int64_t batch_size,
-                               int64_t num_shards, int64_t nnz_bucket) {
+                               int32_t* row_ids, int32_t* offsets,
+                               int64_t batch_size, int64_t num_shards,
+                               int64_t nnz_bucket) {
     if (format_ == kCsv) return kEIo;
     if (num_shards <= 0 || batch_size % num_shards != 0) return kEIo;
     if (StagedMaxShardNnz(batch_size, num_shards) > nnz_bucket) {
       return kEOverflow;
     }
     int64_t rows_per_shard = batch_size / num_shards;
+    // offsets: flat [num_shards * (rows_per_shard + 1)] — per-shard LOCAL
+    // CSR offsets into that shard's entry section; the feed ships these
+    // instead of per-entry row_ids and expands on device.
+    std::memset(offsets, 0,
+                static_cast<size_t>(num_shards * (rows_per_shard + 1)) * 4);
     std::vector<int64_t> filled(static_cast<size_t>(num_shards), 0);
     int64_t out_row = 0;
     int64_t cur = 0;  // entry cursor within the current shard's section
@@ -625,6 +640,8 @@ class Pipeline {
           ++cur;
         }
         ++out_row;
+        offsets[shard * (rows_per_shard + 1) + local_row + 1] =
+            static_cast<int32_t>(cur);
         if (out_row % rows_per_shard == 0) {
           filled[static_cast<size_t>(shard)] = cur;
           cur = 0;  // next shard section
@@ -634,6 +651,16 @@ class Pipeline {
     }
     if (out_row > 0 && out_row % rows_per_shard != 0) {
       filled[static_cast<size_t>(out_row / rows_per_shard)] = cur;
+    }
+    // forward-fill each shard's offset tail (rows past the stream's end
+    // repeat the shard's final nnz; untouched shards stay all-zero)
+    for (int64_t s = 0; s < num_shards; ++s) {
+      int32_t* off = offsets + s * (rows_per_shard + 1);
+      int32_t run = 0;
+      for (int64_t r = 1; r <= rows_per_shard; ++r) {
+        run = std::max(run, off[r]);
+        off[r] = run;
+      }
     }
     // zero only the padding: row tail + each shard section's unfilled tail
     // (a full up-front memset would write most of the hot-path bytes twice)
@@ -1414,15 +1441,17 @@ int64_t ingest_fetch_batch_dense(void* handle, float* x, float* labels,
 }
 
 // Consume the staged rows into a padded COO batch: labels/weights
-// [batch_size], indices/values/row_ids [nnz_bucket] (padding = arithmetic
-// no-ops for segment-sum). Fails with -1 (consuming nothing) when the
-// batch nnz exceeds nnz_bucket. Returns rows consumed, or <0 on error.
+// [batch_size], indices/values/row_ids [nnz_bucket], offsets
+// [batch_size + 1] CSR (padding = arithmetic no-ops for segment-sum).
+// Fails with -1 (consuming nothing) when the batch nnz exceeds
+// nnz_bucket. Returns rows consumed, or <0 on error.
 int64_t ingest_fetch_batch_coo(void* handle, float* labels, float* weights,
                                int32_t* indices, float* values,
-                               int32_t* row_ids, int64_t batch_size,
-                               int64_t nnz_bucket) {
+                               int32_t* row_ids, int32_t* offsets,
+                               int64_t batch_size, int64_t nnz_bucket) {
   return static_cast<Pipeline*>(handle)->FetchBatchCoo(
-      labels, weights, indices, values, row_ids, batch_size, nnz_bucket);
+      labels, weights, indices, values, row_ids, offsets, batch_size,
+      nnz_bucket);
 }
 
 // Max per-shard nnz of the staged batch under a num_shards row-range
@@ -1435,17 +1464,19 @@ int64_t ingest_staged_max_shard_nnz(void* handle, int64_t batch_size,
 
 // Consume the staged rows into a mesh-sharded COO batch: labels/weights
 // [batch_size]; indices/values/row_ids flat [num_shards * nnz_bucket] with
-// per-shard sections and LOCAL row ids (shard = row / (batch/num_shards)).
+// per-shard sections and LOCAL row ids (shard = row / (batch/num_shards));
+// offsets flat [num_shards * (batch/num_shards + 1)] per-shard LOCAL CSR.
 // Fails with -1 (consuming nothing) when any shard overflows nnz_bucket.
 int64_t ingest_fetch_batch_coo_sharded(void* handle, float* labels,
                                        float* weights, int32_t* indices,
                                        float* values, int32_t* row_ids,
+                                       int32_t* offsets,
                                        int64_t batch_size,
                                        int64_t num_shards,
                                        int64_t nnz_bucket) {
   return static_cast<Pipeline*>(handle)->FetchBatchCooSharded(
-      labels, weights, indices, values, row_ids, batch_size, num_shards,
-      nnz_bucket);
+      labels, weights, indices, values, row_ids, offsets, batch_size,
+      num_shards, nnz_bucket);
 }
 
 // Per-stage counters: out[0]=bytes_read, [1]=chunks, [2]=reader_io_ns,

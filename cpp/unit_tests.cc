@@ -56,13 +56,14 @@ int64_t ingest_fetch_batch_dense(void* handle, float* x, float* labels,
                                  int64_t num_features);
 int64_t ingest_fetch_batch_coo(void* handle, float* labels, float* weights,
                                int32_t* indices, float* values,
-                               int32_t* row_ids, int64_t batch_size,
-                               int64_t nnz_bucket);
+                               int32_t* row_ids, int32_t* offsets,
+                               int64_t batch_size, int64_t nnz_bucket);
 int64_t ingest_staged_max_shard_nnz(void* handle, int64_t batch_size,
                                     int64_t num_shards);
 int64_t ingest_fetch_batch_coo_sharded(void* handle, float* labels,
                                        float* weights, int32_t* indices,
                                        float* values, int32_t* row_ids,
+                                       int32_t* offsets,
                                        int64_t batch_size,
                                        int64_t num_shards,
                                        int64_t nnz_bucket);
@@ -476,16 +477,23 @@ void test_pipeline_batch_staging() {
   int64_t rows, nnz;
   CHECK_TRUE(ingest_stage_batch(h, 100, &rows, &nnz) == 1);
   CHECK_TRUE(rows == 100 && nnz == 200);
-  std::vector<int32_t> idx(256), row_ids(256);
+  std::vector<int32_t> idx(256), row_ids(256), offs(101);
   std::vector<float> vals(256);
   // bucket too small: fails without consuming
   CHECK_TRUE(ingest_fetch_batch_coo(h, labels.data(), weights.data(),
                                     idx.data(), vals.data(), row_ids.data(),
-                                    100, 100) < 0);
+                                    offs.data(), 100, 100) < 0);
   CHECK_TRUE(ingest_fetch_batch_coo(h, labels.data(), weights.data(),
                                     idx.data(), vals.data(), row_ids.data(),
-                                    100, 256) == 100);
+                                    offs.data(), 100, 256) == 100);
   CHECK_TRUE(idx[0] == 1 && idx[1] == 3 && row_ids[2] == 1);
+  // CSR offsets mirror row_ids: offsets[r] <= e < offsets[r+1] iff
+  // row_ids[e] == r; final offset = valid nnz
+  CHECK_TRUE(offs[0] == 0 && offs[100] == 200);
+  for (int e = 0; e < 200; ++e) {
+    int r = row_ids[e];
+    CHECK_TRUE(offs[r] <= e && e < offs[r + 1]);
+  }
   for (int k = 200; k < 256; ++k) CHECK_TRUE(vals[k] == 0.0f);
   CHECK_TRUE(ingest_stage_batch(h, 4096, &rows, &nnz) == 1);  // stage rest
   ingest_close(h);  // staged blocks must be freed (ASan tier checks)
@@ -532,16 +540,29 @@ void test_batch_coo_sharded() {
     std::vector<int32_t> idx(kShards * (max_shard - 1));
     std::vector<float> vals(kShards * (max_shard - 1));
     std::vector<int32_t> rid(kShards * (max_shard - 1));
+    std::vector<int32_t> off(kShards * (kRowsPer + 1));
     CHECK_TRUE(ingest_fetch_batch_coo_sharded(
                    h, labels.data(), weights.data(), idx.data(), vals.data(),
-                   rid.data(), kRows, kShards, max_shard - 1) < 0);
+                   rid.data(), off.data(), kRows, kShards,
+                   max_shard - 1) < 0);
   }
   int64_t bucket = max_shard;
   std::vector<int32_t> idx(kShards * bucket), rid(kShards * bucket);
+  std::vector<int32_t> offs(kShards * (kRowsPer + 1));
   std::vector<float> vals(kShards * bucket);
   CHECK_TRUE(ingest_fetch_batch_coo_sharded(
                  h, labels.data(), weights.data(), idx.data(), vals.data(),
-                 rid.data(), kRows, kShards, bucket) == kRows);
+                 rid.data(), offs.data(), kRows, kShards, bucket) == kRows);
+  // per-shard local offsets mirror the local row ids
+  for (int64_t s = 0; s < kShards; ++s) {
+    const int32_t* off = offs.data() + s * (kRowsPer + 1);
+    CHECK_TRUE(off[0] == 0);
+    for (int64_t e = 0; e < bucket; ++e) {
+      if (vals[s * bucket + e] == 0.0f) continue;  // padding
+      int32_t r = rid[s * bucket + e];
+      CHECK_TRUE(off[r] <= e && e < off[r + 1]);
+    }
+  }
   // verify: every entry's value row matches its shard section + local id
   int64_t seen = 0;
   for (int64_t s = 0; s < kShards; ++s) {

@@ -667,12 +667,12 @@ class NativePipelineParser:
             nnz_bucket if nnz_bucket is not None
             else round_up_bucket(nnz, nnz_floor)
         )
-        labels, weights, indices, values, row_ids, rows = (
+        labels, weights, indices, values, row_ids, offsets, rows = (
             self._pipe.fetch_batch_coo(batch_size, bucket)
         )
         return DeviceCSRBatch(
             labels=labels, weights=weights, indices=indices, values=values,
-            row_ids=row_ids, num_rows=rows, num_nonzero=nnz,
+            row_ids=row_ids, offsets=offsets, num_rows=rows, num_nonzero=nnz,
         )
 
     def read_batch_coo_sharded(
@@ -698,12 +698,12 @@ class NativePipelineParser:
                 nnz_floor,
             )
         )
-        labels, weights, indices, values, row_ids, rows = (
+        labels, weights, indices, values, row_ids, offsets, rows = (
             self._pipe.fetch_batch_coo_sharded(batch_size, num_shards, bucket)
         )
         return ShardedCSRBatch(
             labels=labels, weights=weights, indices=indices, values=values,
-            row_ids=row_ids, num_rows=rows, num_nonzero=nnz,
+            row_ids=row_ids, offsets=offsets, num_rows=rows, num_nonzero=nnz,
             num_shards=num_shards, nnz_bucket=bucket,
         )
 

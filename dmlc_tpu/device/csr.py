@@ -46,6 +46,9 @@ class DeviceCSRBatch:
     indices: np.ndarray  # [nnz_bucket] i32 feature ids
     values: np.ndarray  # [nnz_bucket] f32 (0.0 for padded entries)
     row_ids: np.ndarray  # [nnz_bucket] i32 row of each entry
+    offsets: np.ndarray  # [batch + 1] i32 CSR twin of row_ids (shipped to
+    # device instead of row_ids: H2D ∝ rows, not nnz; padded rows repeat
+    # the valid nnz)
     num_rows: int  # valid rows
     num_nonzero: int  # valid entries
 
@@ -86,12 +89,15 @@ def pad_to_bucket(
     row_ids[:nnz] = np.repeat(
         np.arange(n, dtype=np.int32), np.diff(block.offset).astype(np.int64)
     )
+    offsets = np.full(batch_size + 1, nnz, dtype=np.int32)
+    offsets[: n + 1] = np.asarray(block.offset[: n + 1], dtype=np.int32)
     return DeviceCSRBatch(
         labels=labels,
         weights=weights,
         indices=indices,
         values=values,
         row_ids=row_ids,
+        offsets=offsets,
         num_rows=n,
         num_nonzero=nnz,
     )
@@ -114,6 +120,9 @@ class ShardedCSRBatch:
     indices: np.ndarray  # [num_shards * nnz_bucket] i32
     values: np.ndarray  # [num_shards * nnz_bucket] f32
     row_ids: np.ndarray  # [num_shards * nnz_bucket] i32, LOCAL per shard
+    offsets: np.ndarray  # [num_shards * (rows_per_shard + 1)] i32 per-shard
+    # LOCAL CSR offsets into the shard's entry section (shipped instead of
+    # row_ids)
     num_rows: int
     num_nonzero: int
     num_shards: int
@@ -167,6 +176,7 @@ def pad_to_bucket_sharded(
     indices = np.zeros(num_shards * bucket, dtype=np.int32)
     values = np.zeros(num_shards * bucket, dtype=np.float32)
     row_ids = np.zeros(num_shards * bucket, dtype=np.int32)
+    offsets = np.zeros(num_shards * (rows_per_shard + 1), dtype=np.int32)
     # entries arrive row-major, so each shard's entries are contiguous
     start = 0
     for s in range(num_shards):
@@ -175,7 +185,13 @@ def pad_to_bucket_sharded(
         out = slice(s * bucket, s * bucket + c)
         indices[out] = block.index[seg]
         values[out] = vals[seg]
-        row_ids[out] = rows[seg] - s * rows_per_shard
+        local = rows[seg] - s * rows_per_shard
+        row_ids[out] = local
+        # local CSR offsets for this shard's section (padded rows repeat c)
+        obase = s * (rows_per_shard + 1)
+        offsets[obase: obase + rows_per_shard + 1] = np.searchsorted(
+            local, np.arange(rows_per_shard + 1), side="left"
+        ).astype(np.int32)
         start += c
     return ShardedCSRBatch(
         labels=labels,
@@ -183,6 +199,7 @@ def pad_to_bucket_sharded(
         indices=indices,
         values=values,
         row_ids=row_ids,
+        offsets=offsets,
         num_rows=n,
         num_nonzero=block.num_nonzero,
         num_shards=num_shards,

@@ -11,7 +11,8 @@ previous step's compute.
 Batch layouts:
 - "dense": [batch, num_features] f32 + labels/weights — the MXU-friendly
   layout for small dense feature spaces (HIGGS, Criteo-dense)
-- "csr": DeviceCSRBatch arrays (COO row_ids for segment-sum SpMV) with nnz
+- "csr": DeviceCSRBatch arrays (CSR offsets shipped; row ids expanded on
+  device for segment-sum SpMV) with nnz
   bucketing — for genuinely sparse data (see dmlc_tpu.ops.spmv)
 """
 
@@ -226,10 +227,13 @@ class DeviceFeed:
         raise ValueError(f"unknown layout {spec.layout!r}")
 
     def _put_csr(self, batch):
-        # ShardedCSRBatch: per-shard entry sections with local row ids —
-        # P(axis) on the flat entry arrays ships each device only its own
-        # nnz (H2D ∝ global_nnz / world). DeviceCSRBatch (no mesh /
-        # single shard): entries replicated, global row ids.
+        # ShardedCSRBatch: per-shard entry sections — P(axis) on the flat
+        # entry arrays ships each device only its own nnz (H2D ∝
+        # global_nnz / world). DeviceCSRBatch (no mesh / single shard):
+        # entries replicated. Either way the row mapping crosses H2D as
+        # the small CSR ``offsets`` array (∝ rows), NOT the per-entry
+        # ``row_ids`` (∝ nnz); the train step expands row ids on device
+        # (ops.spmv.expand_row_ids) where the cumsum is effectively free.
         sharded = isinstance(batch, ShardedCSRBatch)
         entry_spec = P(self._axis) if sharded else P()
         out = self._put_tree(
@@ -238,14 +242,14 @@ class DeviceFeed:
                 "weight": batch.weights,
                 "indices": batch.indices,
                 "values": batch.values,
-                "row_ids": batch.row_ids,
+                "offsets": batch.offsets,
             },
             {
                 "label": P(self._axis),
                 "weight": P(self._axis),
                 "indices": entry_spec,
                 "values": entry_spec,
-                "row_ids": entry_spec,
+                "offsets": entry_spec if sharded else P(),
             },
         )
         out["num_rows"] = batch.num_rows

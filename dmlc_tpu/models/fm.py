@@ -19,7 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_tpu.models.linear import _margin_grad, step_batch
-from dmlc_tpu.ops.spmv import spmv, spmv_transpose
+from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import check
 
@@ -51,7 +51,8 @@ def _fm_forward_grads(params, batch, objective: str, num_features: int):
     weight = batch["weight"]
     values = batch["values"]
     indices = batch["indices"]
-    row_ids = batch["row_ids"]
+    # offsets → row ids on device (local per shard under shard_map)
+    row_ids = expand_row_ids(batch["offsets"], values.shape[0])
     num_rows = label.shape[0]
 
     v_e = jnp.take(params["v"], indices, axis=0)  # [nnz, K]
@@ -111,7 +112,7 @@ def make_fm_train_step(
         "weight": P(axis),
         "indices": P(axis),
         "values": P(axis),
-        "row_ids": P(axis),
+        "offsets": P(axis),
     }
 
     def _sharded(params, batch):
@@ -181,12 +182,13 @@ class FMLearner:
 
     def predict_batch(self, batch) -> np.ndarray:
         num_rows = int(batch["label"].shape[0])
+        row_ids = expand_row_ids(batch["offsets"], batch["values"].shape[0])
         v_e = jnp.take(self.params["v"], batch["indices"], axis=0)
         xv = batch["values"][:, None] * v_e
-        s = jax.ops.segment_sum(xv, batch["row_ids"], num_segments=num_rows)
-        q = jax.ops.segment_sum(xv * xv, batch["row_ids"], num_segments=num_rows)
+        s = jax.ops.segment_sum(xv, row_ids, num_segments=num_rows)
+        q = jax.ops.segment_sum(xv * xv, row_ids, num_segments=num_rows)
         linear = spmv(
-            batch["values"], batch["indices"], batch["row_ids"],
+            batch["values"], batch["indices"], row_ids,
             self.params["w"], num_rows,
         )
         return np.asarray(

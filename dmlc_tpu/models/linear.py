@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.ops.objectives import margin_loss_grad
-from dmlc_tpu.ops.spmv import spmv, spmv_transpose
+from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import DMLCError, check
 
@@ -45,7 +45,7 @@ class LinearModelParam(Parameter):
 
 
 _DENSE_KEYS = ("x", "label", "weight")
-_CSR_KEYS = ("label", "weight", "indices", "values", "row_ids")
+_CSR_KEYS = ("label", "weight", "indices", "values", "offsets")
 
 
 def step_batch(batch: Dict, layout: str) -> Dict:
@@ -136,11 +136,18 @@ def make_linear_train_step(
         if layout == "dense":
             margin = batch["x"] @ params["w"] + params["b"]
         else:
+            # the batch carries CSR offsets (small H2D payload); expand to
+            # per-entry row ids here, on device. Under the mesh shard_map
+            # the shapes are per-shard local, so the same expansion yields
+            # local row ids from the shard's local offsets.
+            row_ids = expand_row_ids(
+                batch["offsets"], batch["values"].shape[0]
+            )
             margin = (
                 spmv(
                     batch["values"],
                     batch["indices"],
-                    batch["row_ids"],
+                    row_ids,
                     params["w"],
                     label.shape[0],
                 )
@@ -152,7 +159,7 @@ def make_linear_train_step(
             gw = batch["x"].T @ wg
         else:
             gw = spmv_transpose(
-                batch["values"], batch["indices"], batch["row_ids"], wg,
+                batch["values"], batch["indices"], row_ids, wg,
                 num_features,
             )
         gb = jnp.sum(wg)
@@ -203,7 +210,7 @@ def make_linear_train_step(
             "weight": P(axis),
             "indices": P(axis),
             "values": P(axis),
-            "row_ids": P(axis),
+            "offsets": P(axis),
         }
 
     def _sharded(params, velocity, batch):

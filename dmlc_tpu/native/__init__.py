@@ -135,7 +135,7 @@ def _bind(lib) -> None:
     lib.ingest_fetch_batch_coo.restype = i64
     lib.ingest_fetch_batch_coo.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_void_p, ctypes.c_void_p, i64, i64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, i64, i64,
     ]
     lib.ingest_stats.restype = None
     lib.ingest_stats.argtypes = [
@@ -146,7 +146,7 @@ def _bind(lib) -> None:
     lib.ingest_fetch_batch_coo_sharded.restype = i64
     lib.ingest_fetch_batch_coo_sharded.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64,
     ]
     lib.ingest_bytes_read.restype = i64
     lib.ingest_bytes_read.argtypes = [ctypes.c_void_p]
@@ -199,7 +199,7 @@ def _load(path: str):
         _bind(lib)
     except (OSError, AttributeError):
         return None
-    if lib.dmlc_tpu_abi_version() != 3:
+    if lib.dmlc_tpu_abi_version() != 4:
         raise DMLCError(f"native ABI mismatch in {path}")
     return lib
 
@@ -637,19 +637,23 @@ class IngestPipeline:
 
     def fetch_batch_coo(self, batch_size: int, nnz_bucket: int):
         """Consume the staged batch as padded COO; → (labels, weights,
-        indices, values, row_ids, rows)."""
+        indices, values, row_ids, offsets, rows). offsets is the
+        [batch_size + 1] CSR twin of row_ids — the feed ships it instead
+        of the per-entry row array (H2D ∝ rows, not nnz)."""
         labels = np.empty(batch_size, dtype=np.float32)
         weights = np.empty(batch_size, dtype=np.float32)
         indices = np.empty(nnz_bucket, dtype=np.int32)
         values = np.empty(nnz_bucket, dtype=np.float32)
         row_ids = np.empty(nnz_bucket, dtype=np.int32)
+        offsets = np.empty(batch_size + 1, dtype=np.int32)
         rows = self._lib.ingest_fetch_batch_coo(
             self._handle, _ptr(labels), _ptr(weights), _ptr(indices),
-            _ptr(values), _ptr(row_ids), batch_size, nnz_bucket,
+            _ptr(values), _ptr(row_ids), _ptr(offsets), batch_size,
+            nnz_bucket,
         )
         if rows < 0:
             raise DMLCError(f"native coo batch fetch failed rc={rows}")
-        return labels, weights, indices, values, row_ids, int(rows)
+        return labels, weights, indices, values, row_ids, offsets, int(rows)
 
     def staged_max_shard_nnz(self, batch_size: int, num_shards: int) -> int:
         """Max per-shard nnz of the staged batch under a row-range split."""
@@ -664,21 +668,26 @@ class IngestPipeline:
         self, batch_size: int, num_shards: int, nnz_bucket: int
     ):
         """Consume the staged batch partitioned per shard; → (labels,
-        weights, indices, values, row_ids, rows) with flat
-        [num_shards*nnz_bucket] entry arrays and LOCAL row ids."""
+        weights, indices, values, row_ids, offsets, rows) with flat
+        [num_shards*nnz_bucket] entry arrays, LOCAL row ids, and flat
+        [num_shards*(batch/num_shards + 1)] per-shard LOCAL CSR offsets."""
         labels = np.empty(batch_size, dtype=np.float32)
         weights = np.empty(batch_size, dtype=np.float32)
         total = num_shards * nnz_bucket
         indices = np.empty(total, dtype=np.int32)
         values = np.empty(total, dtype=np.float32)
         row_ids = np.empty(total, dtype=np.int32)
+        offsets = np.empty(
+            num_shards * (batch_size // num_shards + 1), dtype=np.int32
+        )
         rows = self._lib.ingest_fetch_batch_coo_sharded(
             self._handle, _ptr(labels), _ptr(weights), _ptr(indices),
-            _ptr(values), _ptr(row_ids), batch_size, num_shards, nnz_bucket,
+            _ptr(values), _ptr(row_ids), _ptr(offsets), batch_size,
+            num_shards, nnz_bucket,
         )
         if rows < 0:
             raise DMLCError(f"native sharded coo fetch failed rc={rows}")
-        return labels, weights, indices, values, row_ids, int(rows)
+        return labels, weights, indices, values, row_ids, offsets, int(rows)
 
     def stats(self) -> dict:
         """Per-stage counters (SURVEY §5.1 pipeline timers)."""

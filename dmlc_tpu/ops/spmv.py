@@ -20,6 +20,25 @@ import jax
 import jax.numpy as jnp
 
 
+def expand_row_ids(offsets, nnz: int):
+    """[rows + 1] CSR offsets → [nnz] COO row ids, on device.
+
+    The feed ships the small offsets array across H2D (∝ rows) instead of
+    per-entry row_ids (∝ nnz); this expansion — scatter-add a mark at every
+    row boundary, then an inclusive cumsum — is O(nnz) vectorized work that
+    XLA fuses into the consuming segment-sum's input. Entry e's row is
+    #{r ≥ 1 : offsets[r] ≤ e}. Boundary marks at nnz (empty tail rows /
+    bucket-exact batches) fall off the end and are dropped; padded entries
+    past the valid nnz resolve to the LAST row (clamped — ``jnp.take``'s
+    out-of-bounds fill mode would inject NaN), which is harmless because
+    their values are 0 (arithmetic no-op in both segment-sum directions).
+
+    ``nnz`` must be the static bucket size (values.shape[0] under jit).
+    """
+    marks = jnp.zeros(nnz, jnp.int32).at[offsets[1:]].add(1, mode="drop")
+    return jnp.minimum(jnp.cumsum(marks), offsets.shape[0] - 2)
+
+
 @partial(jax.jit, static_argnames=("num_rows",))
 def spmv(values, indices, row_ids, weight_vec, num_rows: int):
     """y[r] = sum_{e: row_ids[e]==r} values[e] * weight_vec[indices[e]].

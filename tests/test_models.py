@@ -115,7 +115,7 @@ class TestLinearMeshParity:
             "weight": jnp.asarray(dev.weights),
             "indices": jnp.asarray(dev.indices),
             "values": jnp.asarray(dev.values),
-            "row_ids": jnp.asarray(dev.row_ids),
+            "offsets": jnp.asarray(dev.offsets),
         }
         mesh = data_parallel_mesh()
         nshards = mesh.shape["dp"]
@@ -146,8 +146,8 @@ class TestLinearMeshParity:
             "values": jax.device_put(
                 jnp.asarray(sh.values), NamedSharding(mesh, P("dp"))
             ),
-            "row_ids": jax.device_put(
-                jnp.asarray(sh.row_ids), NamedSharding(mesh, P("dp"))
+            "offsets": jax.device_put(
+                jnp.asarray(sh.offsets), NamedSharding(mesh, P("dp"))
             ),
         }
         # per-device H2D ∝ global_nnz / world: each device holds one
@@ -159,6 +159,47 @@ class TestLinearMeshParity:
         np.testing.assert_allclose(
             np.asarray(p1["w"]), np.asarray(p2["w"]), rtol=1e-5, atol=1e-6
         )
+
+
+class TestExpandRowIds:
+    def test_matches_host_row_ids_and_clamps_padding(self):
+        """Device-side offsets→row_ids expansion == the host row_ids on
+        valid entries; padded entries clamp to the last row (out-of-range
+        ids under jnp.take's fill mode would inject NaN)."""
+        from dmlc_tpu.data.row_block import RowBlockContainer
+        from dmlc_tpu.device.csr import pad_to_bucket, pad_to_bucket_sharded
+        from dmlc_tpu.ops.spmv import expand_row_ids
+
+        rng = np.random.RandomState(11)
+        cont = RowBlockContainer()
+        n = 48
+        for i in range(n):
+            k = rng.randint(0, 5)  # ragged, including EMPTY rows
+            feats = sorted(rng.choice(32, size=k, replace=False)) if k else []
+            cont.push_row(float(i % 2), feats,
+                          value=np.ones(k, dtype=np.float32))
+        block = cont.to_block()
+
+        # short batch: valid rows < batch_size exercises offset tail fill
+        dev = pad_to_bucket(block, 64, nnz_bucket=256)
+        rid = np.asarray(expand_row_ids(jnp.asarray(dev.offsets), 256))
+        nnz = dev.num_nonzero
+        np.testing.assert_array_equal(rid[:nnz], dev.row_ids[:nnz])
+        assert rid.max() <= 63  # clamped in range
+
+        sh = pad_to_bucket_sharded(block, 64, 4)
+        rows_local = 64 // 4
+        for s in range(4):
+            off = sh.offsets[s * (rows_local + 1):(s + 1) * (rows_local + 1)]
+            sec = slice(s * sh.nnz_bucket, (s + 1) * sh.nnz_bucket)
+            rid = np.asarray(
+                expand_row_ids(jnp.asarray(off), sh.nnz_bucket)
+            )
+            valid = int(off[-1])
+            np.testing.assert_array_equal(
+                rid[:valid], sh.row_ids[sec][:valid]
+            )
+            assert rid.max() <= rows_local - 1
 
 
 class TestFM:
@@ -179,7 +220,7 @@ class TestFM:
             "weight": jnp.asarray(dev.weights),
             "indices": jnp.asarray(dev.indices),
             "values": jnp.asarray(dev.values),
-            "row_ids": jnp.asarray(dev.row_ids),
+            "offsets": jnp.asarray(dev.offsets),
         }
         single = make_fm_train_step(None, nfeat, learning_rate=0.2)
         p1 = init_fm_params(nfeat, 4)
@@ -201,7 +242,7 @@ class TestFM:
             for k, v in (
                 ("label", sh.labels), ("weight", sh.weights),
                 ("indices", sh.indices), ("values", sh.values),
-                ("row_ids", sh.row_ids),
+                ("offsets", sh.offsets),
             )
         }
         p1b = init_fm_params(nfeat, 4)
@@ -368,6 +409,7 @@ class TestShardedCSRFeed:
         np.testing.assert_array_equal(got.indices, want.indices)
         np.testing.assert_allclose(got.values, want.values, rtol=1e-6)
         np.testing.assert_array_equal(got.row_ids, want.row_ids)
+        np.testing.assert_array_equal(got.offsets, want.offsets)
         assert got.num_nonzero == want.num_nonzero
 
     def test_feed_mesh_csr_end_to_end_matches_single(self, tmp_path):
