@@ -249,7 +249,7 @@ class DeviceFeed:
                 "weight": P(self._axis),
                 "indices": entry_spec,
                 "values": entry_spec,
-                "offsets": entry_spec if sharded else P(),
+                "offsets": entry_spec,
             },
         )
         out["num_rows"] = batch.num_rows
