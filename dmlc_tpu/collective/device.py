@@ -19,6 +19,26 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dmlc_tpu.utils.logging import DMLCError
 
 
+def _bitor_reduce(x, axis=0):
+    # rabit's bitwise-OR reduce (engine.h AllReduce<op::BitOR>);
+    # integer-only, screened in DeviceEngine.allreduce()
+    return jax.lax.reduce(
+        x, jnp.zeros((), x.dtype), jax.lax.bitwise_or, (axis,)
+    )
+
+
+# The rabit op surface (engine.h op::Sum/Max/Min/BitOR + prod). Single
+# source of truth: allreduce() validates against these keys and
+# _reduce_fn() compiles from the same table — the two cannot drift.
+_REDUCE_OPS = {
+    "sum": jnp.sum,
+    "max": jnp.max,
+    "min": jnp.min,
+    "prod": jnp.prod,
+    "bitor": _bitor_reduce,
+}
+
+
 # ---- in-jit collectives (use inside shard_map/pjit-ed functions) ----------
 
 def psum(x, axis: str = "dp"):
@@ -93,9 +113,7 @@ class DeviceEngine:
         if fn is None:
             from jax.sharding import NamedSharding
 
-            ops = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
-                   "prod": jnp.prod}
-            reduce_fn = ops[op]
+            reduce_fn = _REDUCE_OPS[op]
             out_sharding = NamedSharding(self._process_mesh(), P())
             fn = jax.jit(
                 lambda x: reduce_fn(x, axis=0),
@@ -144,12 +162,14 @@ class DeviceEngine:
         """
         self._check_live()
         arr = self._validate(array)
+        if op not in _REDUCE_OPS:
+            raise ValueError(f"unknown op {op!r}")
+        if op == "bitor" and arr.dtype.kind not in "iub":
+            raise TypeError(f"bitor needs an integer dtype, got {arr.dtype}")
         if self.world_size == 1:
             # Single process owns every device: nothing to reduce across
             # processes; return as-is (matches rabit world=1 semantics).
             return arr
-        if op not in ("sum", "max", "min", "prod"):
-            raise ValueError(f"unknown op {op!r}")
         try:
             from jax.sharding import NamedSharding
 

@@ -90,6 +90,18 @@ def _worker_main(tracker_uri, tracker_port, world, results):
         s1 = engine.allreduce(a)
         s2 = engine.allreduce(a)
         ok_det = np.array_equal(s1, s2)
+        # 5b. the rest of the rabit op surface: min / prod / bitwise-OR
+        # (engine.h op::Min/Prod/BitOR)
+        out_min = engine.allreduce(np.asarray([float(rank)]), op="min")
+        ok_det = ok_det and out_min[0] == 0.0
+        out_prod = engine.allreduce(
+            np.asarray([2.0], dtype=np.float64), op="prod"
+        )
+        ok_det = ok_det and out_prod[0] == float(2 ** world)
+        out_bitor = engine.allreduce(
+            np.asarray([1 << rank], dtype=np.int64), op="bitor"
+        )
+        ok_det = ok_det and int(out_bitor[0]) == (1 << world) - 1
         # 6. ring allreduce (long-message path): force the ring by dropping
         # the threshold; must agree with the tree result elementwise and be
         # bit-stable across calls. Shape chosen to not divide evenly.
@@ -191,6 +203,25 @@ class TestRabitApi:
             assert C.version_number() == 1
         finally:
             C.finalize()
+
+
+class TestDeviceEngineOps:
+    def test_op_validation_and_world1_semantics(self):
+        """DeviceEngine: unknown op / bitor-on-float raise before any
+        transport; world=1 valid ops return the input unchanged (rabit
+        world=1 semantics)."""
+        from dmlc_tpu.collective.device import DeviceEngine
+
+        eng = DeviceEngine()
+        assert eng.world_size == 1
+        with pytest.raises(ValueError):
+            eng.allreduce(np.ones(3, dtype=np.float32), op="bogus")
+        with pytest.raises(TypeError):
+            eng.allreduce(np.ones(3, dtype=np.float32), op="bitor")
+        got = eng.allreduce(np.asarray([3, 5], dtype=np.int64), op="bitor")
+        np.testing.assert_array_equal(got, [3, 5])
+        got = eng.allreduce(np.asarray([2.0]), op="prod")
+        np.testing.assert_array_equal(got, [2.0])
 
 
 class TestDeviceCollectives:
