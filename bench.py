@@ -201,6 +201,130 @@ def _bench_recordio(path: str) -> dict:
     }
 
 
+
+def _bench_nthread() -> int:
+    """Parse workers, native fill and device dispatch contend on small
+    hosts: measured on the 1-core driver box, nthread=1 beats 2 by ~1.5x
+    on the feed benches."""
+    return 1 if (os.cpu_count() or 1) <= 2 else 2
+
+
+def _timed_sgd_epochs(make_feed, size_mb, step_fn, layout, params, velocity):
+    """TRIALS+1 timed epochs (first = warmup) through one jitted step —
+    the single timing protocol every ingest->SGD bench in this file uses."""
+    import jax
+
+    from dmlc_tpu.models.linear import step_batch
+
+    runs = []
+    for _ in range(TRIALS + 1):
+        feed = make_feed()
+        t0 = time.time()
+        for batch in feed:
+            params, velocity, _m = step_fn(
+                params, velocity, step_batch(batch, layout)
+            )
+        jax.block_until_ready(params)
+        runs.append(round(size_mb / (time.time() - t0), 1))
+        feed.close()
+    return runs
+
+
+CRITEO_ROWS = 200_000
+CRITEO_DIM = 1 << 20  # hashed feature space
+CRITEO_NNZ = 39  # 13 numeric + 26 categorical, Criteo shape
+
+
+def _ensure_criteo_like() -> str:
+    """Synthetic Criteo-shaped libsvm: 39 features/row drawn from a 2^20
+    hashed id space with 7-digit ids — the high-cardinality SPARSE workload
+    (the headline HIGGS file is dense-28 with 1-2 digit ids; a framework
+    that only ingests that shape fast has not demonstrated the Criteo-class
+    contract SURVEY §7 names)."""
+    import numpy as np
+
+    path = os.path.join(
+        CACHE_DIR,
+        f"criteo_like_{CRITEO_ROWS}x{CRITEO_NNZ}_d{CRITEO_DIM}.svm",
+    )
+    if os.path.exists(path) and os.path.getsize(path) > 0:
+        return path
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    rng = np.random.RandomState(7)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        for start in range(0, CRITEO_ROWS, 10_000):
+            n = min(10_000, CRITEO_ROWS - start)
+            labels = rng.randint(0, 2, size=n)
+            ids = rng.randint(0, CRITEO_DIM, size=(n, CRITEO_NNZ))
+            ids.sort(axis=1)
+            vals = rng.rand(n, CRITEO_NNZ)
+            lines = []
+            for i in range(n):
+                lines.append(
+                    str(labels[i]) + " " + " ".join(
+                        f"{ids[i, j]}:{vals[i, j]:.4f}"
+                        for j in range(CRITEO_NNZ)
+                    )
+                )
+            fh.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def _bench_criteo_like() -> dict:
+    """Sparse high-cardinality ingest + csr-SGD: parse MB/s over the
+    Criteo-shaped file, and the csr train loop with a 2^20 feature space
+    (segment-sum SpMV gradient, sharded-COO-compatible layout)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.device import BatchSpec, DeviceFeed
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+        step_batch,
+    )
+
+    path = _ensure_criteo_like()
+    size_mb = os.path.getsize(path) / (1 << 20)
+    nthread = _bench_nthread()
+
+    parse_runs = []
+    for _ in range(TRIALS + 1):
+        t0 = time.time()
+        parser = create_parser(path, 0, 1, nthread=nthread)
+        rows = sum(len(b) for b in parser)
+        dt = time.time() - t0
+        parser.close()
+        assert rows == CRITEO_ROWS, f"criteo row count mismatch: {rows}"
+        parse_runs.append(round(size_mb / dt, 1))
+
+    batch = 8192
+    spec = BatchSpec(batch_size=batch, layout="csr",
+                     num_features=CRITEO_DIM + 1,
+                     nnz_bucket=1 << 19)
+    step = make_linear_train_step(
+        None, learning_rate=0.05, layout="csr",
+        num_features=CRITEO_DIM + 1,
+    )
+    params = init_linear_params(CRITEO_DIM + 1)
+    velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
+    sgd_runs = _timed_sgd_epochs(
+        lambda: DeviceFeed(create_parser(path, 0, 1, nthread=nthread), spec),
+        size_mb, step, "csr", params, velocity,
+    )
+    return {
+        "criteo_like_parse_mbps": round(statistics.median(parse_runs[1:]), 1),
+        "criteo_like_parse_trials_mbps": parse_runs[1:],
+        "criteo_like_csr_sgd_mbps": round(statistics.median(sgd_runs[1:]), 1),
+        "criteo_like_csr_sgd_trials_mbps": sgd_runs[1:],
+        "criteo_like_file_mb": round(size_mb, 1),
+        "criteo_like_feature_space": CRITEO_DIM,
+    }
+
+
 def _bench_device_feed(path: str) -> dict:
     """Feed-only (parse→densify→H2D) and ingest→SGD MB/s on the attached
     accelerator, median of warm passes (the jitted step persists across
@@ -218,29 +342,12 @@ def _bench_device_feed(path: str) -> dict:
 
     size_mb = os.path.getsize(path) / (1 << 20)
     spec = BatchSpec(batch_size=16384, layout="dense", num_features=29)
-    # parse workers, native fill and device dispatch contend on small hosts:
-    # measured on the 1-core driver box, nthread=1 beats 2 by ~1.5x here
-    nthread = 1 if (os.cpu_count() or 1) <= 2 else 2
+    nthread = _bench_nthread()
 
     def _feed(feed_spec=spec):
         return DeviceFeed(
             create_parser(path, 0, 1, nthread=nthread), feed_spec
         )
-
-    def _timed_sgd_epochs(feed_spec, step_fn, layout, params, velocity):
-        """TRIALS+1 timed epochs (first = warmup) through one jitted step."""
-        runs = []
-        for _ in range(TRIALS + 1):
-            feed = _feed(feed_spec)
-            t0 = time.time()
-            for batch in feed:
-                params, velocity, _m = step_fn(
-                    params, velocity, step_batch(batch, layout)
-                )
-            jax.block_until_ready(params)
-            runs.append(round(size_mb / (time.time() - t0), 1))
-            feed.close()
-        return runs
 
     feed_runs = []
     stage_samples = {"host_batch_ns": [], "dispatch_ns": [],
@@ -267,7 +374,9 @@ def _bench_device_feed(path: str) -> dict:
     velocity = {"w": jnp.zeros_like(params["w"]),
                 "b": jnp.zeros_like(params["b"])}
     step = make_linear_train_step(None, learning_rate=0.1, layout="dense")
-    sgd_runs = _timed_sgd_epochs(spec, step, "dense", params, velocity)
+    sgd_runs = _timed_sgd_epochs(
+        _feed, size_mb, step, "dense", params, velocity
+    )
 
     # sparse path e2e: csr layout (native COO staging) through the csr
     # train step — the genuinely-sparse Criteo-class shape
@@ -279,7 +388,9 @@ def _bench_device_feed(path: str) -> dict:
     )
     csr_spec = BatchSpec(batch_size=16384, layout="csr", num_features=29,
                          nnz_bucket=1 << 19)
-    csr_runs = _timed_sgd_epochs(csr_spec, csr_step, "csr", cparams, cvel)
+    csr_runs = _timed_sgd_epochs(
+        lambda: _feed(csr_spec), size_mb, csr_step, "csr", cparams, cvel
+    )
 
     out = {
         "feed_dense_mbps": round(statistics.median(feed_runs[1:]), 1),
@@ -392,6 +503,10 @@ def main() -> None:
         extra.update(_bench_device_feed(path))
     except Exception as err:
         extra["device_feed_error"] = str(err)
+    try:
+        extra.update(_bench_criteo_like())
+    except Exception as err:
+        extra["criteo_like_error"] = str(err)
 
     sweeps.append(_headline_sweep(path))
 
