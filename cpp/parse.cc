@@ -13,6 +13,8 @@
 #include <cstdint>
 #include <cstring>
 
+#include "dmlc_tpu.h"
+
 namespace {
 
 inline bool is_space(char c) { return c == ' ' || c == '\t'; }
@@ -169,19 +171,9 @@ inline const char* scan_u64(const char* p, const char* end, uint64_t* out) {
 
 }  // namespace
 
-// Status codes shared by all parsers.
-enum {
-  DMLC_TPU_OK = 0,
-  DMLC_TPU_EOVERFLOW = -1,  // output capacity exceeded
-  DMLC_TPU_EPARSE = -2,     // malformed input
-};
-
-// Feature flags reported by parse_libsvm.
-enum {
-  DMLC_TPU_HAS_WEIGHT = 1,
-  DMLC_TPU_HAS_QID = 2,
-  DMLC_TPU_HAS_VALUE = 4,
-};
+// Status codes and feature flags come from the public header
+// (dmlc_tpu.h) — the single source the Python binding and external
+// consumers read.
 
 
 // Parse libfm text: "label field:idx:val ..." per line. Outputs as libsvm
@@ -441,6 +433,6 @@ void count_tokens(const char* data, int64_t len,
   *out_tokens = tokens;
 }
 
-int dmlc_tpu_abi_version() { return 4; }
+int dmlc_tpu_abi_version(void) { return DMLC_TPU_ABI_VERSION; }
 
 }  // extern "C"

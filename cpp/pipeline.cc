@@ -33,29 +33,10 @@
 #include <thread>
 #include <vector>
 
-extern "C" {
-// parse.cc hot loops (same translation unit boundary as the ctypes ABI).
-// The u32-index variants write device-layout indices directly — no
-// narrowing pass over nnz afterwards.
-int parse_libsvm32(const char* data, int64_t len, float* labels,
-                   float* weights, int64_t* qids, int64_t* row_nnz,
-                   uint32_t* indices, float* values, int64_t max_rows,
-                   int64_t max_nnz, int64_t* out_rows, int64_t* out_nnz,
-                   int* out_flags);
-int parse_libfm32(const char* data, int64_t len, float* labels,
-                  int64_t* row_nnz, uint32_t* fields, uint32_t* indices,
-                  float* values, int64_t max_rows, int64_t max_nnz,
-                  int64_t* out_rows, int64_t* out_nnz);
-int parse_csv(const char* data, int64_t len, float* out, int64_t max_rows,
-              int64_t expect_cols, int64_t* out_rows, int64_t* out_cols);
-void count_tokens(const char* data, int64_t len, int64_t* out_rows,
-                  int64_t* out_tokens);
-// recordio.cc framing primitives
-int recordio_unpack(const char* buf, int64_t len, char* out_data,
-                    int64_t* out_offsets, int64_t* out_nrec,
-                    int64_t* out_datalen, int64_t* out_consumed);
-int64_t recordio_find_head(const char* buf, int64_t len, int64_t start);
-}
+// The public header carries every cross-TU declaration (parse.cc hot
+// loops, recordio.cc framing) — the compiler checks our definitions
+// against it.
+#include "dmlc_tpu.h"
 
 namespace {
 

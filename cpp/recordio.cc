@@ -15,6 +15,8 @@
 #include <cstdint>
 #include <cstring>
 
+#include "dmlc_tpu.h"
+
 namespace {
 
 constexpr uint32_t kMagic = 0xced7230aU;

@@ -17,64 +17,10 @@
 #include <string>
 #include <vector>
 
-extern "C" {
-int parse_libsvm(const char* data, int64_t len, float* labels, float* weights,
-                 int64_t* qids, int64_t* row_nnz, uint64_t* indices,
-                 float* values, int64_t max_rows, int64_t max_nnz,
-                 int64_t* out_rows, int64_t* out_nnz, int* out_flags);
-int parse_libfm(const char* data, int64_t len, float* labels, int64_t* row_nnz,
-                uint64_t* fields, uint64_t* indices, float* values,
-                int64_t max_rows, int64_t max_nnz, int64_t* out_rows,
-                int64_t* out_nnz);
-int parse_csv(const char* data, int64_t len, float* out, int64_t max_rows,
-              int64_t expect_cols, int64_t* out_rows, int64_t* out_cols);
-void count_tokens(const char* data, int64_t len, int64_t* out_rows,
-                  int64_t* out_tokens);
-int64_t recordio_pack_bound(const char* data, int64_t len);
-int64_t recordio_pack(const char* data, int64_t len, char* out);
-int recordio_unpack(const char* buf, int64_t len, char* out_data,
-                    int64_t* out_offsets, int64_t* out_nrec,
-                    int64_t* out_datalen, int64_t* consumed);
-int64_t recordio_find_head(const char* buf, int64_t len, int64_t start);
-int64_t recordio_pack_bound(const char* data, int64_t len);
-int64_t recordio_pack(const char* data, int64_t len, char* out);
-void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
-                  int32_t format, int32_t part, int32_t nparts,
-                  int32_t nthread, int64_t chunk_bytes, int32_t capacity,
-                  int64_t csv_expect_cols);
-int ingest_peek(void* handle, int64_t* rows, int64_t* nnz, int64_t* ncols,
-                int32_t* flags);
-int ingest_fetch(void* handle, float* labels, float* weights, int64_t* qids,
-                 int64_t* offsets, uint32_t* indices, float* values,
-                 uint32_t* fields);
-int64_t ingest_bytes_read(void* handle);
-void ingest_close(void* handle);
-int ingest_stage_batch(void* handle, int64_t batch_size, int64_t* rows,
-                       int64_t* nnz);
-int64_t ingest_fetch_batch_dense(void* handle, float* x, float* labels,
-                                 float* weights, int64_t batch_size,
-                                 int64_t num_features);
-int64_t ingest_fetch_batch_coo(void* handle, float* labels, float* weights,
-                               int32_t* indices, float* values,
-                               int32_t* row_ids, int32_t* offsets,
-                               int64_t batch_size, int64_t nnz_bucket);
-int64_t ingest_staged_max_shard_nnz(void* handle, int64_t batch_size,
-                                    int64_t num_shards);
-int64_t ingest_fetch_batch_coo_sharded(void* handle, float* labels,
-                                       float* weights, int32_t* indices,
-                                       float* values, int32_t* row_ids,
-                                       int32_t* offsets,
-                                       int64_t batch_size,
-                                       int64_t num_shards,
-                                       int64_t nnz_bucket);
-void ingest_stats(void* handle, double* out, int32_t n);
-void* ingest_open_push(int32_t format, int32_t nthread, int64_t chunk_bytes,
-                       int32_t capacity, int64_t csv_expect_cols);
-void* ingest_push_reserve(void* handle, int64_t want);
-int ingest_push_commit(void* handle, int64_t n);
-int ingest_push_eof(void* handle);
-int dmlc_tpu_abi_version();
-}
+// All ABI declarations come from the public header — definitions
+// are compile-checked against it in every TU.
+#include "dmlc_tpu.h"
+
 
 namespace {
 

@@ -1,0 +1,76 @@
+// Consuming libdmlc_tpu.so from C++ — the analog of linking the
+// reference's libdmlc.a (example/: parameter.cc is its demo; this is ours
+// for the native ingest core).
+//
+// Build (from the repo root, after `make -C cpp`; one line):
+//   g++ -O2 -std=c++17 examples/native_ingest.cc
+//       -Icpp -Lcpp -ldmlc_tpu -Wl,-rpath,$PWD/cpp -o native_ingest
+//   ./native_ingest data.svm
+//
+// Streams a libsvm file through the threaded native pipeline (reader
+// thread -> parse workers -> ordered CSR blocks) and prints totals — the
+// same engine the Python package drives through ctypes.
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <sys/stat.h>
+
+#include "dmlc_tpu.h"
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s <file.svm>\n", argv[0]);
+    return 2;
+  }
+  if (dmlc_tpu_abi_version() != DMLC_TPU_ABI_VERSION) {
+    std::fprintf(stderr, "ABI mismatch: header %d, library %d\n",
+                 DMLC_TPU_ABI_VERSION, dmlc_tpu_abi_version());
+    return 2;
+  }
+  struct stat st;
+  if (stat(argv[1], &st) != 0) {
+    std::perror("stat");
+    return 1;
+  }
+  // paths: NUL-terminated strings back to back (one file here)
+  std::string paths(argv[1]);
+  paths.push_back('\0');
+  int64_t size = static_cast<int64_t>(st.st_size);
+  void* h = ingest_open(paths.data(), &size, /*nfiles=*/1,
+                        DMLC_TPU_FORMAT_LIBSVM, /*part=*/0, /*nparts=*/1,
+                        /*nthread=*/2, /*chunk_bytes=*/8 << 20,
+                        /*capacity=*/4, /*csv_expect_cols=*/0);
+  if (h == nullptr) {
+    std::fprintf(stderr, "ingest_open failed\n");
+    return 1;
+  }
+  int64_t total_rows = 0, total_nnz = 0, blocks = 0;
+  for (;;) {
+    int64_t rows, nnz, ncols;
+    int32_t flags;
+    int rc = ingest_peek(h, &rows, &nnz, &ncols, &flags);
+    if (rc == 0) break;  // end of stream
+    if (rc < 0) {
+      std::fprintf(stderr, "pipeline error rc=%d\n", rc);
+      ingest_close(h);
+      return 1;
+    }
+    float *labels, *weights, *values;
+    int64_t *qids, *offsets;
+    uint32_t *indices, *fields;
+    void* block = ingest_fetch_view(h, &labels, &weights, &qids, &offsets,
+                                    &indices, &values, &fields);
+    // zero-copy CSR views are valid until ingest_block_free
+    total_rows += rows;
+    total_nnz += offsets[rows];
+    ++blocks;
+    ingest_block_free(block);
+  }
+  std::printf("rows=%" PRId64 " nnz=%" PRId64 " blocks=%" PRId64
+              " bytes=%" PRId64 "\n",
+              total_rows, total_nnz, blocks, ingest_bytes_read(h));
+  ingest_close(h);
+  return 0;
+}

@@ -47,3 +47,33 @@ def test_cpp_sanitizer_tiers(target):
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "cpp unit tests ok" in proc.stdout
+
+
+@pytest.mark.skipif(shutil.which("make") is None or shutil.which("g++") is None,
+                    reason="no native toolchain")
+def test_cpp_consumer_example_builds_and_runs(tmp_path):
+    """examples/native_ingest.cc: a C++ program consuming the public header
+    (cpp/dmlc_tpu.h) + .so directly — the reference's libdmlc.a consumer
+    story (its example/parameter.cc analog for the native core)."""
+    subprocess.run(
+        ["make", "-C", os.path.join(REPO, "cpp"), "-s"],
+        capture_output=True, text=True, timeout=300, check=True,
+    )
+    exe = tmp_path / "native_ingest"
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(REPO, "examples", "native_ingest.cc"),
+         "-I" + os.path.join(REPO, "cpp"),
+         "-L" + os.path.join(REPO, "cpp"), "-ldmlc_tpu",
+         "-Wl,-rpath," + os.path.join(REPO, "cpp"),
+         "-o", str(exe)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert build.returncode == 0, build.stderr
+    data = tmp_path / "d.svm"
+    data.write_text("1 1:0.5 3:0.25\n0 2:1.5\n1 1:2 2:3 4:4\n")
+    proc = subprocess.run(
+        [str(exe), str(data)], capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "rows=3 nnz=6" in proc.stdout
