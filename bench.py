@@ -93,6 +93,36 @@ def _one_pass(path: str, nthread: int) -> tuple:
     return mbps, stats
 
 
+def _device_backend_ok(timeout_s: float = 90.0) -> tuple:
+    """Probe jax backend init in a THROWAWAY subprocess → (ok, reason).
+    When the TPU tunnel is down, jax.devices() HANGS (not errors) —
+    probing in-process would wedge the whole bench and the driver would
+    record nothing. A failed probe skips the device tiers (with the real
+    reason recorded: timeout vs the child's actual error); every
+    host-side tier still reports."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, (
+            f"jax backend init hung past {timeout_s:.0f}s "
+            "(TPU tunnel down?)"
+        )
+    except Exception as err:
+        return False, f"backend probe failed to run: {err}"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()
+        return False, "jax backend init failed: " + (
+            tail[-1] if tail else f"exit {proc.returncode}"
+        )
+    return True, (proc.stdout or "").strip()
+
+
 def _host_probe() -> float:
     """Fixed-work CPU probe (GB/s), ~0.1s. The shared vCPU's effective
     speed swings ~1.6x on a minutes timescale; a probe recorded next to
@@ -272,20 +302,12 @@ def _ensure_criteo_like() -> str:
     return path
 
 
-def _bench_criteo_like() -> dict:
+def _bench_criteo_like(device_ok: bool = True) -> dict:
     """Sparse high-cardinality ingest + csr-SGD: parse MB/s over the
     Criteo-shaped file, and the csr train loop with a 2^20 feature space
-    (segment-sum SpMV gradient, sharded-COO-compatible layout)."""
-    import jax
-    import jax.numpy as jnp
-
+    (segment-sum SpMV gradient, sharded-COO-compatible layout). With
+    device_ok=False only the parse half runs (no jax touched)."""
     from dmlc_tpu.data import create_parser
-    from dmlc_tpu.device import BatchSpec, DeviceFeed
-    from dmlc_tpu.models.linear import (
-        init_linear_params,
-        make_linear_train_step,
-        step_batch,
-    )
 
     path = _ensure_criteo_like()
     size_mb = os.path.getsize(path) / (1 << 20)
@@ -301,6 +323,23 @@ def _bench_criteo_like() -> dict:
         assert rows == CRITEO_ROWS, f"criteo row count mismatch: {rows}"
         parse_runs.append(round(size_mb / dt, 1))
 
+    out = {
+        "criteo_like_parse_mbps": round(statistics.median(parse_runs[1:]), 1),
+        "criteo_like_parse_trials_mbps": parse_runs[1:],
+        "criteo_like_file_mb": round(size_mb, 1),
+        "criteo_like_feature_space": CRITEO_DIM,
+    }
+    if not device_ok:
+        return out
+
+    import jax.numpy as jnp
+
+    from dmlc_tpu.device import BatchSpec, DeviceFeed
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+    )
+
     batch = 8192
     spec = BatchSpec(batch_size=batch, layout="csr",
                      num_features=CRITEO_DIM + 1,
@@ -315,14 +354,9 @@ def _bench_criteo_like() -> dict:
         lambda: DeviceFeed(create_parser(path, 0, 1, nthread=nthread), spec),
         size_mb, step, "csr", params, velocity,
     )
-    return {
-        "criteo_like_parse_mbps": round(statistics.median(parse_runs[1:]), 1),
-        "criteo_like_parse_trials_mbps": parse_runs[1:],
-        "criteo_like_csr_sgd_mbps": round(statistics.median(sgd_runs[1:]), 1),
-        "criteo_like_csr_sgd_trials_mbps": sgd_runs[1:],
-        "criteo_like_file_mb": round(size_mb, 1),
-        "criteo_like_feature_space": CRITEO_DIM,
-    }
+    out["criteo_like_csr_sgd_mbps"] = round(statistics.median(sgd_runs[1:]), 1)
+    out["criteo_like_csr_sgd_trials_mbps"] = sgd_runs[1:]
+    return out
 
 
 def _bench_device_feed(path: str) -> dict:
@@ -498,13 +532,17 @@ def main() -> None:
         extra.update(_bench_recordio(path))
     except Exception as err:  # the headline metric must still print
         extra["recordio_error"] = str(err)
+    device_ok, device_note = _device_backend_ok()
+    extra["device_feed_probe_gbps"] = _host_probe()
+    if not device_ok:
+        extra["device_unavailable"] = device_note + "; device tiers skipped"
+    else:
+        try:
+            extra.update(_bench_device_feed(path))
+        except Exception as err:
+            extra["device_feed_error"] = str(err)
     try:
-        extra["device_feed_probe_gbps"] = _host_probe()
-        extra.update(_bench_device_feed(path))
-    except Exception as err:
-        extra["device_feed_error"] = str(err)
-    try:
-        extra.update(_bench_criteo_like())
+        extra.update(_bench_criteo_like(device_ok=device_ok))
     except Exception as err:
         extra["criteo_like_error"] = str(err)
 
@@ -529,7 +567,7 @@ def main() -> None:
     try:
         from bench_collective import collective_metrics
 
-        extra.update(collective_metrics())
+        extra.update(collective_metrics(device_ok=device_ok))
     except Exception as err:
         extra["collective_error"] = str(err)
 

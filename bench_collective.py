@@ -239,18 +239,31 @@ def device_engine_allreduce_metrics(
     }
 
 
-def collective_metrics() -> dict:
+def collective_metrics(device_ok: bool = True) -> dict:
     """The bench.py hook: flat metric dict; failures are per-tier so one
-    broken tier cannot hide the other."""
+    broken tier cannot hide the other. device_ok=False (backend init probe
+    failed — jax.devices() would hang) skips the two jax tiers; the socket
+    tier never touches jax."""
     out = {}
     try:
         out.update(socket_allreduce_metrics())
     except Exception as err:
         out["socket_allreduce_error"] = str(err)
+    cpu_mode = bool(os.environ.get("DMLC_TPU_BENCH_CPU_DEVICES"))
+    if not device_ok and not cpu_mode:
+        out["device_tiers_skipped"] = "jax backend unavailable"
+        return out
+    # DMLC_TPU_BENCH_CPU_DEVICES: the psum tier forces itself onto virtual
+    # CPU devices (no TPU backend needed), so it runs even when the probe
+    # failed; the engine tier does NOT self-force and would hang on a dead
+    # tunnel, so it still honors the probe.
     try:
         out.update(device_psum_metrics())
     except Exception as err:
         out["psum_error"] = str(err)
+    if not device_ok:
+        out["engine_tier_skipped"] = "jax backend unavailable"
+        return out
     try:
         out.update(device_engine_allreduce_metrics())
     except Exception as err:
