@@ -124,22 +124,26 @@ def _device_backend_ok(timeout_s: float = 90.0) -> tuple:
 
 
 def _host_probe() -> float:
-    """Fixed-work CPU probe (GB/s), ~0.1s. The shared vCPU's effective
-    speed swings ~1.6x on a minutes timescale; a probe recorded next to
-    each sweep makes that drift visible in the JSON instead of silently
-    moving the score."""
-    import numpy as np
+    """Fixed-work CPU probe (GB/s), ~0.1s; -1.0 if the probe itself fails
+    (it is context for the score, never a reason to lose it). The shared
+    vCPU's effective speed swings ~1.6x on a minutes timescale; a probe
+    recorded next to each sweep makes that drift visible in the JSON
+    instead of silently moving the score."""
+    try:
+        import numpy as np
 
-    buf = getattr(_host_probe, "_buf", None)
-    if buf is None:
-        buf = np.random.RandomState(0).randint(
-            0, 255, size=20_000_000, dtype=np.uint8
-        )
-        _host_probe._buf = buf
-    t0 = time.perf_counter()
-    for _ in range(3):
-        int(buf.sum())
-    return round(3 * buf.nbytes / (time.perf_counter() - t0) / 1e9, 2)
+        buf = getattr(_host_probe, "_buf", None)
+        if buf is None:
+            buf = np.random.RandomState(0).randint(
+                0, 255, size=20_000_000, dtype=np.uint8
+            )
+            _host_probe._buf = buf
+        t0 = time.perf_counter()
+        for _ in range(3):
+            int(buf.sum())
+        return round(3 * buf.nbytes / (time.perf_counter() - t0) / 1e9, 2)
+    except Exception:
+        return -1.0
 
 
 def _headline_threads() -> list:

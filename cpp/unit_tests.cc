@@ -571,8 +571,91 @@ void test_push_reserve_commit() {
 
 }  // namespace
 
+// Deterministic structured fuzz of the chunk parsers (the adversarial
+// counterpart of the strtonum fuzz harness, tools/strtonum.py): random
+// bytes, bit-flipped valid records, token soup, and truncations. The value
+// is in WHICH binary runs it — this same function executes under the
+// ASan+UBSan and TSan tiers (make -C cpp test_asan/test_tsan), so every
+// out-of-bounds read a malformed chunk could provoke is instrumented.
+// Asserts only the parser CONTRACT: rc in {OK, EOVERFLOW, EPARSE} and
+// in-bounds output counts; xorshift seed fixed for reproducibility.
+void test_parser_fuzz() {
+  uint64_t s = 0x9E3779B97F4A7C15ULL;
+  auto next = [&s]() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  };
+  const std::string base = "1 1:0.5 2:1.5\n0 3:2.5\n";
+  const char* toks[] = {"1", ":", ".", "-", "e", "\n", " ", "qid:",
+                        "99999999999999999999", "1e999999", "-.e-", "\r",
+                        "0.00000000000000000000000000000001"};
+  for (int it = 0; it < 3000; ++it) {
+    std::string data;
+    switch (it & 3) {
+      case 0: {  // raw bytes
+        int64_t n = static_cast<int64_t>(next() % 200);
+        for (int64_t i = 0; i < n; ++i)
+          data.push_back(static_cast<char>(next() & 0xFF));
+        break;
+      }
+      case 1: {  // bit-flipped valid records
+        data = base;
+        for (int k = 0; k < 1 + static_cast<int>(next() % 7); ++k)
+          data[next() % data.size()] = static_cast<char>(next() & 0xFF);
+        break;
+      }
+      case 2: {  // token soup
+        int n = 1 + static_cast<int>(next() % 50);
+        for (int k = 0; k < n; ++k)
+          data += toks[next() % (sizeof(toks) / sizeof(toks[0]))];
+        break;
+      }
+      default:  // truncation
+        data = base.substr(0, next() % (base.size() + 1));
+    }
+    int64_t bound = static_cast<int64_t>(data.size()) / 2 + 2;
+    std::vector<float> labels(bound), weights(bound), values(bound);
+    std::vector<int64_t> qids(bound), row_nnz(bound);
+    std::vector<uint32_t> indices(bound), fields(bound);
+    int64_t rows = -1, nnz = -1;
+    int flags = 0;
+    int rc = parse_libsvm32(data.data(), data.size(), labels.data(),
+                            weights.data(), qids.data(), row_nnz.data(),
+                            indices.data(), values.data(), bound, bound,
+                            &rows, &nnz, &flags);
+    CHECK_TRUE(rc == 0 || rc == -1 || rc == -2);
+    if (rc == 0) CHECK_TRUE(rows >= 0 && rows <= bound && nnz >= 0 &&
+                            nnz <= bound);
+    rc = parse_libfm32(data.data(), data.size(), labels.data(),
+                       row_nnz.data(), fields.data(), indices.data(),
+                       values.data(), bound, bound, &rows, &nnz);
+    CHECK_TRUE(rc == 0 || rc == -1 || rc == -2);
+    if (rc == 0) CHECK_TRUE(rows >= 0 && rows <= bound && nnz >= 0 &&
+                            nnz <= bound);
+    // csv capacity contract: caller sizes out from the first line's comma
+    // count (pipeline.cc ParseCsvChunk does the same before calling)
+    int64_t commas = 0;
+    for (char c : data) {
+      if (c == '\n' || c == '\r') break;
+      commas += (c == ',');
+    }
+    int64_t csv_rows = static_cast<int64_t>(data.size()) + 1;
+    std::vector<float> csv_out(csv_rows * (commas + 2));
+    int64_t cols = 0;
+    rc = parse_csv(data.data(), data.size(), csv_out.data(),
+                   csv_rows, 0, &rows, &cols);
+    CHECK_TRUE(rc == 0 || rc == -1 || rc == -2);
+    if (rc == 0) CHECK_TRUE(rows >= 0 && rows <= csv_rows && cols >= 0 &&
+                            rows * cols <= static_cast<int64_t>(
+                                csv_out.size()));
+  }
+}
+
 int main() {
   CHECK_TRUE(dmlc_tpu_abi_version() >= 1);
+  test_parser_fuzz();
   test_libsvm_basic();
   test_libsvm_qid_and_bare();
   test_libsvm_errors();
