@@ -5,8 +5,11 @@ The reference's ThreadedIter pipeline ends with host RowBlocks
 "TPU build" note): a background thread re-batches parser output into
 fixed-shape batches, transfers them with async ``jax.device_put`` (or
 ``jax.make_array_from_process_local_data`` when a multi-host mesh is given),
-and keeps one batch in flight so H2D DMA overlaps both host parsing and the
-previous step's compute.
+and keeps ``spec.prefetch`` batches in flight (default 1 — the classic
+double-buffer; deeper windows pin more HBM but hide per-batch dispatch/DMA
+latency) so H2D DMA overlaps both host parsing and the previous step's
+compute. ``host_prefetch`` separately bounds the host-side ThreadedIter
+queue of parsed-but-undispatched blocks.
 
 Batch layouts:
 - "dense": [batch, num_features] f32 + labels/weights — the MXU-friendly
@@ -19,6 +22,7 @@ Batch layouts:
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
@@ -47,6 +51,11 @@ class BatchSpec:
     num_features: int = 0  # required for dense
     nnz_bucket: Optional[int] = None  # fixed bucket for csr (else auto)
     drop_remainder: bool = False
+    # device transfers in flight ahead of the consumer. jax dispatch is
+    # async, so a deeper window hides per-batch dispatch/DMA latency (the
+    # tunneled-chip profile especially) at the cost of pinning that many
+    # extra batches in HBM. 1 = the classic double-buffer.
+    prefetch: int = 1
 
 
 class DeviceFeed:
@@ -65,7 +74,7 @@ class DeviceFeed:
         axis: str = "dp",
         part_index: int = 0,
         num_parts: int = 1,
-        prefetch: int = 2,
+        host_prefetch: int = 2,  # ThreadedIter queue depth (host blocks)
     ):
         if isinstance(source, str):
             source = create_parser(source, part_index, num_parts)
@@ -89,7 +98,7 @@ class DeviceFeed:
         self._wait_ns = 0
         self._batches = 0
         self._host_iter = ThreadedIter(
-            self._host_batches, max_capacity=prefetch, name="device-feed"
+            self._host_batches, max_capacity=host_prefetch, name="device-feed"
         )
 
     # ---- host side: re-batch parser blocks into fixed-size slices ------
@@ -257,8 +266,10 @@ class DeviceFeed:
         return out
 
     def __iter__(self):
-        """Yield device batches with one transfer in flight ahead."""
-        pending = None
+        """Yield device batches with ``spec.prefetch`` transfers in flight
+        ahead of the consumer (async dispatch pipelining)."""
+        window = max(1, int(self.spec.prefetch))
+        pending = deque()
         it = iter(self._host_iter)
         while True:
             t0 = time.monotonic_ns()
@@ -268,15 +279,14 @@ class DeviceFeed:
                 break
             finally:
                 self._wait_ns += time.monotonic_ns() - t0
-            ready = pending
             t1 = time.monotonic_ns()
-            pending = self._to_device(block)  # async dispatch
+            pending.append(self._to_device(block))  # async dispatch
             self._dispatch_ns += time.monotonic_ns() - t1
             self._batches += 1
-            if ready is not None:
-                yield ready
-        if pending is not None:
-            yield pending
+            if len(pending) > window:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
 
     def stats(self) -> dict:
         """Per-stage wall time (ns): host batch production (parse+densify),

@@ -436,3 +436,29 @@ class TestShardedCSRFeed:
         w_single = run(None)
         w_mesh = run(mesh)
         np.testing.assert_allclose(w_single, w_mesh, rtol=1e-4, atol=1e-6)
+
+
+class TestFeedPrefetchWindow:
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_prefetch_depths_yield_identical_batches(self, tmp_path, depth):
+        """spec.prefetch only changes pipelining, never content/order."""
+        from dmlc_tpu.data import create_parser
+        from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+        path = tmp_path / "d.svm"
+        rng = np.random.RandomState(5)
+        with open(path, "w") as fh:
+            for i in range(700):
+                fh.write(f"{i % 2} 1:{rng.rand():.4f} 3:{rng.rand():.4f}\n")
+        ref_spec = BatchSpec(batch_size=128, layout="dense", num_features=8)
+        spec = BatchSpec(batch_size=128, layout="dense", num_features=8,
+                         prefetch=depth)
+        ref = DeviceFeed(create_parser(str(path), 0, 1, nthread=1), ref_spec)
+        got = DeviceFeed(create_parser(str(path), 0, 1, nthread=1), spec)
+        ref_batches = [np.asarray(b["x"]) for b in ref]
+        got_batches = [np.asarray(b["x"]) for b in got]
+        ref.close()
+        got.close()
+        assert len(ref_batches) == len(got_batches) == 6
+        for a, b in zip(ref_batches, got_batches):
+            np.testing.assert_array_equal(a, b)
