@@ -25,6 +25,7 @@ from dmlc_tpu.data.row_iter import (
     DiskRowIter,
     create_row_block_iter,
 )
+from dmlc_tpu.data.service import BlockService, RemoteBlockParser
 from dmlc_tpu.data.rowrec import (
     RecordIORowParser,
     convert_to_recordio,
@@ -54,4 +55,6 @@ __all__ = [
     "decode_row_group",
     "encode_row_group",
     "write_recordio_rows",
+    "BlockService",
+    "RemoteBlockParser",
 ]

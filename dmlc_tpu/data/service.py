@@ -1,0 +1,239 @@
+"""Disaggregated ingest: serve parsed RowBlocks over TCP (tf.data-service
+pattern).
+
+The reference has no analog — its parallelism unit is one process reading
+its own InputSplit part. On TPU pods the accelerator host is often
+compute-bound on ingest (parse contends with dispatch on the same cores),
+and the standard fix is disaggregation: dedicated CPU hosts parse, the
+accelerator hosts consume finished batches over the network (tf.data
+service, arXiv:2210.14826 — PAPERS.md). This module is that shape on this
+framework's own primitives:
+
+- :class:`BlockService` wraps any parser (URI or instance) and serves its
+  RowBlocks to connected consumers with **dynamic sharding**: blocks are
+  handed out in arrival order, so a fast consumer takes more — the
+  first-come load balancing the tf.data service paper argues for (static
+  part k/n sharding remains available by running one service per part).
+- :class:`RemoteBlockParser` is a drop-in :class:`~dmlc_tpu.data.parsers.
+  Parser`: ``next_block()`` pulls one RowBlock from a service, so
+  ``DeviceFeed(RemoteBlockParser(addr), spec)`` and every learner compose
+  unchanged.
+
+Wire format (little-endian, per response): u32 field count (0 = end of
+stream), then per field u8 name length + name, u8 dtype-string length +
+dtype, u64 byte length + raw array bytes. All RowBlock fields are 1-D.
+Requests are a single u32: 1 = NEXT, 2 = CLOSE.
+
+Like the parsers it serves, a service is ONE streaming pass (Parser
+semantics, data.h:298: "streaming one-pass"); epochs re-create service and
+clients, mirroring create_parser per epoch.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple, Union
+
+import numpy as np
+
+from dmlc_tpu.data.parsers import Parser, create_parser
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.utils.logging import DMLCError, check
+
+_REQ_NEXT = 1
+_REQ_CLOSE = 2
+
+_BLOCK_FIELDS = ("offset", "label", "index", "value", "weight", "qid",
+                 "field")
+
+
+def _send_arrays(sock: socket.socket, arrays: Dict[str, np.ndarray]) -> None:
+    parts = [struct.pack("<I", len(arrays))]
+    for name, arr in arrays.items():
+        data = np.ascontiguousarray(arr).tobytes()
+        dt = arr.dtype.str
+        parts.append(struct.pack("<B", len(name)) + name.encode())
+        parts.append(struct.pack("<B", len(dt)) + dt.encode())
+        parts.append(struct.pack("<Q", len(data)))
+        parts.append(data)
+    sock.sendall(b"".join(parts))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise DMLCError("block service connection closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def _recv_arrays(sock: socket.socket) -> Optional[Dict[str, np.ndarray]]:
+    (nfields,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if nfields == 0:
+        return None
+    out: Dict[str, np.ndarray] = {}
+    for _ in range(nfields):
+        (nlen,) = struct.unpack("<B", _recv_exact(sock, 1))
+        name = _recv_exact(sock, nlen).decode()
+        (dlen,) = struct.unpack("<B", _recv_exact(sock, 1))
+        dtype = np.dtype(_recv_exact(sock, dlen).decode())
+        (nbytes,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        out[name] = np.frombuffer(_recv_exact(sock, nbytes), dtype=dtype)
+    return out
+
+
+class BlockService:
+    """Serve one parser's RowBlocks to N consumers, dynamically sharded."""
+
+    def __init__(
+        self,
+        source: Union[str, Parser],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        **parser_kwargs,
+    ):
+        self._parser = (
+            create_parser(source, 0, 1, **parser_kwargs)
+            if isinstance(source, str)
+            else source
+        )
+        self._lock = threading.Lock()  # serializes parser pulls (the shard
+        # point: one block goes to exactly one consumer)
+        self._done = False
+        self.blocks_served = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._threads: list = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="block-service"
+        )
+        self._accept_thread.start()
+
+    # ---- server side ---------------------------------------------------
+
+    def _next_block_arrays(self) -> Optional[Dict[str, np.ndarray]]:
+        with self._lock:
+            if self._done:
+                return None
+            block = self._parser.next_block()
+            if block is None:
+                self._done = True
+                return None
+            self.blocks_served += 1
+        out = {}
+        for name in _BLOCK_FIELDS:
+            arr = getattr(block, name)
+            if arr is not None:
+                out[name] = np.asarray(arr)
+        return out
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                (req,) = struct.unpack("<I", _recv_exact(conn, 4))
+                if req == _REQ_CLOSE:
+                    return
+                check(req == _REQ_NEXT, "bad block service request %d", req)
+                arrays = self._next_block_arrays()
+                _send_arrays(conn, arrays or {})
+                if arrays is None:
+                    return
+        except (DMLCError, OSError):
+            return  # consumer went away; the stream continues for others
+        finally:
+            conn.close()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self._parser.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RemoteBlockParser:
+    """Parser-shaped consumer of a :class:`BlockService`.
+
+    Drop-in for create_parser output: next_block()/iteration/bytes_read/
+    close. before_first raises — the service is a one-pass stream (re-create
+    service + parser per epoch, exactly like a fresh create_parser).
+    """
+
+    def __init__(self, address: Tuple[str, int], timeout: float = 60.0):
+        self._sock = socket.create_connection(address, timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.bytes_read = 0
+        self._closed = False
+        self._ended = False
+
+    def next_block(self) -> Optional[RowBlock]:
+        if self._ended:
+            return None
+        self._sock.sendall(struct.pack("<I", _REQ_NEXT))
+        arrays = _recv_arrays(self._sock)
+        if arrays is None:
+            self._ended = True
+            return None
+        self.bytes_read += sum(a.nbytes for a in arrays.values())
+        return RowBlock(
+            offset=arrays["offset"],
+            label=arrays["label"],
+            index=arrays["index"],
+            value=arrays.get("value"),
+            weight=arrays.get("weight"),
+            qid=arrays.get("qid"),
+            field=arrays.get("field"),
+        )
+
+    def __iter__(self):
+        while True:
+            block = self.next_block()
+            if block is None:
+                return
+            yield block
+
+    def before_first(self) -> None:
+        raise DMLCError(
+            "RemoteBlockParser is a one-pass stream; re-create the service "
+            "and parser per epoch (Parser streaming semantics, data.h:298)"
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if not self._ended:
+                self._sock.sendall(struct.pack("<I", _REQ_CLOSE))
+        except OSError:
+            pass
+        self._sock.close()
