@@ -36,27 +36,65 @@ from dmlc_tpu.utils.logging import check
 _NEG_INF = -1e30  # mask value: large-negative beats -inf (0*inf=nan in bwd)
 
 
+def _group_ratio(q, k, v):
+    """Q-heads per KV-head (grouped-query attention). 1 = classic MHA;
+    H % H_kv must divide (llama-class GQA, MQA at H_kv = 1). K and V must
+    agree — the grouped einsums would otherwise silently mis-pair heads
+    (the classic MHA einsum made a mismatch a shape error; keep that)."""
+    h, hk = q.shape[2], k.shape[2]
+    check(k.shape[2] == v.shape[2],
+          "k has %d heads but v has %d", k.shape[2], v.shape[2])
+    check(h % hk == 0, "num_heads %d must divide by num_kv_heads %d", h, hk)
+    return h // hk
+
+
+def _grouped_scores(q, k, scale):
+    """QKᵀ with KV-head grouping: q [B,Tq,H,D] x k [B,Tk,Hk,D] →
+    [B,H,Tq,Tk] (the G = H/Hk query heads of a group share one KV head —
+    no materialized KV repeat)."""
+    b, t_q, h, d = q.shape
+    hk = k.shape[2]
+    qg = q.reshape(b, t_q, hk, h // hk, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * scale
+    return s.reshape(b, h, t_q, k.shape[1])
+
+
+def _grouped_pv(p, v):
+    """probs [B,H,Tq,Tk] x v [B,Tk,Hk,D] → [B,Tq,H,D] under grouping."""
+    b, h, t_q, t_k = p.shape
+    hk = v.shape[2]
+    pg = p.reshape(b, hk, h // hk, t_q, t_k)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", pg, v)
+    return out.reshape(b, t_q, h, v.shape[-1])
+
+
 def full_attention(q, k, v, causal: bool = False):
     """Reference single-device attention: softmax(QKᵀ/√d)V.
 
-    [B, T, H, D] in/out; the parity oracle for the sharded schedules."""
+    q [B, T, H, D]; k/v [B, T, H_kv, D] with H_kv | H (GQA/MQA — H_kv = H
+    is classic MHA); out [B, T, H, D]. The parity oracle for the sharded
+    schedules."""
+    _group_ratio(q, k, v)
     d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(float(d))
+    scores = _grouped_scores(q, k, 1.0 / jnp.sqrt(float(d)))
     if causal:
         t_q, t_k = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return _grouped_pv(probs, v)
 
 
 def _block_accumulate(q, k_blk, v_blk, m, l, o, q_pos, k_pos, causal, scale):
     """One online-softmax block update (the flash-attention recurrence).
 
-    q [B,Tq,H,D]; k_blk/v_blk [B,Tk,H,D]; m,l [B,H,Tq]; o [B,Tq,H,D].
-    q_pos [Tq] / k_pos [Tk] are GLOBAL positions for causal masking.
+    q [B,Tq,H,D]; k_blk/v_blk [B,Tk,Hk,D] with Hk | H (GQA); m,l [B,H,Tq];
+    o [B,Tq,H,D]. q_pos [Tq] / k_pos [Tk] are GLOBAL positions for causal
+    masking. The accumulator stays per Q head — only the score/PV einsums
+    group, so GQA costs nothing extra here (and the ring ships the SMALLER
+    KV shards around the ICI ring: bandwidth ∝ Hk, not H).
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    s = _grouped_scores(q, k_blk, scale)
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
         s = jnp.where(mask[None, None], s, _NEG_INF)
@@ -65,7 +103,7 @@ def _block_accumulate(q, k_blk, v_blk, m, l, o, q_pos, k_pos, causal, scale):
     correction = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+    pv = _grouped_pv(p, v_blk)
     o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -144,7 +182,7 @@ def make_ring_attention(
         denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
         return o / denom
 
-    return jax.jit(
+    _sharded = jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
@@ -152,6 +190,12 @@ def make_ring_attention(
             out_specs=P(None, axis),
         )
     )
+
+    def _wrapped(q, k, v):
+        _group_ratio(q, k, v)  # validate heads before tracing
+        return _sharded(q, k, v)
+
+    return _wrapped
 
 
 def make_ulysses_attention(
@@ -200,6 +244,15 @@ def make_ulysses_attention(
             "ulysses needs heads %% axis_size == 0 (got %d heads over %d)",
             q.shape[2], n_shards,
         )
+        # GQA: KV heads re-shard over the same axis, so they must divide
+        # too (each device then holds H/size query heads against Hk/size
+        # KV heads — the group ratio is preserved locally)
+        check(
+            k.shape[2] % n_shards == 0,
+            "ulysses needs kv_heads %% axis_size == 0 (got %d over %d)",
+            k.shape[2], n_shards,
+        )
+        _group_ratio(q, k, v)
         return _sharded(q, k, v)
 
     _sharded = jax.jit(
@@ -243,6 +296,12 @@ def make_pallas_flash_local(causal: bool = False, block_sizes=None):
         return t
 
     def kernel(q, k, v):
+        # the Pallas kernel wants matched head counts; GQA KV heads are
+        # materialized to H here (local cost ∝ T·H·D — what MHA would pay)
+        if k.shape[2] != q.shape[2]:
+            rep = _group_ratio(q, k, v)
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         scale = 1.0 / math.sqrt(q.shape[-1])
         bs = block_sizes
         if bs is None:
