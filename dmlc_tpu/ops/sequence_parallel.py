@@ -68,35 +68,45 @@ def _grouped_pv(p, v):
     return out.reshape(b, t_q, h, v.shape[-1])
 
 
-def full_attention(q, k, v, causal: bool = False):
+def full_attention(q, k, v, causal: bool = False, window: int = 0):
     """Reference single-device attention: softmax(QKᵀ/√d)V.
 
     q [B, T, H, D]; k/v [B, T, H_kv, D] with H_kv | H (GQA/MQA — H_kv = H
-    is classic MHA); out [B, T, H, D]. The parity oracle for the sharded
-    schedules."""
+    is classic MHA); out [B, T, H, D]. ``window > 0`` adds mistral-style
+    sliding-window masking (query p attends keys in (p-window, p]; implies
+    causal). The parity oracle for the sharded schedules."""
+    check(window >= 0, "window must be >= 0, got %d", window)
     _group_ratio(q, k, v)
+    causal = causal or window > 0
     d = q.shape[-1]
     scores = _grouped_scores(q, k, 1.0 / jnp.sqrt(float(d)))
     if causal:
         t_q, t_k = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
+        qp = jnp.arange(t_q)[:, None]
+        kp = jnp.arange(t_k)[None, :]
+        mask = qp >= kp
+        if window > 0:
+            mask &= (qp - kp) < window
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return _grouped_pv(probs, v)
 
 
-def _block_accumulate(q, k_blk, v_blk, m, l, o, q_pos, k_pos, causal, scale):
+def _block_accumulate(q, k_blk, v_blk, m, l, o, q_pos, k_pos, causal, scale,
+                      window: int = 0):
     """One online-softmax block update (the flash-attention recurrence).
 
     q [B,Tq,H,D]; k_blk/v_blk [B,Tk,Hk,D] with Hk | H (GQA); m,l [B,H,Tq];
     o [B,Tq,H,D]. q_pos [Tq] / k_pos [Tk] are GLOBAL positions for causal
-    masking. The accumulator stays per Q head — only the score/PV einsums
+    and sliding-window masking. The accumulator stays per Q head — only the score/PV einsums
     group, so GQA costs nothing extra here (and the ring ships the SMALLER
     KV shards around the ICI ring: bandwidth ∝ Hk, not H).
     """
     s = _grouped_scores(q, k_blk, scale)
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+        if window > 0:
+            mask &= (q_pos[:, None] - k_pos[None, :]) < window
         s = jnp.where(mask[None, None], s, _NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
     # guard fully-masked rows: exp(-inf - -inf) must not produce nan
@@ -109,7 +119,7 @@ def _block_accumulate(q, k_blk, v_blk, m, l, o, q_pos, k_pos, causal, scale):
 
 
 def make_ring_attention(
-    mesh: Mesh, axis: str = "sp", causal: bool = False
+    mesh: Mesh, axis: str = "sp", causal: bool = False, window: int = 0
 ):
     """Jitted f(q, k, v) -> out with the sequence dim sharded over ``axis``.
 
@@ -118,7 +128,15 @@ def make_ring_attention(
     has seen every key. The accumulator is the online-softmax triple
     (m, l, o), so the result equals exact softmax attention — verified
     against ``full_attention`` — not an approximation.
+
+    ``window > 0`` = mistral-style sliding window (implies causal). Blocks
+    entirely outside every local query's window skip their compute exactly
+    like fully-future causal blocks — at long T with a small window most
+    hops are skips, so wall time approaches O(T·window) while the exact
+    result is preserved.
     """
+    check(window >= 0, "window must be >= 0, got %d", window)
+    causal = causal or window > 0
 
     def _local(q, k, v):
         size = jax.lax.axis_size(axis)
@@ -145,7 +163,7 @@ def make_ring_attention(
         # total, none discarded
         m, l, o = _block_accumulate(
             q, k, v, m, l, o, q_pos, idx * t_local + jnp.arange(t_local),
-            causal, scale,
+            causal, scale, window,
         )
 
         def step(carry, step_idx):
@@ -157,15 +175,25 @@ def make_ring_attention(
             src = (idx - step_idx) % size
             k_pos = src * t_local + jnp.arange(t_local)
             if causal:
-                # a block entirely in this device's future is fully masked:
-                # skip its einsum/exp work (the rotation still runs — the
-                # ring schedule needs every hop). Divergent across devices
-                # by design; no collectives inside the branches.
+                # a block entirely in this device's future is fully masked,
+                # and with a sliding window so is a block entirely OLDER
+                # than every local query's window: skip the einsum/exp work
+                # (the rotation still runs — the ring schedule needs every
+                # hop). Divergent across devices by design; no collectives
+                # inside the branches. Window overlap test: the youngest
+                # key of block src is (src+1)*t_local - 1; the oldest local
+                # query is idx*t_local; attendable iff their distance is
+                # inside the window.
+                needed = src <= idx
+                if window > 0:
+                    needed &= (
+                        idx * t_local - ((src + 1) * t_local - 1)
+                    ) < window
                 m, l, o = jax.lax.cond(
-                    src <= idx,
+                    needed,
                     lambda ops: _block_accumulate(
                         q, ops[0], ops[1], ops[2], ops[3], ops[4],
-                        q_pos, k_pos, causal, scale,
+                        q_pos, k_pos, causal, scale, window,
                     ),
                     lambda ops: (ops[2], ops[3], ops[4]),
                     (k_cur, v_cur, m, l, o),
@@ -199,7 +227,7 @@ def make_ring_attention(
 
 
 def make_ulysses_attention(
-    mesh: Mesh, axis: str = "sp", causal: bool = False,
+    mesh: Mesh, axis: str = "sp", causal: bool = False, window: int = 0,
     local_attention=None,
 ):
     """Jitted f(q, k, v) -> out: all-to-all sequence↔head re-sharding.
@@ -212,10 +240,11 @@ def make_ulysses_attention(
     A custom kernel owns its own masking, so combining ``causal=True``
     with ``local_attention`` is rejected rather than silently dropped.
     """
+    check(window >= 0, "window must be >= 0, got %d", window)
     check(
-        not (causal and local_attention is not None),
-        "pass causality inside your local_attention kernel; the causal "
-        "flag only configures the built-in full_attention",
+        not ((causal or window > 0) and local_attention is not None),
+        "pass causality/windowing inside your local_attention kernel; the "
+        "flags only configure the built-in full_attention",
     )
     n_shards = mesh.shape[axis]
 
@@ -233,7 +262,7 @@ def make_ulysses_attention(
 
         qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
         fn = local_attention if local_attention is not None else partial(
-            full_attention, causal=causal
+            full_attention, causal=causal, window=window
         )
         out = fn(qh, kh, vh)
         return heads_to_seq(out)

@@ -371,3 +371,113 @@ class TestGroupedQueryAttention:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
             )
+
+
+class TestSlidingWindow:
+    """Mistral-style sliding-window attention: query p attends (p-W, p].
+    Oracle = explicit banded mask; the ring schedule must match exactly
+    INCLUDING its block-skip shortcut for out-of-window hops."""
+
+    def _oracle(self, q, k, v, window):
+        d = q.shape[-1]
+        t = q.shape[1]
+        rep = q.shape[2] // k.shape[2]
+        kk = jnp.repeat(k, rep, axis=2)
+        vv = jnp.repeat(v, rep, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(float(d))
+        qp = jnp.arange(t)[:, None]
+        kp = jnp.arange(t)[None, :]
+        mask = (qp >= kp) & ((qp - kp) < window)
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+
+    @pytest.mark.parametrize("window", [1, 5, 16, 1000])
+    def test_full_attention_window_matches_banded_oracle(self, window):
+        rng = np.random.RandomState(30)
+        q = jnp.asarray(rng.randn(2, 24, 4, 8).astype(np.float32))
+        k = jnp.asarray(rng.randn(2, 24, 2, 8).astype(np.float32))
+        v = jnp.asarray(rng.randn(2, 24, 2, 8).astype(np.float32))
+        got = full_attention(q, k, v, window=window)
+        want = self._oracle(q, k, v, window)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("window", [3, 8, 17, 10_000])
+    def test_ring_attention_window(self, window):
+        """Windows smaller than, equal to, straddling, and larger than the
+        per-device shard — the block-skip boundary cases."""
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(31)
+        t = 8 * n
+        q = jnp.asarray(rng.randn(2, t, 4, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(2, t, 2, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(2, t, 2, 16).astype(np.float32))
+        want = full_attention(q, k, v, window=window)
+        ring = make_ring_attention(mesh, window=window)
+        got = ring(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_ulysses_window(self):
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(32)
+        t = 4 * n
+        q = jnp.asarray(rng.randn(1, t, 2 * n, 8).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, t, n, 8).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, t, n, 8).astype(np.float32))
+        want = full_attention(q, k, v, window=7)
+        ulysses = make_ulysses_attention(mesh, window=7)
+        got = ulysses(
+            _shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+
+    def test_negative_window_rejected(self):
+        from dmlc_tpu.utils.logging import DMLCError
+
+        rng = np.random.RandomState(33)
+        q = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))
+        with pytest.raises(DMLCError):
+            full_attention(q, q, q, window=-3)
+        with pytest.raises(DMLCError):
+            make_ring_attention(_mesh(), window=-1)
+
+    def test_ring_window_gradients_match(self):
+        """Gradients through the window-dependent block-skip cond equal the
+        banded-oracle gradients (the skipped branch must thread m/l/o
+        untouched in the backward pass too)."""
+        mesh = _mesh()
+        n = mesh.shape["sp"]
+        rng = np.random.RandomState(34)
+        t = 4 * n
+        q = jnp.asarray(rng.randn(1, t, 4, 8).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, t, 2, 8).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, t, 2, 8).astype(np.float32))
+        window = 5  # straddles shard boundaries at t_local=4
+        ring = make_ring_attention(mesh, window=window)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(
+                ring(_shard_seq(mesh, q), _shard_seq(mesh, k),
+                     _shard_seq(mesh, v)) ** 2
+            )
+
+        def loss_full(q, k, v):
+            return jnp.sum(full_attention(q, k, v, window=window) ** 2)
+
+        g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+            )
