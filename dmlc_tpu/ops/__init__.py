@@ -18,6 +18,8 @@ from dmlc_tpu.ops.sequence_parallel import (
     make_pallas_flash_local,
     make_ring_attention,
     make_ulysses_attention,
+    zigzag_shard,
+    zigzag_unshard,
 )
 
 __all__ = [
@@ -28,4 +30,6 @@ __all__ = [
     "make_pallas_flash_local",
     "make_ring_attention",
     "make_ulysses_attention",
+    "zigzag_shard",
+    "zigzag_unshard",
 ]

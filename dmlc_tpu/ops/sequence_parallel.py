@@ -29,6 +29,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_tpu.utils.logging import check
@@ -118,8 +119,50 @@ def _block_accumulate(q, k_blk, v_blk, m, l, o, q_pos, k_pos, causal, scale,
     return m_new, l_new, o_new
 
 
+
+
+def zigzag_indices(t: int, num_devices: int):
+    """Permutation mapping natural order → zigzag device layout.
+
+    The sequence splits into 2N equal chunks; device i holds chunks
+    (i, 2N-1-i) — one early + one late — so under CAUSAL masking every
+    device does the same total score work per ring hop. With the
+    contiguous layout device 0's queries see almost nothing and device
+    N-1's see everything: the ring runs in lockstep, so the most-loaded
+    device sets every hop's wall time and half the fleet idles. Zigzag is
+    the standard fix (llama-class context-parallel training).
+    """
+    check(t % (2 * num_devices) == 0,
+          "seq len %d must divide by 2*num_devices (%d)", t, 2 * num_devices)
+    c = t // (2 * num_devices)
+    order = []
+    for i in range(num_devices):
+        order.extend(range(i * c, (i + 1) * c))
+        j = 2 * num_devices - 1 - i
+        order.extend(range(j * c, (j + 1) * c))
+    return np.asarray(order, dtype=np.int32)
+
+
+def zigzag_shard(x, num_devices: int):
+    """Reorder [B, T, ...] from natural to zigzag layout (device i's
+    contiguous shard then holds chunks i and 2N-1-i). Apply BEFORE
+    sequence-sharding the array over the mesh axis; activations can stay
+    in this layout across layers so the cost is paid once."""
+    return jnp.take(x, jnp.asarray(zigzag_indices(x.shape[1], num_devices)),
+                    axis=1)
+
+
+def zigzag_unshard(x, num_devices: int):
+    """Inverse of :func:`zigzag_shard`."""
+    perm = zigzag_indices(x.shape[1], num_devices)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=perm.dtype)
+    return jnp.take(x, jnp.asarray(inv), axis=1)
+
+
 def make_ring_attention(
-    mesh: Mesh, axis: str = "sp", causal: bool = False, window: int = 0
+    mesh: Mesh, axis: str = "sp", causal: bool = False, window: int = 0,
+    layout: str = "contiguous",
 ):
     """Jitted f(q, k, v) -> out with the sequence dim sharded over ``axis``.
 
@@ -136,14 +179,34 @@ def make_ring_attention(
     result is preserved.
     """
     check(window >= 0, "window must be >= 0, got %d", window)
+    check(layout in ("contiguous", "zigzag"),
+          "layout must be 'contiguous' or 'zigzag', got %r", layout)
     causal = causal or window > 0
+    zigzag = layout == "zigzag"
 
     def _local(q, k, v):
         size = jax.lax.axis_size(axis)
         idx = jax.lax.axis_index(axis)
         b, t_local, h, d = q.shape
         scale = 1.0 / jnp.sqrt(float(d))
-        q_pos = idx * t_local + jnp.arange(t_local)
+
+        if zigzag:
+            # device dev holds chunks (dev, 2N-1-dev) of 2N chunks: one
+            # early + one late, so causal score work is equal on every
+            # device (inputs must be pre-permuted with zigzag_shard)
+            c = t_local // 2
+
+            def dev_pos(dev):
+                return jnp.concatenate([
+                    dev * c + jnp.arange(c),
+                    (2 * size - 1 - dev) * c + jnp.arange(c),
+                ])
+        else:
+
+            def dev_pos(dev):
+                return dev * t_local + jnp.arange(t_local)
+
+        q_pos = dev_pos(idx)
 
         # pcast-to-varying: fresh constants enter the scan carry as
         # device-varying values (the step output varies over the axis)
@@ -162,8 +225,7 @@ def make_ring_attention(
         # and each scan step rotates THEN consumes — size-1 rotations
         # total, none discarded
         m, l, o = _block_accumulate(
-            q, k, v, m, l, o, q_pos, idx * t_local + jnp.arange(t_local),
-            causal, scale, window,
+            q, k, v, m, l, o, q_pos, dev_pos(idx), causal, scale, window,
         )
 
         def step(carry, step_idx):
@@ -173,8 +235,8 @@ def make_ring_attention(
             # after `step_idx` rotations this device holds the shard that
             # started at ring position (idx - step_idx) mod size
             src = (idx - step_idx) % size
-            k_pos = src * t_local + jnp.arange(t_local)
-            if causal:
+            k_pos = dev_pos(src)
+            if causal and not zigzag:
                 # a block entirely in this device's future is fully masked,
                 # and with a sliding window so is a block entirely OLDER
                 # than every local query's window: skip the einsum/exp work
@@ -198,9 +260,40 @@ def make_ring_attention(
                     lambda ops: (ops[2], ops[3], ops[4]),
                     (k_cur, v_cur, m, l, o),
                 )
+            elif causal and window > 0:
+                # zigzag + window: a hop IS fully masked when both of the
+                # block's chunks fall outside every local query's window.
+                # Per (q chunk, k chunk) pair the banded mask has a hit
+                # iff q_hi >= k_lo (causal reach) and q_lo - k_hi < W
+                # (window reach); the hop is needed if any of the 4 pairs
+                # hits — keeps the documented O(T·W) walltime under zigzag
+                def chunk_ranges(dev):
+                    early = (dev * c, (dev + 1) * c - 1)
+                    late = ((2 * size - 1 - dev) * c,
+                            (2 * size - dev) * c - 1)
+                    return (early, late)
+
+                needed = False
+                for qlo, qhi in chunk_ranges(idx):
+                    for klo, khi in chunk_ranges(src):
+                        needed |= (qhi >= klo) & ((qlo - khi) < window)
+                m, l, o = jax.lax.cond(
+                    needed,
+                    lambda ops: _block_accumulate(
+                        q, ops[0], ops[1], ops[2], ops[3], ops[4],
+                        q_pos, k_pos, causal, scale, window,
+                    ),
+                    lambda ops: (ops[2], ops[3], ops[4]),
+                    (k_cur, v_cur, m, l, o),
+                )
             else:
+                # zigzag pure-causal: no hop is ever fully masked (every
+                # device holds an early chunk every other device's late
+                # queries can see) — the BALANCE is the optimization;
+                # positions make the masking exact
                 m, l, o = _block_accumulate(
-                    q, k_cur, v_cur, m, l, o, q_pos, k_pos, causal, scale
+                    q, k_cur, v_cur, m, l, o, q_pos, k_pos, causal, scale,
+                    window,
                 )
             return (k_cur, v_cur, m, l, o), None
 
@@ -221,6 +314,11 @@ def make_ring_attention(
 
     def _wrapped(q, k, v):
         _group_ratio(q, k, v)  # validate heads before tracing
+        if zigzag:
+            n = mesh.shape[axis]
+            check(q.shape[1] % (2 * n) == 0,
+                  "zigzag needs seq len %% 2*axis_size == 0 (T=%d, n=%d)",
+                  q.shape[1], n)
         return _sharded(q, k, v)
 
     return _wrapped
