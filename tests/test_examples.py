@@ -9,14 +9,16 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
 
 
-def _run(argv, timeout=120):
+def _run(argv, timeout=120, extra_env=None):
     return subprocess.run(
-        argv, capture_output=True, text=True, timeout=timeout, env=ENV
+        argv, capture_output=True, text=True, timeout=timeout,
+        env={**ENV, **(extra_env or {})},
     )
 
 
@@ -82,3 +84,37 @@ class TestDistributedSGDExample:
         ls, lm = losses(single.stdout + single.stderr), \
             losses(multi.stdout + multi.stderr)
         assert ls and ls == lm, (ls, lm)
+
+
+class TestLongContextExample:
+    @pytest.mark.parametrize("kv_heads,expect_ulysses", [
+        ("8", True),   # MHA: heads divide over the axis -> ulysses runs
+        ("2", False),  # GQA ratio 4: the grouped ring paths are exercised
+    ])
+    def test_runs_all_schedules_on_virtual_mesh(self, kv_heads,
+                                                expect_ulysses):
+        proc = _run(
+            [sys.executable, os.path.join(REPO, "examples", "long_context.py"),
+             "--seq", "128", "--heads", "8", "--kv-heads", kv_heads],
+            timeout=280,
+            extra_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "all schedules match exact attention" in proc.stdout
+        if expect_ulysses:
+            assert "ulysses all-to-all" in proc.stdout
+        else:
+            assert "ulysses skipped" in proc.stdout
+
+    def test_too_small_seq_gets_clear_error(self):
+        proc = _run(
+            [sys.executable, os.path.join(REPO, "examples", "long_context.py"),
+             "--seq", "8"],
+            extra_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+        )
+        assert proc.returncode == 2
+        assert "smaller than 2*num_devices" in proc.stderr
