@@ -13,6 +13,12 @@ from dmlc_tpu.ops.spmv import (
     spmv_transpose,
     make_sharded_spmv,
 )
+from dmlc_tpu.ops.moe import (
+    init_moe_params,
+    make_moe_layer,
+    moe_dense_oracle,
+    shard_moe_params,
+)
 from dmlc_tpu.ops.sequence_parallel import (
     full_attention,
     make_pallas_flash_local,
@@ -32,4 +38,8 @@ __all__ = [
     "make_ulysses_attention",
     "zigzag_shard",
     "zigzag_unshard",
+    "init_moe_params",
+    "make_moe_layer",
+    "moe_dense_oracle",
+    "shard_moe_params",
 ]

@@ -1,0 +1,178 @@
+"""Expert parallelism: switch-style MoE dispatch over a mesh axis.
+
+The reference predates mixture-of-experts training (SURVEY §2.9 lists no
+EP); this realizes the documented extension point the TPU-first way, the
+same stance as ``sequence_parallel``:
+
+- experts are SHARDED over the ``ep`` mesh axis (each device owns
+  ``num_experts / ep_size`` expert FFNs — model memory scales out);
+- tokens stay sharded over the same axis (data-parallel token shards);
+- routing is top-1 softmax gating with a STATIC per-(device, expert)
+  capacity (XLA needs static shapes — the standard switch-transformer
+  bucketing; over-capacity tokens pass through the residual with zero
+  expert output, never a recompile);
+- dispatch/return ride ONE ``all_to_all`` each way over the axis
+  ([E, C, D] grouped by owning device), the canonical TPU MoE exchange —
+  ICI bandwidth, no host involvement.
+
+Parity oracle: ``moe_dense_oracle`` applies every token's routed expert
+directly (no capacity, one device); with capacity ≥ tokens the sharded
+layer must match it exactly (tests/test_moe.py, 8-device mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.utils.logging import check
+
+
+def init_moe_params(
+    num_experts: int, d_model: int, d_hidden: int, seed: int = 0
+) -> Dict:
+    """{"wg": [D, E], "w1": [E, D, H], "w2": [E, H, D]} — wg replicated,
+    w1/w2 sharded over ep on the expert dim by the layer."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    s1 = 1.0 / np.sqrt(d_model)
+    s2 = 1.0 / np.sqrt(d_hidden)
+    return {
+        "wg": jax.random.normal(k1, (d_model, num_experts)) * s1,
+        "w1": jax.random.normal(k2, (num_experts, d_model, d_hidden)) * s1,
+        "w2": jax.random.normal(k3, (num_experts, d_hidden, d_model)) * s2,
+    }
+
+
+def _route_top1(x, wg, num_experts: int, capacity: int):
+    """Top-1 routing with static capacity → (dispatch, combine, aux).
+
+    x [T, D] (local tokens). dispatch [T, E, C] one-hot; combine the same
+    scaled by the gate probability. Tokens beyond an expert's capacity get
+    all-zero rows (dropped — residual handles them upstream). aux is the
+    switch load-balancing loss (mean fraction·prob product, scaled by E)."""
+    gates = jax.nn.softmax(x @ wg, axis=-1)  # [T, E]
+    expert = jnp.argmax(gates, axis=-1)  # [T]
+    prob = jnp.take_along_axis(gates, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)  # [T, E]
+    # position of each token within its expert's bucket (exclusive cumsum)
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # [T, E]
+    pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+    keep = pos < capacity
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :]
+        * keep[:, None, None]
+    )  # [T, E, C]
+    combine = dispatch * prob[:, None, None]
+    # switch aux loss: E * mean_e(frac_tokens_e * mean_prob_e)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(gates, axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_dense_oracle(params: Dict, x):
+    """Single-device reference: every token through its top-1 expert, no
+    capacity limit. [B, T, D] -> ([B, T, D], aux)."""
+    b, t, d = x.shape
+    xt = x.reshape(b * t, d)
+    gates = jax.nn.softmax(xt @ params["wg"], axis=-1)
+    expert = jnp.argmax(gates, axis=-1)
+    prob = jnp.take_along_axis(gates, expert[:, None], axis=-1)[:, 0]
+    w1 = params["w1"][expert]  # [T, D, H]
+    w2 = params["w2"][expert]  # [T, H, D]
+    h = jax.nn.gelu(jnp.einsum("td,tdh->th", xt, w1))
+    y = jnp.einsum("th,thd->td", h, w2) * prob[:, None]
+    num_experts = params["wg"].shape[1]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)
+    aux = num_experts * jnp.sum(
+        jnp.mean(onehot, axis=0) * jnp.mean(gates, axis=0)
+    )
+    return y.reshape(b, t, d), aux
+
+
+def make_moe_layer(
+    mesh: Mesh,
+    num_experts: int,
+    capacity: int,
+    axis: str = "ep",
+):
+    """Jitted f(params, x[B, T, D]) -> (y[B, T, D], aux_loss).
+
+    Tokens sharded over ``axis`` on T; expert weights sharded over the
+    expert dim. ``capacity`` is PER (device, expert): each device may send
+    at most ``capacity`` of its local tokens to any one expert (static
+    shapes — raise it toward local_tokens for a no-drop guarantee).
+    """
+    ep = mesh.shape[axis]
+    check(num_experts % ep == 0,
+          "num_experts %d must divide over axis size %d", num_experts, ep)
+    e_local = num_experts // ep
+
+    def _local(params, x):
+        b, t_local, d = x.shape
+        xt = x.reshape(b * t_local, d)
+        dispatch, combine, aux = _route_top1(
+            xt, params["wg"], num_experts, capacity
+        )
+        # gather expert inputs: [E, C, D] with experts numbered
+        # contiguously per owning device (expert e lives on device
+        # e // e_local)
+        xd = jnp.einsum("tec,td->ecd", dispatch, xt)
+        # ONE all_to_all each way: trade "my tokens for every expert" for
+        # "every device's tokens for my experts". split_axis=0 sends
+        # slice [dst] to device dst; the received stack's leading axis
+        # indexes the SOURCE device.
+        xd = xd.reshape(ep, e_local, capacity, d)
+        xd = jax.lax.all_to_all(xd, axis, split_axis=0, concat_axis=0)
+        # [ep(source), e_local, C, D] -> [e_local, ep*C, D]: every
+        # device's buckets for my experts, grouped per expert
+        xd = xd.transpose(1, 0, 2, 3).reshape(e_local, ep * capacity, d)
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xd, params["w1"]))
+        y = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+        # reverse exchange: slice [dst] = expert outputs for device dst's
+        # tokens; received stack = my tokens' outputs by owner device,
+        # which is exactly global expert order (contiguous per device)
+        y = y.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
+        y = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
+        y = y.reshape(num_experts, capacity, d)
+        out = jnp.einsum("tec,ecd->td", combine, y)
+        # aux is the mean of per-shard switch losses (each shard balances
+        # its own routing mix — the standard distributed-MoE practice;
+        # equals the global loss only when shards route identically)
+        aux = jax.lax.pmean(aux, axis_name=axis)
+        return out.reshape(b, t_local, d), aux
+
+    sharded = jax.jit(
+        jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(
+                {"wg": P(), "w1": P(axis), "w2": P(axis)},
+                P(None, axis),
+            ),
+            out_specs=(P(None, axis), P()),
+        )
+    )
+
+    def _wrapped(params, x):
+        check(x.shape[1] % ep == 0,
+              "token dim %d must divide over axis size %d", x.shape[1], ep)
+        return sharded(params, x)
+
+    return _wrapped
+
+
+def shard_moe_params(params: Dict, mesh: Mesh, axis: str = "ep") -> Dict:
+    """Place params for :func:`make_moe_layer`: expert weights sharded on
+    the expert dim, gate replicated — each device materializes only its
+    own experts' FFNs."""
+    return {
+        "wg": jax.device_put(params["wg"], NamedSharding(mesh, P())),
+        "w1": jax.device_put(params["w1"], NamedSharding(mesh, P(axis))),
+        "w2": jax.device_put(params["w2"], NamedSharding(mesh, P(axis))),
+    }

@@ -1,0 +1,146 @@
+"""Expert parallelism (ops/moe.py): switch-style top-1 MoE with experts
+sharded over the ep axis, all_to_all dispatch, parity vs the dense oracle.
+
+The reference predates MoE (SURVEY §2.9 EP: absent); like sequence
+parallelism, this is the documented extension point realized TPU-first.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.ops.moe import (
+    init_moe_params,
+    make_moe_layer,
+    moe_dense_oracle,
+    shard_moe_params,
+)
+from dmlc_tpu.utils.logging import DMLCError
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()), ("ep",))
+
+
+class TestMoE:
+    def test_sharded_matches_dense_oracle(self):
+        """Generous capacity (no drops): the 8-device all_to_all pipeline
+        must equal per-token dense expert application exactly."""
+        mesh = _mesh()
+        E, D, H, B, T = 16, 8, 16, 2, 64
+        params = init_moe_params(E, D, H, seed=1)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(B, T, D).astype(np.float32))
+        want, _ = moe_dense_oracle(params, x)
+        layer = make_moe_layer(mesh, E, capacity=T)
+        got, aux = layer(
+            shard_moe_params(params, mesh),
+            jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+        assert float(aux) > 0
+
+    def test_expert_weights_are_sharded(self):
+        """Each device materializes only its own experts' FFN weights —
+        the model-memory scale-out EP exists for."""
+        mesh = _mesh()
+        n = mesh.shape["ep"]
+        E, D, H = 16, 8, 16
+        sp = shard_moe_params(init_moe_params(E, D, H), mesh)
+        shard = sp["w1"].addressable_shards[0].data
+        assert shard.shape[0] == E // n
+
+    def test_aux_is_mean_of_per_shard_losses(self):
+        """The distributed aux loss = mean over token shards of each
+        shard's local switch loss (documented semantic)."""
+        mesh = _mesh()
+        n = mesh.shape["ep"]
+        E, D, H, B, T = 8, 8, 16, 1, 8 * n
+        params = init_moe_params(E, D, H, seed=3)
+        x = jnp.asarray(
+            np.random.RandomState(3).randn(B, T, D).astype(np.float32))
+        layer = make_moe_layer(mesh, E, capacity=T)
+        _, aux = layer(
+            shard_moe_params(params, mesh),
+            jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+        )
+        t_local = T // n
+        locals_ = []
+        for s in range(n):
+            xs = x[:, s * t_local:(s + 1) * t_local]
+            _, a = moe_dense_oracle(params, xs)
+            locals_.append(float(a))
+        np.testing.assert_allclose(float(aux), np.mean(locals_), rtol=1e-5)
+
+    def test_capacity_drops_are_zero_not_garbage(self):
+        """Tokens beyond an expert's per-device capacity contribute zero
+        output (residual handles them) — never another token's value."""
+        mesh = _mesh()
+        E, D, H, B = 8, 8, 16, 1
+        n = mesh.shape["ep"]
+        T = 8 * n
+        params = init_moe_params(E, D, H, seed=4)
+        # a gate that routes EVERYTHING to expert 0: positive inputs with
+        # wg column 0 positive (a linear gate cannot be made constant, so
+        # make x @ wg[:, 0] > 0 for every token instead)
+        params = dict(params)
+        params["wg"] = jnp.zeros_like(params["wg"]).at[:, 0].set(10.0)
+        x = jnp.asarray(
+            np.abs(np.random.RandomState(4).randn(B, T, D)).astype(
+                np.float32) + 0.1)
+        layer = make_moe_layer(mesh, E, capacity=1)  # one slot per device
+        got, _ = layer(
+            shard_moe_params(params, mesh),
+            jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+        )
+        got = np.asarray(got)
+        t_local = T // n
+        # per shard: exactly the first token got through; the rest are 0
+        for s in range(n):
+            sl = got[0, s * t_local:(s + 1) * t_local]
+            assert np.any(sl[0] != 0.0)
+            np.testing.assert_array_equal(sl[1:], 0.0)
+
+    def test_gradients_flow_and_match_oracle(self):
+        mesh = _mesh()
+        E, D, H, B, T = 8, 8, 8, 1, 32
+        params = init_moe_params(E, D, H, seed=5)
+        x = jnp.asarray(
+            np.random.RandomState(5).randn(B, T, D).astype(np.float32))
+        layer = make_moe_layer(mesh, E, capacity=T)
+
+        def loss_sharded(p):
+            y, _ = layer(
+                shard_moe_params(p, mesh),
+                jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+            )
+            return jnp.sum(jnp.asarray(y) ** 2)
+
+        def loss_dense(p):
+            y, _ = moe_dense_oracle(p, x)
+            return jnp.sum(y ** 2)
+
+        g1 = jax.grad(loss_sharded)(params)
+        g2 = jax.grad(loss_dense)(params)
+        # expert FFN grads must agree (gate grads differ by design: the
+        # oracle has no capacity/dispatch graph)
+        for key in ("w1", "w2"):
+            np.testing.assert_allclose(
+                np.asarray(g1[key]), np.asarray(g2[key]),
+                rtol=2e-3, atol=2e-4,
+            )
+
+    def test_validation(self):
+        mesh = _mesh()
+        n = mesh.shape["ep"]
+        with pytest.raises(DMLCError):
+            make_moe_layer(mesh, n + 1, capacity=4)  # experts don't divide
+        layer = make_moe_layer(mesh, 2 * n, capacity=4)
+        params = shard_moe_params(init_moe_params(2 * n, 4, 8), mesh)
+        bad = jnp.zeros((1, n + 1, 4))  # tokens don't divide
+        with pytest.raises(DMLCError):
+            layer(params, bad)
