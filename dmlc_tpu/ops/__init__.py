@@ -19,6 +19,11 @@ from dmlc_tpu.ops.moe import (
     moe_dense_oracle,
     shard_moe_params,
 )
+from dmlc_tpu.ops.pipeline_parallel import (
+    make_pipeline,
+    pipeline_oracle,
+    shard_pipeline_params,
+)
 from dmlc_tpu.ops.sequence_parallel import (
     full_attention,
     make_pallas_flash_local,
@@ -42,4 +47,7 @@ __all__ = [
     "make_moe_layer",
     "moe_dense_oracle",
     "shard_moe_params",
+    "make_pipeline",
+    "pipeline_oracle",
+    "shard_pipeline_params",
 ]

@@ -1,0 +1,126 @@
+"""Pipeline parallelism (ops/pipeline_parallel.py): GPipe microbatch
+schedule over the pp axis must equal sequential stage folding exactly,
+forward and backward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from dmlc_tpu.ops.pipeline_parallel import (
+    make_pipeline,
+    pipeline_oracle,
+    shard_pipeline_params,
+)
+from dmlc_tpu.utils.logging import DMLCError
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()), ("pp",))
+
+
+def _mlp_stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _params(rng, n, d):
+    return {
+        "w": jnp.asarray(rng.randn(n, d, d).astype(np.float32) * 0.3),
+        "b": jnp.asarray(rng.randn(n, d).astype(np.float32) * 0.1),
+    }
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("microbatches", [1, 4, 8])
+    def test_matches_sequential_oracle(self, microbatches):
+        mesh = _mesh()
+        n = mesh.shape["pp"]
+        rng = np.random.RandomState(0)
+        d, batch = 16, 32
+        params = _params(rng, n, d)
+        x = jnp.asarray(rng.randn(batch, d).astype(np.float32))
+        want = pipeline_oracle(_mlp_stage, params, x)
+        pipe = make_pipeline(mesh, _mlp_stage, microbatches)
+        got = pipe(shard_pipeline_params(params, mesh), x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+
+    def test_stage_weights_are_sharded(self):
+        mesh = _mesh()
+        n = mesh.shape["pp"]
+        params = shard_pipeline_params(
+            _params(np.random.RandomState(1), n, 8), mesh
+        )
+        assert params["w"].addressable_shards[0].data.shape[0] == 1
+
+    def test_gradients_match_oracle(self):
+        mesh = _mesh()
+        n = mesh.shape["pp"]
+        rng = np.random.RandomState(2)
+        d, batch = 8, 16
+        params = _params(rng, n, d)
+        x = jnp.asarray(rng.randn(batch, d).astype(np.float32))
+        pipe = make_pipeline(mesh, _mlp_stage, num_microbatches=4)
+
+        def loss_pipe(p):
+            return jnp.sum(
+                jnp.asarray(pipe(shard_pipeline_params(p, mesh), x)) ** 2
+            )
+
+        def loss_seq(p):
+            return jnp.sum(pipeline_oracle(_mlp_stage, p, x) ** 2)
+
+        g1 = jax.grad(loss_pipe)(params)
+        g2 = jax.grad(loss_seq)(params)
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(g1[key]), np.asarray(g2[key]),
+                rtol=2e-3, atol=2e-4,
+            )
+
+    def test_validation(self):
+        mesh = _mesh()
+        n = mesh.shape["pp"]
+        pipe = make_pipeline(mesh, _mlp_stage, num_microbatches=4)
+        rng = np.random.RandomState(3)
+        with pytest.raises(DMLCError):  # wrong stage count
+            pipe(_params(rng, n + 1, 8),
+                 jnp.zeros((8, 8), dtype=jnp.float32))
+        with pytest.raises(DMLCError):  # batch doesn't divide
+            pipe(shard_pipeline_params(_params(rng, n, 8), mesh),
+                 jnp.zeros((7, 8), dtype=jnp.float32))
+
+
+    def test_zero_singular_stage_keeps_finite_gradients(self):
+        """Fill/drain ticks must not run stage fns on zero garbage: a
+        normalization stage (norm(0) = 0 -> NaN) has to keep finite
+        gradients equal to the sequential oracle's (the 0*NaN VJP trap)."""
+        mesh = _mesh()
+        n = mesh.shape["pp"]
+        rng = np.random.RandomState(4)
+        d, batch = 8, 16
+
+        def norm_stage(p, x):
+            return (x / jnp.linalg.norm(x, axis=-1, keepdims=True)) @ p["w"]
+
+        params = {"w": jnp.asarray(
+            rng.randn(n, d, d).astype(np.float32) * 0.5)}
+        x = jnp.asarray(rng.randn(batch, d).astype(np.float32))
+        pipe = make_pipeline(mesh, norm_stage, num_microbatches=4)
+
+        def loss_pipe(p):
+            return jnp.sum(
+                jnp.asarray(pipe(shard_pipeline_params(p, mesh), x)) ** 2
+            )
+
+        def loss_seq(p):
+            return jnp.sum(pipeline_oracle(norm_stage, p, x) ** 2)
+
+        g1 = jax.grad(loss_pipe)(params)["w"]
+        g2 = jax.grad(loss_seq)(params)["w"]
+        assert np.all(np.isfinite(np.asarray(g1)))
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), rtol=2e-3, atol=2e-4
+        )
