@@ -100,6 +100,7 @@ def make_moe_layer(
     num_experts: int,
     capacity: int,
     axis: str = "ep",
+    batch_axis=None,
 ):
     """Jitted f(params, x[B, T, D]) -> (y[B, T, D], aux_loss).
 
@@ -107,6 +108,10 @@ def make_moe_layer(
     expert dim. ``capacity`` is PER (device, expert): each device may send
     at most ``capacity`` of its local tokens to any one expert (static
     shapes — raise it toward local_tokens for a no-drop guarantee).
+    ``batch_axis`` (a second mesh axis) composes data parallelism: place x
+    with P(batch_axis, axis) and each dp shard routes its own tokens
+    independently (expert weights replicated across dp; aux averaged over
+    both axes).
     """
     ep = mesh.shape[axis]
     check(num_experts % ep == 0,
@@ -143,19 +148,24 @@ def make_moe_layer(
         out = jnp.einsum("tec,ecd->td", combine, y)
         # aux is the mean of per-shard switch losses (each shard balances
         # its own routing mix — the standard distributed-MoE practice;
-        # equals the global loss only when shards route identically)
+        # equals the global loss only when shards route identically).
+        # Averaged over every token-sharding axis so it is replicated.
         aux = jax.lax.pmean(aux, axis_name=axis)
+        if batch_axis is not None:
+            aux = jax.lax.pmean(aux, axis_name=batch_axis)
         return out.reshape(b, t_local, d), aux
 
+    # batch_axis composes dp on a multi-axis mesh (each dp-shard routes
+    # its own tokens; expert weights stay replicated across dp)
     sharded = jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
             in_specs=(
                 {"wg": P(), "w1": P(axis), "w2": P(axis)},
-                P(None, axis),
+                P(batch_axis, axis),
             ),
-            out_specs=(P(None, axis), P()),
+            out_specs=(P(batch_axis, axis), P()),
         )
     )
 

@@ -47,15 +47,18 @@ def make_pipeline(
     stage_fn: Callable,
     num_microbatches: int,
     axis: str = "pp",
+    batch_axis=None,
 ):
     """Jitted f(params, x[batch, ...]) -> y with GPipe microbatch schedule.
 
     ``stage_fn(stage_params, act) -> act`` is one stage (shapes preserved);
     ``params`` leaves have leading dim = axis size (one stage per device,
     sharded P(axis) by :func:`shard_pipeline_params`). ``x``'s batch dim
-    must divide into ``num_microbatches``. x/y are replicated across the
-    axis (the demo contract — a production feed would stream stage-0 input
-    shards; the schedule itself is unchanged).
+    must divide into ``num_microbatches``. With ``batch_axis=None`` x/y
+    are replicated across the axis; with ``batch_axis="dp"`` (a second
+    mesh axis) the batch dim shards over it — pass x placed P(batch_axis)
+    — and each dp shard streams its own microbatches, so the PER-SHARD
+    batch must divide ``num_microbatches``.
     """
     n_stages = mesh.shape[axis]
     m = num_microbatches
@@ -113,12 +116,14 @@ def make_pipeline(
         )
         return outputs.reshape(batch, *x.shape[1:])
 
+    # batch_axis composes dp: each dp-shard streams its own microbatches
+    # through the same per-device stages
     sharded = jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(axis), P()),
-            out_specs=P(),
+            in_specs=(P(axis), P(batch_axis)),
+            out_specs=P(batch_axis),
         )
     )
 
@@ -126,8 +131,16 @@ def make_pipeline(
         leading = jax.tree_util.tree_leaves(params)[0].shape[0]
         check(leading == n_stages,
               "params lead dim %d != pipeline stages %d", leading, n_stages)
-        check(x.shape[0] % m == 0,
-              "batch %d must divide into %d microbatches", x.shape[0], m)
+        # the constraint is per batch shard: each dp shard streams its own
+        # microbatches
+        dp = mesh.shape[batch_axis] if batch_axis is not None else 1
+        check(x.shape[0] % dp == 0,
+              "batch %d must divide over %s size %d", x.shape[0],
+              batch_axis, dp)
+        local_batch = x.shape[0] // dp
+        check(local_batch % m == 0 and local_batch >= m,
+              "per-shard batch %d must divide into %d microbatches",
+              local_batch, m)
         return sharded(params, x)
 
     return _wrapped

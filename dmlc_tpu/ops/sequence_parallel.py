@@ -162,7 +162,7 @@ def zigzag_unshard(x, num_devices: int):
 
 def make_ring_attention(
     mesh: Mesh, axis: str = "sp", causal: bool = False, window: int = 0,
-    layout: str = "contiguous",
+    layout: str = "contiguous", batch_axis=None,
 ):
     """Jitted f(q, k, v) -> out with the sequence dim sharded over ``axis``.
 
@@ -177,6 +177,10 @@ def make_ring_attention(
     like fully-future causal blocks — at long T with a small window most
     hops are skips, so wall time approaches O(T·window) while the exact
     result is preserved.
+
+    ``batch_axis`` (a second mesh axis) composes data parallelism: place
+    q/k/v with P(batch_axis, axis) and each dp shard runs an independent
+    ring over its own batch rows.
     """
     check(window >= 0, "window must be >= 0, got %d", window)
     check(layout in ("contiguous", "zigzag"),
@@ -303,12 +307,16 @@ def make_ring_attention(
         denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
         return o / denom
 
+    # batch_axis composes data parallelism on a multi-axis mesh: the
+    # batch dim shards over it while seq shards over ``axis`` (each
+    # dp-shard runs its own independent ring — no cross-talk)
+    spec = P(batch_axis, axis)
     _sharded = jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-            out_specs=P(None, axis),
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
         )
     )
 
@@ -326,7 +334,7 @@ def make_ring_attention(
 
 def make_ulysses_attention(
     mesh: Mesh, axis: str = "sp", causal: bool = False, window: int = 0,
-    local_attention=None,
+    local_attention=None, batch_axis=None,
 ):
     """Jitted f(q, k, v) -> out: all-to-all sequence↔head re-sharding.
 
@@ -337,6 +345,8 @@ def make_ulysses_attention(
 
     A custom kernel owns its own masking, so combining ``causal=True``
     with ``local_attention`` is rejected rather than silently dropped.
+    ``batch_axis`` composes data parallelism exactly as in
+    :func:`make_ring_attention`.
     """
     check(window >= 0, "window must be >= 0, got %d", window)
     check(
@@ -382,12 +392,13 @@ def make_ulysses_attention(
         _group_ratio(q, k, v)
         return _sharded(q, k, v)
 
+    u_spec = P(batch_axis, axis)
     _sharded = jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-            out_specs=P(None, axis),
+            in_specs=(u_spec, u_spec, u_spec),
+            out_specs=u_spec,
             # pallas_call out_shapes carry no varying-mesh-axes metadata,
             # so custom kernels cannot pass the vma check
             check_vma=local_attention is None,
