@@ -118,3 +118,17 @@ class TestLongContextExample:
         )
         assert proc.returncode == 2
         assert "smaller than 2*num_devices" in proc.stderr
+
+
+class TestMoETransformerExample:
+    def test_block_matches_single_device(self):
+        proc = _run(
+            [sys.executable,
+             os.path.join(REPO, "examples", "moe_transformer.py")],
+            timeout=280,
+            extra_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "block matches the single-device reference" in proc.stdout
