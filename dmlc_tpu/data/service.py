@@ -106,6 +106,7 @@ class BlockService:
         self._lock = threading.Lock()  # serializes parser pulls (the shard
         # point: one block goes to exactly one consumer)
         self._done = False
+        self._drained = threading.Event()  # set when the stream is exhausted
         self.blocks_served = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -113,6 +114,7 @@ class BlockService:
         self._sock.listen(64)
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
         self._threads: list = []
+        self._conns: list = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="block-service"
         )
@@ -127,6 +129,7 @@ class BlockService:
             block = self._parser.next_block()
             if block is None:
                 self._done = True
+                self._drained.set()
                 return None
             self.blocks_served += 1
         out = {}
@@ -137,6 +140,7 @@ class BlockService:
         return out
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        self._conns.append(conn)
         try:
             while True:
                 (req,) = struct.unpack("<I", _recv_exact(conn, 4))
@@ -164,11 +168,25 @@ class BlockService:
             t.start()
             self._threads.append(t)
 
+    def wait(self) -> None:
+        """Block until the stream is exhausted AND every connection that
+        consumed it has finished — the CLI server's natural exit point."""
+        self._drained.wait()
+        for t in list(self._threads):
+            t.join()
+
     def close(self) -> None:
         try:
             self._sock.close()
         except OSError:
             pass
+        # closing live connections wakes threads blocked in recv — exit is
+        # prompt instead of a join-timeout per idle consumer
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
         for t in self._threads:
             t.join(timeout=5)
         self._parser.close()

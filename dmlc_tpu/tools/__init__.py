@@ -39,6 +39,7 @@ _COMMANDS = {
     "dataiter": "dmlc_tpu.tools.dataiter",
     "strtonum": "dmlc_tpu.tools.strtonum",
     "rowrec": "dmlc_tpu.tools.rowrec",
+    "serve": "dmlc_tpu.tools.serve",
 }
 
 

@@ -1,0 +1,59 @@
+"""Block-service CLI: run a disaggregated parse host from the shell.
+
+The tf.data-service operational surface for dmlc_tpu/data/service.py: one
+process parses a dataset (any URI/format the parsers accept) and serves
+finished RowBlocks over TCP with dynamic sharding; consumers attach with
+``RemoteBlockParser(addr)`` (or a DeviceFeed over it) from anywhere.
+
+Usage::
+
+    python -m dmlc_tpu.tools serve <uri> [--host H] [--port P]
+        [--format auto|libsvm|libfm|csv|recordio] [--nthread N] [--linger]
+
+Prints ``serving HOST PORT`` on stdout once listening. Exits when the
+stream is exhausted and consumers have drained (--linger keeps serving
+end-of-stream markers to late consumers until killed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from dmlc_tpu.data import BlockService, create_parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("uri")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--format", default="auto",
+                    choices=["auto", "libsvm", "libfm", "csv", "recordio"])
+    ap.add_argument("--nthread", type=int, default=2)
+    ap.add_argument("--linger", action="store_true",
+                    help="keep serving end-of-stream to late consumers")
+    args = ap.parse_args(argv)
+
+    parser = create_parser(args.uri, 0, 1, data_format=args.format,
+                           nthread=args.nthread)
+    svc = BlockService(parser, host=args.host, port=args.port)
+    host, port = svc.address
+    print(f"serving {host} {port}", flush=True)
+    try:
+        svc.wait()
+        if args.linger:
+            while True:
+                time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.close()
+    print(f"served {svc.blocks_served} blocks", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,3 +122,38 @@ class TestBlockService:
         assert b.weight is not None and b.qid is not None
         np.testing.assert_allclose(b.weight, [0.5, 1.5])
         np.testing.assert_array_equal(b.qid, [7, 8])
+
+
+class TestServeCLI:
+    def test_serve_and_consume(self, svm_file):
+        """python -m dmlc_tpu.tools serve <uri> → consume with
+        RemoteBlockParser, server exits once the stream drains."""
+        import os
+        import re
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dmlc_tpu.tools", "serve", svm_file,
+             "--host", "127.0.0.1", "--nthread", "1"],
+            stdout=subprocess.PIPE, text=True, cwd=repo,
+            env={**os.environ,
+                 "PYTHONPATH": repo + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+        )
+        try:
+            line = proc.stdout.readline()
+            m = re.match(r"serving (\S+) (\d+)", line)
+            assert m, line
+            addr = (m.group(1), int(m.group(2)))
+            p = RemoteBlockParser(addr)
+            rows = sum(len(b) for b in p)
+            p.close()
+            assert rows == ROWS
+            proc.wait(timeout=30)
+            assert proc.returncode == 0
+            assert "served" in proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
