@@ -7,7 +7,8 @@ same stance as ``sequence_parallel``:
 - experts are SHARDED over the ``ep`` mesh axis (each device owns
   ``num_experts / ep_size`` expert FFNs — model memory scales out);
 - tokens stay sharded over the same axis (data-parallel token shards);
-- routing is top-1 softmax gating with a STATIC per-(device, expert)
+- routing is top-1 (switch) or renormalized top-k (GShard) softmax
+  gating with a STATIC per-(device, expert)
   capacity (XLA needs static shapes — the standard switch-transformer
   bucketing; over-capacity tokens pass through the residual with zero
   expert output, never a recompile);
@@ -47,48 +48,67 @@ def init_moe_params(
     }
 
 
-def _route_top1(x, wg, num_experts: int, capacity: int):
-    """Top-1 routing with static capacity → (dispatch, combine, aux).
+def _route_topk(x, wg, num_experts: int, capacity: int, top_k: int):
+    """Top-k routing with static capacity → (dispatch, combine, aux).
 
-    x [T, D] (local tokens). dispatch [T, E, C] one-hot; combine the same
-    scaled by the gate probability. Tokens beyond an expert's capacity get
-    all-zero rows (dropped — residual handles them upstream). aux is the
-    switch load-balancing loss (mean fraction·prob product, scaled by E)."""
+    x [T, D] (local tokens). dispatch [T, E, C] one-hot over every kept
+    (token, choice); combine the same scaled by the RENORMALIZED gate
+    probability of each choice (GShard: the k selected probs sum to 1 per
+    token). Capacity fills first-choice tokens before second-choice —
+    under pressure an expert drops k=2 overflow, not k=1 traffic. Tokens
+    whose choice overflows get zero rows for that choice (the residual
+    upstream handles them). aux is the switch/GShard load-balancing loss
+    on FIRST choices: E * sum_e(frac_e * mean_prob_e)."""
     gates = jax.nn.softmax(x @ wg, axis=-1)  # [T, E]
-    expert = jnp.argmax(gates, axis=-1)  # [T]
-    prob = jnp.take_along_axis(gates, expert[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)  # [T, E]
-    # position of each token within its expert's bucket (exclusive cumsum)
-    pos = jnp.cumsum(onehot, axis=0) - onehot  # [T, E]
-    pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+    probs, ids = jax.lax.top_k(gates, top_k)  # [T, K]
+    if top_k > 1:
+        # GShard: the selected probs renormalize to a mixture. At k=1 the
+        # RAW gate prob scales the output (switch semantics) — dividing
+        # would make it exactly 1.0 and cut the router's gradient path
+        # through the main output.
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(ids, num_experts, dtype=x.dtype)  # [T, K, E]
+    # bucket positions: choice-major order (all first choices claim slots
+    # before any second choice) — flatten [K, T, E], exclusive-cumsum
+    oh_km = onehot.transpose(1, 0, 2).reshape(top_k * onehot.shape[0],
+                                              num_experts)
+    pos_flat = jnp.cumsum(oh_km, axis=0) - oh_km
+    pos = (
+        jnp.sum(pos_flat.reshape(top_k, -1, num_experts)
+                .transpose(1, 0, 2) * onehot, axis=-1)
+    ).astype(jnp.int32)  # [T, K]
     keep = pos < capacity
-    dispatch = (
-        onehot[:, :, None]
-        * jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :]
-        * keep[:, None, None]
-    )  # [T, E, C]
-    combine = dispatch * prob[:, None, None]
-    # switch aux loss: E * mean_e(frac_tokens_e * mean_prob_e)
-    frac = jnp.mean(onehot, axis=0)
+    kept = (
+        onehot[:, :, :, None]
+        * jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, :, None, :]
+        * keep[:, :, None, None]
+    )  # [T, K, E, C]
+    dispatch = jnp.sum(kept, axis=1)  # [T, E, C]
+    combine = jnp.sum(kept * probs[:, :, None, None], axis=1)
+    frac = jnp.mean(onehot[:, 0], axis=0)
     mean_prob = jnp.mean(gates, axis=0)
     aux = num_experts * jnp.sum(frac * mean_prob)
     return dispatch, combine, aux
 
 
-def moe_dense_oracle(params: Dict, x):
-    """Single-device reference: every token through its top-1 expert, no
-    capacity limit. [B, T, D] -> ([B, T, D], aux)."""
+def moe_dense_oracle(params: Dict, x, top_k: int = 1):
+    """Single-device reference: every token through its top-k experts
+    (renormalized gate mixture), no capacity limit.
+    [B, T, D] -> ([B, T, D], aux)."""
     b, t, d = x.shape
     xt = x.reshape(b * t, d)
     gates = jax.nn.softmax(xt @ params["wg"], axis=-1)
-    expert = jnp.argmax(gates, axis=-1)
-    prob = jnp.take_along_axis(gates, expert[:, None], axis=-1)[:, 0]
-    w1 = params["w1"][expert]  # [T, D, H]
-    w2 = params["w2"][expert]  # [T, H, D]
-    h = jax.nn.gelu(jnp.einsum("td,tdh->th", xt, w1))
-    y = jnp.einsum("th,thd->td", h, w2) * prob[:, None]
+    probs, ids = jax.lax.top_k(gates, top_k)  # [T, K]
+    if top_k > 1:
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    y = jnp.zeros_like(xt)
+    for kk in range(top_k):
+        w1 = params["w1"][ids[:, kk]]  # [T, D, H]
+        w2 = params["w2"][ids[:, kk]]  # [T, H, D]
+        h = jax.nn.gelu(jnp.einsum("td,tdh->th", xt, w1))
+        y = y + jnp.einsum("th,thd->td", h, w2) * probs[:, kk:kk + 1]
     num_experts = params["wg"].shape[1]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)
+    onehot = jax.nn.one_hot(ids[:, 0], num_experts, dtype=x.dtype)
     aux = num_experts * jnp.sum(
         jnp.mean(onehot, axis=0) * jnp.mean(gates, axis=0)
     )
@@ -101,6 +121,7 @@ def make_moe_layer(
     capacity: int,
     axis: str = "ep",
     batch_axis=None,
+    top_k: int = 1,
 ):
     """Jitted f(params, x[B, T, D]) -> (y[B, T, D], aux_loss).
 
@@ -111,18 +132,22 @@ def make_moe_layer(
     ``batch_axis`` (a second mesh axis) composes data parallelism: place x
     with P(batch_axis, axis) and each dp shard routes its own tokens
     independently (expert weights replicated across dp; aux averaged over
-    both axes).
+    both axes). ``top_k`` selects switch (1, default) or GShard-style
+    top-2+ routing with renormalized gate mixtures; capacity admits first
+    choices before second.
     """
     ep = mesh.shape[axis]
     check(num_experts % ep == 0,
           "num_experts %d must divide over axis size %d", num_experts, ep)
+    check(1 <= top_k <= num_experts,
+          "top_k %d must be in [1, %d]", top_k, num_experts)
     e_local = num_experts // ep
 
     def _local(params, x):
         b, t_local, d = x.shape
         xt = x.reshape(b * t_local, d)
-        dispatch, combine, aux = _route_top1(
-            xt, params["wg"], num_experts, capacity
+        dispatch, combine, aux = _route_topk(
+            xt, params["wg"], num_experts, capacity, top_k
         )
         # gather expert inputs: [E, C, D] with experts numbered
         # contiguously per owning device (expert e lives on device

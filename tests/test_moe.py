@@ -144,3 +144,113 @@ class TestMoE:
         bad = jnp.zeros((1, n + 1, 4))  # tokens don't divide
         with pytest.raises(DMLCError):
             layer(params, bad)
+
+
+class TestTopK:
+    def test_top2_matches_dense_oracle(self):
+        """GShard-style top-2: renormalized two-expert mixture equals the
+        dense oracle with generous capacity."""
+        mesh = _mesh()
+        E, D, H, B, T = 16, 8, 16, 2, 64
+        params = init_moe_params(E, D, H, seed=6)
+        x = jnp.asarray(
+            np.random.RandomState(6).randn(B, T, D).astype(np.float32))
+        want, _ = moe_dense_oracle(params, x, top_k=2)
+        layer = make_moe_layer(mesh, E, capacity=T, top_k=2)
+        got, aux = layer(
+            shard_moe_params(params, mesh),
+            jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+        assert float(aux) > 0
+
+    def test_top1_path_unchanged(self):
+        """top_k=1 must equal the original switch behavior exactly —
+        including the RAW gate-prob scaling (no renormalization; the
+        router's output-path gradient depends on it). Checked against a
+        hand-computed expectation, not the co-evolving oracle."""
+        mesh = _mesh()
+        E, D, H, B, T = 8, 8, 16, 1, 32
+        params = init_moe_params(E, D, H, seed=7)
+        x = jnp.asarray(
+            np.random.RandomState(7).randn(B, T, D).astype(np.float32))
+        layer = make_moe_layer(mesh, E, capacity=T, top_k=1)
+        got, _ = layer(
+            shard_moe_params(params, mesh),
+            jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+        )
+        xt = np.asarray(x[0])
+        gates = np.asarray(jax.nn.softmax(
+            jnp.asarray(xt) @ params["wg"], axis=-1))
+        want = np.zeros_like(xt)
+        for ti in range(T):
+            e_id = int(np.argmax(gates[ti]))
+            hdn = np.asarray(jax.nn.gelu(
+                jnp.asarray(xt[ti] @ np.asarray(params["w1"][e_id]))))
+            # RAW prob, not renormalized-to-1
+            want[ti] = (hdn @ np.asarray(params["w2"][e_id])) * gates[
+                ti, e_id]
+        np.testing.assert_allclose(
+            np.asarray(got)[0], want, rtol=2e-4, atol=2e-5
+        )
+
+    def test_capacity_admits_first_choices_before_second(self):
+        """Under capacity pressure the k=1 (first-choice) traffic wins
+        bucket slots; second choices overflow first."""
+        mesh = _mesh()
+        n = mesh.shape["ep"]
+        E, D, H, B = 8, 8, 16, 1
+        T = 8 * n
+        params = init_moe_params(E, D, H, seed=8)
+        x = jnp.asarray(
+            np.random.RandomState(8).randn(B, T, D).astype(np.float32))
+        # capacity exactly local tokens: every FIRST choice fits by
+        # construction (<= t_local per expert). If first choices won the
+        # bucket slots, every token's first-choice contribution survives:
+        # check against a dense oracle restricted to kept choices.
+        t_local = T // n
+        layer2 = make_moe_layer(mesh, E, capacity=t_local, top_k=2)
+        got2, _ = layer2(
+            shard_moe_params(params, mesh),
+            jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+        )
+        got2 = np.asarray(got2)
+        assert np.all(np.isfinite(got2))
+        # per shard, recompute what the layer should emit: choice-major
+        # capacity over the shard's tokens, renormalized top-2 probs
+        xt = np.asarray(x[0])
+        gates = np.asarray(jax.nn.softmax(
+            jnp.asarray(xt) @ params["wg"], axis=-1))
+        order = np.argsort(-gates, axis=-1)
+        ids = order[:, :2]
+        pr = np.take_along_axis(gates, ids, axis=-1)
+        pr = pr / pr.sum(axis=-1, keepdims=True)
+        for s in range(n):
+            lo, hi = s * t_local, (s + 1) * t_local
+            counts = {}
+            want = np.zeros((t_local, xt.shape[1]), np.float32)
+            for kk in range(2):  # choice-major: all k=0 first
+                for ti in range(lo, hi):
+                    e_id = int(ids[ti, kk])
+                    c = counts.get(e_id, 0)
+                    counts[e_id] = c + 1
+                    if c >= t_local:
+                        continue  # dropped
+                    w1 = np.asarray(params["w1"][e_id])
+                    w2 = np.asarray(params["w2"][e_id])
+                    hdn = np.asarray(jax.nn.gelu(
+                        jnp.asarray(xt[ti] @ w1)))
+                    want[ti - lo] += (hdn @ w2) * pr[ti, kk]
+            np.testing.assert_allclose(
+                got2[0, lo:hi], want, rtol=2e-4, atol=2e-5
+            )
+
+    def test_validation(self):
+        mesh = _mesh()
+        n = mesh.shape["ep"]
+        with pytest.raises(DMLCError):
+            make_moe_layer(mesh, n, capacity=4, top_k=0)
+        with pytest.raises(DMLCError):
+            make_moe_layer(mesh, n, capacity=4, top_k=n + 1)
