@@ -33,6 +33,8 @@ def main() -> int:
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--kv-heads", type=int, default=2)
     ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--top-k", type=int, default=2,
+                    help="experts per token (1=switch, 2=GShard)")
     args = ap.parse_args()
 
     import jax
@@ -87,10 +89,11 @@ def main() -> int:
         return v * jax.lax.rsqrt(jnp.mean(v * v, axis=-1, keepdims=True) + 1e-6)
 
     ring = make_ring_attention(mesh, causal=True, axis="sp", batch_axis="dp")
-    # capacity is per (device, expert) against LOCAL tokens: t // sp covers
-    # every local token, the tight no-drop bound
+    # capacity per (device, expert) against LOCAL tokens: top-k expert ids
+    # are DISTINCT per token, so an expert receives at most one claim per
+    # token — t//sp (= local tokens) is the tight no-drop bound for ANY k
     moe = make_moe_layer(mesh, e, capacity=t // sp, axis="sp",
-                         batch_axis="dp")
+                         batch_axis="dp", top_k=args.top_k)
 
     def qkv(v):
         vn = rmsnorm(v)
@@ -116,12 +119,13 @@ def main() -> int:
     q, k, v = qkv(x)
     attn_ref = full_attention(q, k, v, causal=True).reshape(b, t, h * hd)
     y1_ref = x + attn_ref @ params["wo"]
-    ffn_ref, _ = moe_dense_oracle(params["moe"], rmsnorm(y1_ref))
+    ffn_ref, _ = moe_dense_oracle(params["moe"], rmsnorm(y1_ref),
+                                  top_k=args.top_k)
     y_ref = np.asarray(y1_ref + ffn_ref)
 
     err = float(np.max(np.abs(y_sharded - y_ref)))
-    print(f"block: ring-attn(GQA {h}q/{hk}kv, causal) + switch-MoE(E={e}) "
-          f"+ residuals/RMSNorm over T={t}")
+    print(f"block: ring-attn(GQA {h}q/{hk}kv, causal) + "
+          f"MoE(E={e}, top-{args.top_k}) + residuals/RMSNorm over T={t}")
     print(f"max|Δ| sharded vs single-device = {err:.2e} "
           f"(aux={float(aux):.3f})")
     ok = err < 1e-3

@@ -197,54 +197,86 @@ class TestTopK:
         )
 
     def test_capacity_admits_first_choices_before_second(self):
-        """Under capacity pressure the k=1 (first-choice) traffic wins
-        bucket slots; second choices overflow first."""
+        """Choice-major bucketing: when an expert is claimed by one
+        token's FIRST choice and an earlier token's SECOND choice, the
+        first choice wins the slot. (Token-major ordering would hand it
+        to the earlier token's second choice instead — top-k ids are
+        distinct per token, so contention only arises ACROSS tokens.)"""
         mesh = _mesh()
         n = mesh.shape["ep"]
-        E, D, H, B = 8, 8, 16, 1
-        T = 8 * n
-        params = init_moe_params(E, D, H, seed=8)
-        x = jnp.asarray(
-            np.random.RandomState(8).randn(B, T, D).astype(np.float32))
-        # capacity exactly local tokens: every FIRST choice fits by
-        # construction (<= t_local per expert). If first choices won the
-        # bucket slots, every token's first-choice contribution survives:
-        # check against a dense oracle restricted to kept choices.
-        t_local = T // n
-        layer2 = make_moe_layer(mesh, E, capacity=t_local, top_k=2)
-        got2, _ = layer2(
+        E = n  # one expert per device
+        D = E
+        # identity gate: logits = 10 * x, so x rows select experts directly
+        params = init_moe_params(E, D, 16, seed=8)
+        params = dict(params)
+        params["wg"] = 10.0 * jnp.eye(D, E, dtype=jnp.float32)
+        # per shard, token order [Y, X]:
+        #   Y: top1 = e1 (1.0), top2 = e0 (0.5)
+        #   X: top1 = e0 (1.0), top2 = e1 (0.25)
+        y_row = np.zeros(D, np.float32); y_row[1] = 1.0; y_row[0] = 0.5
+        x_row = np.zeros(D, np.float32); x_row[0] = 1.0; x_row[1] = 0.25
+        shard = np.stack([y_row, x_row])
+        x = jnp.asarray(np.tile(shard, (n, 1))[None])  # [1, 2n, D]
+        layer = make_moe_layer(mesh, E, capacity=1, top_k=2)
+        got, _ = layer(
             shard_moe_params(params, mesh),
             jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
         )
-        got2 = np.asarray(got2)
-        assert np.all(np.isfinite(got2))
-        # per shard, recompute what the layer should emit: choice-major
-        # capacity over the shard's tokens, renormalized top-2 probs
-        xt = np.asarray(x[0])
+        got = np.asarray(got)[0]
+
+        def expert_out(e_id, row, prob):
+            h = np.asarray(jax.nn.gelu(
+                jnp.asarray(row @ np.asarray(params["w1"][e_id]))))
+            return (h @ np.asarray(params["w2"][e_id])) * prob
+
         gates = np.asarray(jax.nn.softmax(
-            jnp.asarray(xt) @ params["wg"], axis=-1))
-        order = np.argsort(-gates, axis=-1)
-        ids = order[:, :2]
-        pr = np.take_along_axis(gates, ids, axis=-1)
-        pr = pr / pr.sum(axis=-1, keepdims=True)
+            jnp.asarray(shard) @ np.asarray(params["wg"]), axis=-1))
+        # renormalized top-2 probs per row
+        def top2(g):
+            ids = np.argsort(-g)[:2]
+            p = g[ids] / g[ids].sum()
+            return ids, p
+        y_ids, y_p = top2(gates[0])
+        x_ids, x_p = top2(gates[1])
+        # choice-major with capacity 1 per expert:
+        #  e1: Y-first wins; X-second (to e1) dropped
+        #  e0: X-first wins; Y-second (to e0) dropped
+        want_y = expert_out(y_ids[0], y_row, y_p[0])
+        want_x = expert_out(x_ids[0], x_row, x_p[0])
         for s in range(n):
-            lo, hi = s * t_local, (s + 1) * t_local
-            counts = {}
-            want = np.zeros((t_local, xt.shape[1]), np.float32)
-            for kk in range(2):  # choice-major: all k=0 first
-                for ti in range(lo, hi):
-                    e_id = int(ids[ti, kk])
-                    c = counts.get(e_id, 0)
-                    counts[e_id] = c + 1
-                    if c >= t_local:
-                        continue  # dropped
-                    w1 = np.asarray(params["w1"][e_id])
-                    w2 = np.asarray(params["w2"][e_id])
-                    hdn = np.asarray(jax.nn.gelu(
-                        jnp.asarray(xt[ti] @ w1)))
-                    want[ti - lo] += (hdn @ w2) * pr[ti, kk]
+            np.testing.assert_allclose(got[2 * s], want_y,
+                                       rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(got[2 * s + 1], want_x,
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_top2_gradients_match_oracle(self):
+        """Gradients through the renormalized top-2 path (incl. the
+        d(prob)/d(gate) cross terms of the division) equal the dense
+        oracle's."""
+        mesh = _mesh()
+        E, D, H, B, T = 8, 8, 8, 1, 32
+        params = init_moe_params(E, D, H, seed=9)
+        x = jnp.asarray(
+            np.random.RandomState(9).randn(B, T, D).astype(np.float32))
+        layer = make_moe_layer(mesh, E, capacity=T, top_k=2)
+
+        def loss_sharded(p):
+            y, _ = layer(
+                shard_moe_params(p, mesh),
+                jax.device_put(x, NamedSharding(mesh, P(None, "ep"))),
+            )
+            return jnp.sum(jnp.asarray(y) ** 2)
+
+        def loss_dense(p):
+            y, _ = moe_dense_oracle(p, x, top_k=2)
+            return jnp.sum(y ** 2)
+
+        g1 = jax.grad(loss_sharded)(params)
+        g2 = jax.grad(loss_dense)(params)
+        for key in ("w1", "w2", "wg"):
             np.testing.assert_allclose(
-                got2[0, lo:hi], want, rtol=2e-4, atol=2e-5
+                np.asarray(g1[key]), np.asarray(g2[key]),
+                rtol=3e-3, atol=3e-4,
             )
 
     def test_validation(self):
