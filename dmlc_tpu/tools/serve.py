@@ -8,7 +8,11 @@ finished RowBlocks over TCP with dynamic sharding; consumers attach with
 Usage::
 
     python -m dmlc_tpu.tools serve <uri> [--host H] [--port P]
-        [--format auto|libsvm|libfm|csv|recordio] [--nthread N] [--linger]
+        [--part K --nparts N] [--format auto|libsvm|libfm|csv|recordio]
+        [--nthread N] [--linger]
+
+``--part/--nparts`` serve one InputSplit part (static sharding: one serve
+host per part; within a part, consumers still shard dynamically).
 
 Prints ``serving HOST PORT`` on stdout once listening. Exits when the
 stream is exhausted and consumers have drained (--linger keeps serving
@@ -23,6 +27,7 @@ import time
 from typing import List, Optional
 
 from dmlc_tpu.data import BlockService, create_parser
+from dmlc_tpu.utils.logging import check
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -30,15 +35,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("uri")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--part", type=int, default=0)
+    ap.add_argument("--nparts", type=int, default=1)
     ap.add_argument("--format", default="auto",
                     choices=["auto", "libsvm", "libfm", "csv", "recordio"])
     ap.add_argument("--nthread", type=int, default=2)
     ap.add_argument("--linger", action="store_true",
                     help="keep serving end-of-stream to late consumers")
     args = ap.parse_args(argv)
+    check(0 <= args.part < args.nparts, "bad part %d/%d (parts are "
+          "0-based)", args.part, args.nparts)
 
-    parser = create_parser(args.uri, 0, 1, data_format=args.format,
-                           nthread=args.nthread)
+    parser = create_parser(args.uri, args.part, args.nparts,
+                           data_format=args.format, nthread=args.nthread)
     svc = BlockService(parser, host=args.host, port=args.port)
     host, port = svc.address
     print(f"serving {host} {port}", flush=True)

@@ -124,29 +124,34 @@ class TestBlockService:
         np.testing.assert_array_equal(b.qid, [7, 8])
 
 
+def _spawn_serve(svm_file, *extra_args):
+    """Launch the serve CLI; → (proc, (host, port))."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dmlc_tpu.tools", "serve", svm_file,
+         "--host", "127.0.0.1", "--nthread", "1", *extra_args],
+        stdout=subprocess.PIPE, text=True, cwd=repo,
+        env={**os.environ,
+             "PYTHONPATH": repo + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+    )
+    line = proc.stdout.readline()
+    m = re.match(r"serving (\S+) (\d+)", line)
+    assert m, line
+    return proc, (m.group(1), int(m.group(2)))
+
+
 class TestServeCLI:
     def test_serve_and_consume(self, svm_file):
         """python -m dmlc_tpu.tools serve <uri> → consume with
         RemoteBlockParser, server exits once the stream drains."""
-        import os
-        import re
-        import subprocess
-        import sys
-
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "dmlc_tpu.tools", "serve", svm_file,
-             "--host", "127.0.0.1", "--nthread", "1"],
-            stdout=subprocess.PIPE, text=True, cwd=repo,
-            env={**os.environ,
-                 "PYTHONPATH": repo + os.pathsep
-                 + os.environ.get("PYTHONPATH", "")},
-        )
+        proc, addr = _spawn_serve(svm_file)
         try:
-            line = proc.stdout.readline()
-            m = re.match(r"serving (\S+) (\d+)", line)
-            assert m, line
-            addr = (m.group(1), int(m.group(2)))
             p = RemoteBlockParser(addr)
             rows = sum(len(b) for b in p)
             p.close()
@@ -157,3 +162,44 @@ class TestServeCLI:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+    def test_serve_cli_rejects_bad_part(self, svm_file):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "dmlc_tpu.tools", "serve", svm_file,
+             "--part", "2", "--nparts", "2"],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+            env={**os.environ,
+                 "PYTHONPATH": repo + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+        )
+        assert proc.returncode != 0
+        assert "bad part" in proc.stderr
+
+
+    def test_serve_cli_static_parts_cover_exactly_once(self, svm_file):
+        """Two serve processes with --part 0/1 --nparts 2: their streams
+        union to every row exactly once (static sharding across serve
+        hosts; dynamic within each)."""
+        procs, vals = [], []
+        try:
+            for part in (0, 1):
+                proc, addr = _spawn_serve(
+                    svm_file, "--part", str(part), "--nparts", "2")
+                procs.append(proc)
+                p = RemoteBlockParser(addr)
+                for b in p:
+                    vals.extend(np.asarray(b.value)[::2].tolist())
+                p.close()
+            for proc in procs:
+                proc.wait(timeout=30)
+                assert proc.returncode == 0
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+        assert sorted(vals) == [i + 0.25 for i in range(ROWS)]
