@@ -162,7 +162,7 @@ def zigzag_unshard(x, num_devices: int):
 
 def make_ring_attention(
     mesh: Mesh, axis: str = "sp", causal: bool = False, window: int = 0,
-    layout: str = "contiguous", batch_axis=None,
+    layout: str = "contiguous", batch_axis=None, remat: bool = False,
 ):
     """Jitted f(q, k, v) -> out with the sequence dim sharded over ``axis``.
 
@@ -181,6 +181,11 @@ def make_ring_attention(
     ``batch_axis`` (a second mesh axis) composes data parallelism: place
     q/k/v with P(batch_axis, axis) and each dp shard runs an independent
     ring over its own batch rows.
+
+    ``remat=True`` wraps each ring hop in ``jax.checkpoint``: the backward
+    pass recomputes the hop's scores instead of keeping every hop's
+    intermediates alive — activation memory stops scaling with axis_size
+    (the standard trade for long-context training; FLOPs roughly +1x fwd).
     """
     check(window >= 0, "window must be >= 0, got %d", window)
     check(layout in ("contiguous", "zigzag"),
@@ -301,8 +306,14 @@ def make_ring_attention(
                 )
             return (k_cur, v_cur, m, l, o), None
 
+        # prevent_cse=False: inside lax.scan the problematic CSE cannot
+        # happen (per the jax.checkpoint docs), so skip the optimization
+        # barriers it would otherwise insert around every hop
+        step_fn = (
+            jax.checkpoint(step, prevent_cse=False) if remat else step
+        )
         (k, v, m, l, o), _ = jax.lax.scan(
-            step, (k, v, m, l, o), jnp.arange(1, size)
+            step_fn, (k, v, m, l, o), jnp.arange(1, size)
         )
         denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
         return o / denom
