@@ -34,22 +34,27 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional, Tuple, Union
 
 import numpy as np
 
 from dmlc_tpu.data.parsers import Parser, create_parser
 from dmlc_tpu.data.row_block import RowBlock
-from dmlc_tpu.utils.logging import DMLCError, check
+from dmlc_tpu.utils.logging import DMLCError, check, log_warning
 
 _REQ_NEXT = 1
 _REQ_CLOSE = 2
+
+# Response sentinel in the u32 field-count slot: server-side parse failure.
+# Followed by u32 message length + utf-8 message; consumers raise DMLCError.
+_RESP_ERROR = 0xFFFFFFFF
 
 _BLOCK_FIELDS = ("offset", "label", "index", "value", "weight", "qid",
                  "field")
 
 
-def _send_arrays(sock: socket.socket, arrays: Dict[str, np.ndarray]) -> None:
+def _pack_arrays(arrays: Dict[str, np.ndarray]) -> bytes:
     parts = [struct.pack("<I", len(arrays))]
     for name, arr in arrays.items():
         data = np.ascontiguousarray(arr).tobytes()
@@ -58,7 +63,7 @@ def _send_arrays(sock: socket.socket, arrays: Dict[str, np.ndarray]) -> None:
         parts.append(struct.pack("<B", len(dt)) + dt.encode())
         parts.append(struct.pack("<Q", len(data)))
         parts.append(data)
-    sock.sendall(b"".join(parts))
+    return b"".join(parts)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -73,8 +78,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _send_error(sock: socket.socket, msg: str) -> None:
+    data = msg.encode()
+    sock.sendall(struct.pack("<II", _RESP_ERROR, len(data)) + data)
+
+
 def _recv_arrays(sock: socket.socket) -> Optional[Dict[str, np.ndarray]]:
     (nfields,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if nfields == _RESP_ERROR:
+        (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+        raise DMLCError(
+            "block service parse failed: " + _recv_exact(sock, mlen).decode()
+        )
     if nfields == 0:
         return None
     out: Dict[str, np.ndarray] = {}
@@ -107,7 +122,23 @@ class BlockService:
         # point: one block goes to exactly one consumer)
         self._done = False
         self._drained = threading.Event()  # set when the stream is exhausted
+        self._pending: list = []  # blocks pulled but undelivered (their
+        # consumer died mid-send); redelivered before the next parser pull
+        # so those rows stay in the epoch
+        self._error: Optional[DMLCError] = None  # parser failure, relayed to
+        # every consumer instead of an opaque mid-frame close
+        self._error_msg: Optional[str] = None  # plain one-line form of the
+        # same failure for the wire (DMLCError's str embeds a server-side
+        # stack trace consumers don't need)
+        self._responses_done = 0  # monotonic completed-response counter —
+        # wait()'s forward-progress signal (a gauge alone cannot tell
+        # "steadily delivering" from "wedged")
+        self._bytes_sent = 0  # monotonic payload bytes pushed to sockets —
+        # makes an in-flight send to a slow consumer visible as progress
+        # (responses_done only ticks at completion)
         self.blocks_served = 0
+        self.blocks_dropped = 0  # undelivered blocks still pending at
+        # close() — rows that never reached any consumer
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -124,9 +155,25 @@ class BlockService:
 
     def _next_block_arrays(self) -> Optional[Dict[str, np.ndarray]]:
         with self._lock:
+            if self._pending:
+                return self._pending.pop(0)
+            if self._error is not None:
+                raise self._error
             if self._done:
                 return None
-            block = self._parser.next_block()
+            try:
+                block = self._parser.next_block()
+            except Exception as exc:  # parser failure ends the stream for
+                # everyone — record it so wait() returns and every consumer
+                # sees the real error, not a mid-frame close
+                self._done = True
+                # first line only: a DMLCError's str already embeds the
+                # server-side stack trace, which must not ship on the wire
+                detail = str(exc).split("\n\nStack trace:")[0]
+                self._error_msg = "%s: %s" % (type(exc).__name__, detail)
+                self._error = DMLCError(self._error_msg)
+                self._drained.set()
+                raise self._error
             if block is None:
                 self._done = True
                 self._drained.set()
@@ -139,20 +186,54 @@ class BlockService:
                 out[name] = np.asarray(arr)
         return out
 
+    def _stash_undelivered(self, arrays: Dict[str, np.ndarray]) -> None:
+        with self._lock:
+            self._pending.append(arrays)
+
+    def _send_response(self, conn: socket.socket, data: bytes) -> None:
+        """sendall in ≤1 MiB slices, ticking _bytes_sent — so wait() can
+        tell a slow-but-live transfer from a wedged one."""
+        view = memoryview(data)
+        while view:
+            sent = conn.send(view[: 1 << 20])
+            with self._lock:
+                self._bytes_sent += sent
+            view = view[sent:]
+
     def _serve_conn(self, conn: socket.socket) -> None:
         self._conns.append(conn)
+        undelivered: Optional[Dict[str, np.ndarray]] = None
         try:
             while True:
                 (req,) = struct.unpack("<I", _recv_exact(conn, 4))
-                if req == _REQ_CLOSE:
-                    return
-                check(req == _REQ_NEXT, "bad block service request %d", req)
-                arrays = self._next_block_arrays()
-                _send_arrays(conn, arrays or {})
-                if arrays is None:
-                    return
+                try:
+                    if req == _REQ_CLOSE:
+                        return
+                    check(
+                        req == _REQ_NEXT, "bad block service request %d", req
+                    )
+                    try:
+                        undelivered = self._next_block_arrays()
+                    except DMLCError:  # parser failure (stream is over)
+                        try:
+                            _send_error(conn, self._error_msg or "parse "
+                                        "failed")
+                        except OSError:
+                            pass
+                        return
+                    self._send_response(conn, _pack_arrays(undelivered or {}))
+                    if undelivered is None:
+                        return
+                    undelivered = None
+                finally:
+                    with self._lock:
+                        self._responses_done += 1
         except (DMLCError, OSError):
-            return  # consumer went away; the stream continues for others
+            # consumer went away; requeue any block it never received so the
+            # stream stays lossless for the remaining consumers
+            if undelivered is not None:
+                self._stash_undelivered(undelivered)
+            return
         finally:
             conn.close()
 
@@ -168,12 +249,38 @@ class BlockService:
             t.start()
             self._threads.append(t)
 
-    def wait(self) -> None:
-        """Block until the stream is exhausted AND every connection that
-        consumed it has finished — the CLI server's natural exit point."""
+    def wait(self, timeout: float = 10.0) -> None:
+        """Block until the stream is exhausted (unbounded — serving IS the
+        job), then give remaining connections grace windows of ``timeout``
+        seconds to finish — the CLI server's natural exit point.
+
+        Exit semantic (a deliberate tradeoff — bounded exit vs waiting for
+        consumers that may never return): windows extend as long as there is
+        measurable progress — a response completed or a connection finished
+        during the window. One full window with NO progress ends the wait,
+        cutting off consumers that connected but never issued their final
+        request (they would otherwise hold a recv forever) — and, by the
+        same clock, any consumer that goes silent for longer than
+        ``timeout`` after the drain; raise ``timeout`` if consumers do long
+        post-drain work between pulls. Any stashed undelivered blocks still
+        unclaimed are counted and logged as lost by :meth:`close`."""
         self._drained.wait()
-        for t in list(self._threads):
-            t.join()
+        with self._lock:
+            last_done, last_sent = self._responses_done, self._bytes_sent
+        last_alive = len([t for t in list(self._threads) if t.is_alive()])
+        while True:
+            deadline = time.monotonic() + timeout
+            for t in list(self._threads):
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            alive = len([t for t in list(self._threads) if t.is_alive()])
+            if alive == 0:
+                return
+            with self._lock:
+                done, sent = self._responses_done, self._bytes_sent
+            if done > last_done or sent > last_sent or alive < last_alive:
+                last_done, last_sent, last_alive = done, sent, alive
+                continue  # delivery progressed during the window
+            return  # a silent window: only stuck/idle connections remain
 
     def close(self) -> None:
         try:
@@ -189,6 +296,25 @@ class BlockService:
                 pass
         for t in self._threads:
             t.join(timeout=5)
+        # loss accounting AFTER the joins: a send-wedged thread stashes its
+        # block only when the conn close above errors its sendall out.
+        # Bounded acquire — a thread wedged INSIDE a parser pull holds the
+        # lock, and close() must still reach parser.close() (the one call
+        # that can unblock such a reader)
+        if self._lock.acquire(timeout=1.0):
+            try:
+                if self._pending:  # redelivery never happened — those rows
+                    # left the epoch; surface the loss, don't exit "clean"
+                    self.blocks_dropped += len(self._pending)
+                    rows = sum(len(a["offset"]) - 1 for a in self._pending)
+                    log_warning(
+                        "block service closing with %d undelivered "
+                        "block(s) (%d rows never reached a consumer)",
+                        self.blocks_dropped, rows,
+                    )
+                    self._pending.clear()
+            finally:
+                self._lock.release()
         self._parser.close()
 
     def __enter__(self):
@@ -217,7 +343,14 @@ class RemoteBlockParser:
         if self._ended:
             return None
         self._sock.sendall(struct.pack("<I", _REQ_NEXT))
-        arrays = _recv_arrays(self._sock)
+        try:
+            arrays = _recv_arrays(self._sock)
+        except DMLCError:
+            # error frame or dead socket: the stream is over — a retried
+            # next_block() must not mask the original error with a
+            # broken-pipe on the closed connection
+            self._ended = True
+            raise
         if arrays is None:
             self._ended = True
             return None

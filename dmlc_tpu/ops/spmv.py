@@ -27,11 +27,14 @@ def expand_row_ids(offsets, nnz: int):
     per-entry row_ids (∝ nnz); this expansion — scatter-add a mark at every
     row boundary, then an inclusive cumsum — is O(nnz) vectorized work that
     XLA fuses into the consuming segment-sum's input. Entry e's row is
-    #{r ≥ 1 : offsets[r] ≤ e}. Boundary marks at nnz (empty tail rows /
-    bucket-exact batches) fall off the end and are dropped; padded entries
-    past the valid nnz resolve to the LAST row (clamped — ``jnp.take``'s
-    out-of-bounds fill mode would inject NaN), which is harmless because
-    their values are 0 (arithmetic no-op in both segment-sum directions).
+    #{r ≥ 1 : offsets[r] ≤ e}. Padding semantics: when the batch fills the
+    bucket exactly (offsets[rows] == nnz) the tail boundary marks land past
+    the end and ``mode="drop"`` discards them; when valid nnz < bucket, the
+    padded rows' marks land in-bounds at the valid-nnz index, so the padded
+    entries' cumsum overshoots and the clamp to the LAST row absorbs them
+    (also saving ``jnp.take``'s out-of-bounds NaN fill) — harmless either
+    way because padded values are 0 (arithmetic no-op in both segment-sum
+    directions).
 
     ``nnz`` must be the static bucket size (values.shape[0] under jit).
     """

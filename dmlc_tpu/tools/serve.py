@@ -9,14 +9,16 @@ Usage::
 
     python -m dmlc_tpu.tools serve <uri> [--host H] [--port P]
         [--part K --nparts N] [--format auto|libsvm|libfm|csv|recordio]
-        [--nthread N] [--linger]
+        [--nthread N] [--grace SECS] [--linger]
 
 ``--part/--nparts`` serve one InputSplit part (static sharding: one serve
 host per part; within a part, consumers still shard dynamically).
 
 Prints ``serving HOST PORT`` on stdout once listening. Exits when the
-stream is exhausted and consumers have drained (--linger keeps serving
-end-of-stream markers to late consumers until killed).
+stream is exhausted and post-drain delivery goes silent for ``--grace``
+seconds (default 10 — raise it when consumers do long work between pulls;
+see BlockService.wait for the exact progress semantics). ``--linger``
+keeps serving end-of-stream markers to late consumers until killed.
 """
 
 from __future__ import annotations
@@ -40,6 +42,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--format", default="auto",
                     choices=["auto", "libsvm", "libfm", "csv", "recordio"])
     ap.add_argument("--nthread", type=int, default=2)
+    ap.add_argument("--grace", type=float, default=10.0,
+                    help="post-drain grace window seconds for slow "
+                         "consumers (forwarded to BlockService.wait)")
     ap.add_argument("--linger", action="store_true",
                     help="keep serving end-of-stream to late consumers")
     args = ap.parse_args(argv)
@@ -52,7 +57,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     host, port = svc.address
     print(f"serving {host} {port}", flush=True)
     try:
-        svc.wait()
+        svc.wait(timeout=args.grace)
         if args.linger:
             while True:
                 time.sleep(1)

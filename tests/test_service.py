@@ -111,6 +111,92 @@ class TestBlockService:
         for a, b in zip(remote, local):
             np.testing.assert_array_equal(a, b)
 
+    def test_undelivered_block_is_redelivered(self, svm_file):
+        """A block pulled for a consumer that died mid-send goes back into
+        the stream (one-slot pending buffer) — no rows leave the epoch."""
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.io import create_input_split
+
+        split = create_input_split(svm_file, 0, 1, "text", threaded=False)
+        split.hint_chunk_size(2048)
+        with BlockService(LibSVMParser(split, nthread=1)) as svc:
+            # simulate _serve_conn's failure path: block pulled, send failed
+            arrays = svc._next_block_arrays()
+            assert arrays is not None
+            svc._stash_undelivered(arrays)
+            p = RemoteBlockParser(svc.address)
+            rows = sum(len(b) for b in p)
+            p.close()
+        assert rows == ROWS  # the stashed block was redelivered
+        assert svc.blocks_dropped == 0
+
+    def test_two_undelivered_blocks_both_redeliver(self, svm_file):
+        """Two consumers dying mid-send in the same window lose nothing:
+        the pending buffer is a list, not a single slot."""
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.io import create_input_split
+
+        split = create_input_split(svm_file, 0, 1, "text", threaded=False)
+        split.hint_chunk_size(2048)
+        with BlockService(LibSVMParser(split, nthread=1)) as svc:
+            a = svc._next_block_arrays()
+            b = svc._next_block_arrays()
+            svc._stash_undelivered(a)
+            svc._stash_undelivered(b)
+            p = RemoteBlockParser(svc.address)
+            rows = sum(len(blk) for blk in p)
+            p.close()
+        assert rows == ROWS
+        assert svc.blocks_dropped == 0
+
+    def test_close_counts_undeliverable_pending_blocks(self, svm_file):
+        with BlockService(svm_file, nthread=1) as svc:
+            a = svc._next_block_arrays()
+            svc._stash_undelivered(a)
+        # closed with the block still pending: the loss is counted
+        assert svc.blocks_dropped == 1
+
+    def test_parser_error_reaches_consumer_and_unblocks_wait(self):
+        """A parse failure must surface as a DMLCError frame on every
+        consumer and set the drained event so wait()/the serve CLI exit —
+        not hang behind a swallowed exception."""
+
+        class _BoomParser:
+            bytes_read = 0
+
+            def next_block(self):
+                raise DMLCError("malformed row at byte 7")
+
+            def close(self):
+                pass
+
+        with BlockService(_BoomParser()) as svc:
+            p = RemoteBlockParser(svc.address)
+            with pytest.raises(DMLCError, match="malformed row"):
+                p.next_block()
+            svc.wait(timeout=5)  # returns: _drained set on the error path
+            # a late consumer sees the same error, not a hang
+            p2 = RemoteBlockParser(svc.address)
+            with pytest.raises(DMLCError, match="malformed row"):
+                p2.next_block()
+
+    def test_wait_does_not_hang_on_idle_consumer(self, svm_file):
+        """A consumer that connects but never issues a request must not
+        block wait() forever (it holds a recv until close)."""
+        import socket
+        import time
+
+        with BlockService(svm_file, nthread=1) as svc:
+            idle = socket.create_connection(svc.address)  # never requests
+            p = RemoteBlockParser(svc.address)
+            rows = sum(len(b) for b in p)
+            p.close()
+            assert rows == ROWS
+            t0 = time.monotonic()
+            svc.wait(timeout=2.0)
+            assert time.monotonic() - t0 < 8
+            idle.close()
+
     def test_serves_weights_and_qids(self, tmp_path):
         path = tmp_path / "wq.svm"
         with open(path, "w") as fh:
