@@ -93,13 +93,11 @@ def _one_pass(path: str, nthread: int) -> tuple:
     return mbps, stats
 
 
-def _device_backend_ok(timeout_s: float = 90.0) -> tuple:
-    """Probe jax backend init in a THROWAWAY subprocess → (ok, reason).
+def _device_backend_probe_once(timeout_s: float) -> tuple:
+    """One jax-backend-init probe in a THROWAWAY subprocess → (ok, reason).
     When the TPU tunnel is down, jax.devices() HANGS (not errors) —
     probing in-process would wedge the whole bench and the driver would
-    record nothing. A failed probe skips the device tiers (with the real
-    reason recorded: timeout vs the child's actual error); every
-    host-side tier still reports."""
+    record nothing."""
     import subprocess
 
     try:
@@ -121,6 +119,34 @@ def _device_backend_ok(timeout_s: float = 90.0) -> tuple:
             tail[-1] if tail else f"exit {proc.returncode}"
         )
     return True, (proc.stdout or "").strip()
+
+
+def _device_backend_ok(timeout_s: float = None, attempts: int = None,
+                       backoff_s: float = 20.0) -> tuple:
+    """Retrying device probe → (ok, note, probe_record). A transient tunnel
+    drop must not cost the round its device tiers, so a failed probe
+    retries with backoff before the tiers are skipped; every attempt's
+    outcome and duration goes in the JSON (probe timing is accounted here,
+    SEPARATE from the tier timings — a slow init never deflates a tier's
+    MB/s). Env knobs DMLC_TPU_BENCH_PROBE_ATTEMPTS/_TIMEOUT bound the
+    worst-case wait (3 x 90s + backoff by default)."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("DMLC_TPU_BENCH_PROBE_TIMEOUT", 90))
+    if attempts is None:
+        attempts = int(os.environ.get("DMLC_TPU_BENCH_PROBE_ATTEMPTS", 3))
+    record = {"attempts": []}
+    note = "device probe disabled (DMLC_TPU_BENCH_PROBE_ATTEMPTS < 1)"
+    for i in range(attempts):
+        if i:
+            time.sleep(backoff_s)
+        t0 = time.time()
+        ok, note = _device_backend_probe_once(timeout_s)
+        record["attempts"].append(
+            {"ok": ok, "note": note, "secs": round(time.time() - t0, 1)}
+        )
+        if ok:
+            return True, note, record
+    return False, note, record
 
 
 def _host_probe() -> float:
@@ -206,20 +232,27 @@ def _combine_headline(sweeps: list) -> tuple:
     return headline, extra
 
 
-def _bench_recordio(path: str) -> dict:
-    """Binary row-group ingest over the same rows (data/rowrec.py): the
-    scan-free format — framing + memcpy — that binary shards should use.
-    Reported next to the text headline to keep the 'recordio >= libsvm'
-    contract visible."""
-    from dmlc_tpu.data import create_parser
+def _ensure_recordio(path: str) -> str:
+    """Binary row-group twin of the text file (data/rowrec.py): the
+    scan-free format — framing + memcpy — that binary shards should use."""
     from dmlc_tpu.data.rowrec import convert_to_recordio
 
     rec = os.path.join(CACHE_DIR, f"higgs_like_{ROWS}.rec")
     if not (os.path.exists(rec) and os.path.getsize(rec) > 0):
         convert_to_recordio(path, rec + ".tmp", rows_per_group=4096)
         os.replace(rec + ".tmp", rec)
+    return rec
+
+
+def _recordio_sweep(path: str) -> dict:
+    """One recordio-ingest sweep → {probe_gbps, trials} (first trial is an
+    in-sweep warmup, dropped)."""
+    from dmlc_tpu.data import create_parser
+
+    rec = _ensure_recordio(path)
+    probe = _host_probe()
     runs = []
-    for _ in range(TRIALS + 1):  # first is warmup
+    for _ in range(TRIALS + 1):
         t0 = time.time()
         parser = create_parser(rec, 0, 1, data_format="recordio", nthread=1)
         rows = sum(len(b) for b in parser)
@@ -228,11 +261,23 @@ def _bench_recordio(path: str) -> dict:
         parser.close()
         assert rows == ROWS, f"recordio row count mismatch: {rows}"
         runs.append(round(mb / dt, 1))
-    return {
-        "recordio_ingest_mbps": round(statistics.median(runs[1:]), 1),
-        "recordio_ingest_trials_mbps": runs[1:],
-        "recordio_file_mb": round(os.path.getsize(rec) / (1 << 20), 1),
-    }
+    return {"probe_gbps": probe, "trials": runs[1:]}
+
+
+def _combine_tier(sweeps: list) -> tuple:
+    """Best sweep's score (median of its trials unless the sweep recorded
+    an explicit score) → (value, sweeps-for-extra). The host is bimodal
+    (BASELINE.md): a tier scored from ONE window is a coin flip, so every
+    tier runs three sweeps spread across the bench and scores the best
+    window — same discipline as the headline."""
+    best = None
+    for sw in sweeps:
+        if "error" in sw or not sw.get("trials"):
+            continue
+        score = sw.get("score", statistics.median(sw["trials"]))
+        if best is None or score > best:
+            best = score
+    return best, sweeps
 
 
 
@@ -306,46 +351,51 @@ def _ensure_criteo_like() -> str:
     return path
 
 
-def _bench_criteo_like(device_ok: bool = True) -> dict:
-    """Sparse high-cardinality ingest + csr-SGD: parse MB/s over the
-    Criteo-shaped file, and the csr train loop with a 2^20 feature space
-    (segment-sum SpMV gradient, sharded-COO-compatible layout). With
-    device_ok=False only the parse half runs (no jax touched)."""
+def _criteo_parse_sweep() -> dict:
+    """One sparse high-cardinality parse sweep over the Criteo-shaped file
+    → {probe_gbps, trials} (first trial is an in-sweep warmup, dropped).
+    The {1,2}-thread configs both run; the sweep's trials are the better
+    config's (mirroring the headline's per-config discipline at the
+    1-core-host scale)."""
     from dmlc_tpu.data import create_parser
 
     path = _ensure_criteo_like()
     size_mb = os.path.getsize(path) / (1 << 20)
-    nthread = _bench_nthread()
+    probe = _host_probe()
+    best_runs, best_med = None, -1.0
+    for nthread in sorted({1, _bench_nthread()}):
+        runs = []
+        for _ in range(TRIALS + 1):
+            t0 = time.time()
+            parser = create_parser(path, 0, 1, nthread=nthread)
+            rows = sum(len(b) for b in parser)
+            dt = time.time() - t0
+            parser.close()
+            assert rows == CRITEO_ROWS, f"criteo row count mismatch: {rows}"
+            runs.append(round(size_mb / dt, 1))
+        med = statistics.median(runs[1:])
+        if med > best_med:
+            best_runs, best_med = runs[1:], med
+    return {"probe_gbps": probe, "trials": best_runs}
 
-    parse_runs = []
-    for _ in range(TRIALS + 1):
-        t0 = time.time()
-        parser = create_parser(path, 0, 1, nthread=nthread)
-        rows = sum(len(b) for b in parser)
-        dt = time.time() - t0
-        parser.close()
-        assert rows == CRITEO_ROWS, f"criteo row count mismatch: {rows}"
-        parse_runs.append(round(size_mb / dt, 1))
 
-    out = {
-        "criteo_like_parse_mbps": round(statistics.median(parse_runs[1:]), 1),
-        "criteo_like_parse_trials_mbps": parse_runs[1:],
-        "criteo_like_file_mb": round(size_mb, 1),
-        "criteo_like_feature_space": CRITEO_DIM,
-    }
-    if not device_ok:
-        return out
-
+def _bench_criteo_sgd() -> dict:
+    """Criteo sparse END-TO-END on the attached device: parse → sharded-COO
+    staging → csr train step (segment-sum SpMV grads over the 2^20 feature
+    space) → SGD — the north-star workload's device loop."""
     import jax.numpy as jnp
 
+    from dmlc_tpu.data import create_parser
     from dmlc_tpu.device import BatchSpec, DeviceFeed
     from dmlc_tpu.models.linear import (
         init_linear_params,
         make_linear_train_step,
     )
 
-    batch = 8192
-    spec = BatchSpec(batch_size=batch, layout="csr",
+    path = _ensure_criteo_like()
+    size_mb = os.path.getsize(path) / (1 << 20)
+    nthread = _bench_nthread()
+    spec = BatchSpec(batch_size=8192, layout="csr",
                      num_features=CRITEO_DIM + 1,
                      nnz_bucket=1 << 19)
     step = make_linear_train_step(
@@ -358,9 +408,43 @@ def _bench_criteo_like(device_ok: bool = True) -> dict:
         lambda: DeviceFeed(create_parser(path, 0, 1, nthread=nthread), spec),
         size_mb, step, "csr", params, velocity,
     )
-    out["criteo_like_csr_sgd_mbps"] = round(statistics.median(sgd_runs[1:]), 1)
-    out["criteo_like_csr_sgd_trials_mbps"] = sgd_runs[1:]
-    return out
+    return {
+        "criteo_like_csr_sgd_mbps": round(statistics.median(sgd_runs[1:]), 1),
+        "criteo_like_csr_sgd_trials_mbps": sgd_runs[1:],
+    }
+
+
+def _bench_recordio_sgd(path: str) -> dict:
+    """Recordio row-group → native StageBatch → dense SGD on the attached
+    device: the scan-free binary ingest path driven all the way to the
+    chip (host-side it parses at GB/s; this tier proves that throughput
+    survives to the training loop instead of dying before H2D)."""
+    import jax.numpy as jnp
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.device import BatchSpec, DeviceFeed
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+    )
+
+    rec = _ensure_recordio(path)
+    size_mb = os.path.getsize(rec) / (1 << 20)
+    spec = BatchSpec(batch_size=16384, layout="dense", num_features=29)
+    params = init_linear_params(29)
+    velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
+    step = make_linear_train_step(None, learning_rate=0.1, layout="dense")
+    runs = _timed_sgd_epochs(
+        lambda: DeviceFeed(
+            create_parser(rec, 0, 1, data_format="recordio", nthread=1),
+            spec,
+        ),
+        size_mb, step, "dense", params, velocity,
+    )
+    return {
+        "recordio_sgd_mbps": round(statistics.median(runs[1:]), 1),
+        "recordio_sgd_trials_mbps": runs[1:],
+    }
 
 
 def _bench_device_feed(path: str) -> dict:
@@ -469,11 +553,14 @@ def _bench_device_feed(path: str) -> dict:
     return out
 
 
-def _bench_remote_ingest(path: str) -> float:
-    """Loopback fake-S3 → parallel range-GET readahead → native push
-    pipeline, MB/s (the Criteo-class object-store ingest shape, hermetic).
-    The in-process HTTP server shares the host CPUs, so this is a floor."""
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+def _remote_sweep(path: str) -> dict:
+    """One loopback fake-S3 → parallel range-GET readahead → native push
+    pipeline sweep → {probe_gbps, trials, score, conns} (the Criteo-class
+    object-store ingest shape, hermetic). The in-process HTTP server shares
+    the host CPUs, so every number here is a floor. Score = the better
+    connection-count config's median."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
     from fake_object_store import serve
 
     from dmlc_tpu.data.parsers import NativePipelineParser, create_parser
@@ -484,6 +571,7 @@ def _bench_remote_ingest(path: str) -> float:
     old_env = {k: os.environ.get(k) for k in
                ("S3_ENDPOINT", "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
                 "DMLC_TPU_READAHEAD_CONNS")}
+    probe = _host_probe()
     try:
         os.environ["S3_ENDPOINT"] = base
         os.environ.pop("AWS_ACCESS_KEY_ID", None)
@@ -493,7 +581,7 @@ def _bench_remote_ingest(path: str) -> float:
             store.objects[("bench", "higgs.svm")] = fh.read()
         size = os.path.getsize(path)
         nthread = 1 if (os.cpu_count() or 1) <= 2 else 2
-        best = 0.0
+        best = None  # (median, runs, conns)
         for conns in (1, 4):
             os.environ["DMLC_TPU_READAHEAD_CONNS"] = str(conns)
             runs = []
@@ -512,9 +600,12 @@ def _bench_remote_ingest(path: str) -> float:
                 dt = time.time() - t0
                 parser.close()
                 assert rows == ROWS, f"remote row count mismatch: {rows}"
-                runs.append(size / (1 << 20) / dt)
-            best = max(best, statistics.median(runs))
-        return best
+                runs.append(round(size / (1 << 20) / dt, 1))
+            med = statistics.median(runs)
+            if best is None or med > best[0]:
+                best = (med, runs, conns)
+        return {"probe_gbps": probe, "trials": best[1],
+                "score": best[0], "conns": best[2]}
     finally:
         server.shutdown()
         for k, v in old_env.items():
@@ -529,45 +620,52 @@ def main() -> None:
     path = _ensure_data()
 
     _one_pass(path, 1)  # warmup: native build, page cache, allocators
-    sweeps = [_headline_sweep(path)]
 
-    extra = {}
-    try:
-        extra.update(_bench_recordio(path))
-    except Exception as err:  # the headline metric must still print
-        extra["recordio_error"] = str(err)
-    device_ok, device_note = _device_backend_ok()
+    # host tiers all follow the headline's bimodal-host discipline: three
+    # sweeps spread across the run, probe next to each, best sweep scores
+    host_tiers = {
+        "recordio_ingest": lambda: _recordio_sweep(path),
+        "criteo_like_parse": _criteo_parse_sweep,
+        "remote_ingest": lambda: _remote_sweep(path),
+    }
+    tier_sweeps = {name: [] for name in host_tiers}
+
+    def run_host_tier_sweeps():
+        for name, fn in host_tiers.items():
+            try:
+                tier_sweeps[name].append(fn())
+            except Exception as err:  # the headline must still print
+                tier_sweeps[name].append({"error": str(err)})
+
+    sweeps = [_headline_sweep(path)]
+    run_host_tier_sweeps()  # tier sweep 1
+
+    extra = {
+        "criteo_like_file_mb": round(
+            os.path.getsize(_ensure_criteo_like()) / (1 << 20), 1),
+        "criteo_like_feature_space": CRITEO_DIM,
+        "recordio_file_mb": round(
+            os.path.getsize(_ensure_recordio(path)) / (1 << 20), 1),
+    }
+    device_ok, device_note, probe_record = _device_backend_ok()
+    extra["device_probe"] = probe_record
     extra["device_feed_probe_gbps"] = _host_probe()
     if not device_ok:
         extra["device_unavailable"] = device_note + "; device tiers skipped"
     else:
-        try:
-            extra.update(_bench_device_feed(path))
-        except Exception as err:
-            extra["device_feed_error"] = str(err)
-    try:
-        extra.update(_bench_criteo_like(device_ok=device_ok))
-    except Exception as err:
-        extra["criteo_like_error"] = str(err)
+        for tier_fn, err_key in (
+            (lambda: _bench_device_feed(path), "device_feed_error"),
+            (lambda: _bench_recordio_sgd(path), "recordio_sgd_error"),
+            (_bench_criteo_sgd, "criteo_sgd_error"),
+        ):
+            try:
+                extra.update(tier_fn())
+            except Exception as err:
+                extra[err_key] = str(err)
 
     sweeps.append(_headline_sweep(path))
+    run_host_tier_sweeps()  # tier sweep 2
 
-    try:
-        extra["remote_ingest_mbps"] = round(_bench_remote_ingest(path), 1)
-        # The loopback harness runs BOTH http ends and the parser on this
-        # host's core(s): at 1 core the serial budget is parse (~0.26s for
-        # the workload at the measured 700+ MB/s kernel) + server slice/
-        # send + client recv (~0.25s of python http at the measured 2.7
-        # GB/s raw socket), so ~55-60% of the local number IS the
-        # all-on-one-core ceiling, not a product limit — the product path
-        # (readahead fetch threads + native push parse) overlaps these on
-        # independent cores/NICs on a real host.
-        extra["remote_ingest_note"] = (
-            "loopback fake-S3 shares this host's core(s) with the parser; "
-            "serial floor, not the product ceiling"
-        )
-    except Exception as err:
-        extra["remote_ingest_error"] = str(err)
     try:
         from bench_collective import collective_metrics
 
@@ -576,6 +674,28 @@ def main() -> None:
         extra["collective_error"] = str(err)
 
     sweeps.append(_headline_sweep(path))
+    run_host_tier_sweeps()  # tier sweep 3
+
+    for name, tier in tier_sweeps.items():
+        value, sw_extra = _combine_tier(tier)
+        if value is None:
+            extra[name + "_error"] = "; ".join(
+                sw.get("error", "no trials") for sw in tier)
+        else:
+            extra[name + "_mbps"] = round(value, 1)
+            extra[name + "_sweeps"] = sw_extra
+    if "remote_ingest_mbps" in extra:
+        # The loopback harness runs BOTH http ends and the parser on this
+        # host's core(s): at 1 core the serial budget is parse + server
+        # slice/send + client recv, so ~55-70% of the local number IS the
+        # all-on-one-core ceiling, not a product limit — the product path
+        # (readahead fetch threads + native push parse) overlaps these on
+        # independent cores/NICs on a real host.
+        extra["remote_ingest_note"] = (
+            "loopback fake-S3 shares this host's core(s) with the parser; "
+            "serial floor, not the product ceiling"
+        )
+
     headline, headline_extra = _combine_headline(sweeps)
     extra = {**headline_extra, **extra}
 

@@ -112,6 +112,22 @@ def socket_allreduce_metrics(
     return out
 
 
+def allreduce_algo_metrics(n: int, nbytes: int, dt: float,
+                           platform: str) -> dict:
+    """Pure estimator for the >1-device psum tier (factored out so the
+    virtual-mesh tests exercise it without real multi-chip hardware).
+    Ring-allreduce moves 2(n-1)/n × size per device, so achieved
+    algorithm bandwidth = that volume / step time; on TPU the ICI
+    utilization is achieved / peak (``DMLC_TPU_ICI_PEAK_GBPS``
+    per-direction per-link, default 45 for v5e)."""
+    algo_bytes = 2 * (n - 1) / n * nbytes  # per-device wire volume
+    metrics = {"psum_algo_gbps": round(algo_bytes / dt / 1e9, 3)}
+    if platform == "tpu":
+        peak = float(os.environ.get("DMLC_TPU_ICI_PEAK_GBPS", 45.0)) * 1e9
+        metrics["psum_ici_utilization"] = round((algo_bytes / dt) / peak, 3)
+    return metrics
+
+
 def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
     """Jitted psum-allreduce step over the device mesh axis: per-step time
     and achieved algorithm bytes/s. Ring-allreduce moves 2(n-1)/n × size
@@ -166,18 +182,63 @@ def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
         "psum_step_ms": round(dt * 1e3, 3),
     }
     if n > 1:
-        algo_bytes = 2 * (n - 1) / n * nbytes  # per-device wire volume
-        metrics["psum_algo_gbps"] = round(algo_bytes / dt / 1e9, 3)
-        if devices[0].platform == "tpu":
-            peak = float(os.environ.get("DMLC_TPU_ICI_PEAK_GBPS", 45.0)) * 1e9
-            metrics["psum_ici_utilization"] = round(
-                (algo_bytes / dt) / peak, 3
-            )
+        metrics.update(
+            allreduce_algo_metrics(n, nbytes, dt, devices[0].platform)
+        )
     else:
         # single device: psum over a size-1 axis is a pass-through; this
         # measures step dispatch + donation only, not a collective
         metrics["psum_single_device_gbps"] = round(nbytes / dt / 1e9, 3)
     return metrics
+
+
+def grad_bucket_metrics(iters: int = 20) -> dict:
+    """Fused-bucket vs per-tensor gradient allreduce A/B on whatever
+    devices exist (preparing for the ICI-utilization target before
+    multi-chip hardware does: one concatenated psum per step vs one psum
+    per leaf). The pytree mimics a small transformer's grad structure —
+    many leaves of very different sizes — where combiner behavior actually
+    matters."""
+    import jax
+    import numpy as np
+
+    from dmlc_tpu.collective.device import make_allreduce_step
+    from dmlc_tpu.parallel.mesh import batch_sharding, data_parallel_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = data_parallel_mesh(devices)
+    sharding = batch_sharding(mesh)
+
+    rng = np.random.RandomState(0)
+    # ~24 MB over 26 leaves: embeddings, per-layer qkvo + mlp + norms
+    shapes = [(1024, 512), (512, 512), (512, 512), (512, 512), (512, 512),
+              (512, 2048), (2048, 512), (512,), (512,)] * 2 + [
+        (1024, 512), (8, 512), (512,), (512,), (2048,), (2048,), (512, 512),
+        (512,)]
+    grads = {
+        f"g{i}": rng.randn(n, *s).astype(np.float32)
+        for i, s in enumerate(shapes)
+    }  # leading dim shards over dp
+    nbytes = sum(g.nbytes for g in grads.values())
+
+    out = {"bucket_payload_mb": round(nbytes / (1 << 20), 1),
+           "bucket_leaves": len(shapes)}
+    for key, bucket in (("bucket_fused_ms", True),
+                        ("bucket_per_tensor_ms", False)):
+        step = make_allreduce_step(mesh, axis="dp", bucket=bucket)
+
+        def one():
+            x = {k: jax.device_put(v, sharding) for k, v in grads.items()}
+            jax.block_until_ready(x)
+            t0 = time.perf_counter()
+            y = step(x)
+            jax.block_until_ready(y)
+            return time.perf_counter() - t0
+
+        one()  # compile + warmup
+        out[key] = round(min(one() for _ in range(iters)) * 1e3, 3)
+    return out
 
 
 def device_engine_allreduce_metrics(
@@ -261,6 +322,10 @@ def collective_metrics(device_ok: bool = True) -> dict:
         out.update(device_psum_metrics())
     except Exception as err:
         out["psum_error"] = str(err)
+    try:
+        out.update(grad_bucket_metrics())
+    except Exception as err:
+        out["bucket_error"] = str(err)
     if not device_ok:
         out["engine_tier_skipped"] = "jax backend unavailable"
         return out

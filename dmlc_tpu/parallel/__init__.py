@@ -9,6 +9,7 @@ rank/world-size bookkeeping the tracker used to own.
 
 from dmlc_tpu.parallel.mesh import (
     make_mesh,
+    make_multislice_mesh,
     data_parallel_mesh,
     local_mesh,
     batch_sharding,
@@ -18,6 +19,7 @@ from dmlc_tpu.parallel.mesh import (
 
 __all__ = [
     "make_mesh",
+    "make_multislice_mesh",
     "data_parallel_mesh",
     "local_mesh",
     "batch_sharding",

@@ -39,3 +39,67 @@ class TestDeviceTier:
         key = ("engine_allreduce_gbps" if out["engine_allreduce_world"] > 1
                else "engine_reduce_single_process_gbps")
         assert out[key] > 0
+
+    def test_algo_estimator_tpu_branch(self, monkeypatch):
+        """The ICI-utilization estimator (unreachable on CPU meshes) as a
+        pure function: ring algo volume 2(n-1)/n × size, utilization =
+        achieved / peak."""
+        from bench_collective import allreduce_algo_metrics
+
+        n, nbytes, dt = 8, 32 << 20, 0.001
+        monkeypatch.setenv("DMLC_TPU_ICI_PEAK_GBPS", "45")
+        out = allreduce_algo_metrics(n, nbytes, dt, "tpu")
+        algo = 2 * (n - 1) / n * nbytes
+        assert out["psum_algo_gbps"] == round(algo / dt / 1e9, 3)
+        assert out["psum_ici_utilization"] == round(
+            (algo / dt) / 45e9, 3)
+        assert "psum_ici_utilization" not in allreduce_algo_metrics(
+            n, nbytes, dt, "cpu")
+
+    def test_grad_bucket_tier(self):
+        out = bench_collective.grad_bucket_metrics(iters=2)
+        assert out["bucket_leaves"] > 20
+        assert out["bucket_fused_ms"] > 0
+        assert out["bucket_per_tensor_ms"] > 0
+
+
+class TestBucketedAllreduce:
+    def test_bucketed_matches_per_tensor(self):
+        """bucket=True must be numerically identical to per-leaf psums,
+        across mixed shapes and dtypes (dtype-grouped concat)."""
+        import jax
+        import numpy as np
+
+        from dmlc_tpu.collective.device import make_allreduce_step
+        from dmlc_tpu.parallel.mesh import (
+            batch_sharding,
+            data_parallel_mesh,
+        )
+
+        mesh = data_parallel_mesh()
+        n = len(jax.devices())
+        sharding = batch_sharding(mesh)
+        rng = np.random.RandomState(5)
+        grads = {
+            "w": rng.randn(n, 4, 3).astype(np.float32),
+            "b": rng.randn(n, 7).astype(np.float32),
+            # f16 exercises the dtype-grouped concat (f64 would silently
+            # downcast at device_put under default jax_enable_x64=False)
+            "emb": rng.randn(n, 2, 5).astype(np.float16),
+            "scale": rng.randn(n, 1).astype(np.float32),
+        }
+        put = {k: jax.device_put(v, sharding) for k, v in grads.items()}
+        fused = make_allreduce_step(mesh, bucket=True)(put)
+        put2 = {k: jax.device_put(v, sharding) for k, v in grads.items()}
+        per = make_allreduce_step(mesh, bucket=False)(put2)
+        for k in grads:
+            tol = 1e-2 if grads[k].dtype == np.float16 else 1e-5
+            np.testing.assert_allclose(
+                np.asarray(fused[k]), np.asarray(per[k]), rtol=tol
+            )
+            np.testing.assert_allclose(  # leading dim stays shard-local
+                np.asarray(fused[k])[0],
+                grads[k].astype(np.float32).sum(axis=0),
+                rtol=tol, atol=tol,
+            )
+            assert fused[k].dtype == grads[k].dtype
