@@ -131,9 +131,17 @@ def _device_backend_ok(timeout_s: float = None, attempts: int = None,
     MB/s). Env knobs DMLC_TPU_BENCH_PROBE_ATTEMPTS/_TIMEOUT bound the
     worst-case wait (3 x 90s + backoff by default)."""
     if timeout_s is None:
-        timeout_s = float(os.environ.get("DMLC_TPU_BENCH_PROBE_TIMEOUT", 90))
+        try:
+            timeout_s = float(
+                os.environ.get("DMLC_TPU_BENCH_PROBE_TIMEOUT", 90))
+        except ValueError:  # malformed env must not cost the round its JSON
+            timeout_s = 90.0
     if attempts is None:
-        attempts = int(os.environ.get("DMLC_TPU_BENCH_PROBE_ATTEMPTS", 3))
+        try:
+            attempts = int(
+                os.environ.get("DMLC_TPU_BENCH_PROBE_ATTEMPTS", 3))
+        except ValueError:
+            attempts = 3
     record = {"attempts": []}
     note = "device probe disabled (DMLC_TPU_BENCH_PROBE_ATTEMPTS < 1)"
     for i in range(attempts):
@@ -662,6 +670,21 @@ def main() -> None:
                 extra.update(tier_fn())
             except Exception as err:
                 extra[err_key] = str(err)
+        try:
+            # chip-vs-CPU-world parity artifact (north star: bit-exact
+            # loss parity vs the CPU/MPI path; tools/parity.py documents
+            # the reduction-order construction and what cross-backend
+            # tolerance means)
+            from dmlc_tpu.tools.parity import run_parity
+
+            parity = run_parity(world=2, steps=3)
+            extra["parity"] = {
+                k: parity[k]
+                for k in ("single_backend", "bitexact", "max_grad_ulp",
+                          "max_loss_rel", "max_param_abs_diff", "pass")
+            }
+        except Exception as err:
+            extra["parity_error"] = str(err)
 
     sweeps.append(_headline_sweep(path))
     run_host_tier_sweeps()  # tier sweep 2

@@ -128,17 +128,15 @@ def allreduce_algo_metrics(n: int, nbytes: int, dt: float,
     return metrics
 
 
-def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
-    """Jitted psum-allreduce step over the device mesh axis: per-step time
-    and achieved algorithm bytes/s. Ring-allreduce moves 2(n-1)/n × size
-    per device, so achieved_bw = that volume / step time; utilization is
-    reported only on real multi-device TPU."""
+def _maybe_force_cpu_devices() -> None:
+    """DMLC_TPU_BENCH_CPU_DEVICES: shape-coverage mode on a virtual CPU
+    mesh. Every jax-touching tier must call this BEFORE jax.devices() —
+    the interpreter may boot with a TPU hook whose backend init hangs on
+    a dead tunnel, and config.update (not the env var) is what still
+    works after jax was pre-imported (same trick as tests/conftest)."""
     import jax
 
     if os.environ.get("DMLC_TPU_BENCH_CPU_DEVICES"):
-        # shape-coverage mode: virtual CPU mesh (the interpreter may boot
-        # with a TPU hook that pre-imported jax, so config.update — not the
-        # env var — is what still works here; same trick as tests/conftest)
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
@@ -146,6 +144,16 @@ def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
                 + os.environ["DMLC_TPU_BENCH_CPU_DEVICES"]
             ).strip()
         jax.config.update("jax_platforms", "cpu")
+
+
+def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
+    """Jitted psum-allreduce step over the device mesh axis: per-step time
+    and achieved algorithm bytes/s. Ring-allreduce moves 2(n-1)/n × size
+    per device, so achieved_bw = that volume / step time; utilization is
+    reported only on real multi-device TPU."""
+    import jax  # noqa: F401  (backend touched below)
+
+    _maybe_force_cpu_devices()
 
     import numpy as np
 
@@ -201,6 +209,8 @@ def grad_bucket_metrics(iters: int = 20) -> dict:
     matters."""
     import jax
     import numpy as np
+
+    _maybe_force_cpu_devices()  # standalone-callable without a tunnel
 
     from dmlc_tpu.collective.device import make_allreduce_step
     from dmlc_tpu.parallel.mesh import batch_sharding, data_parallel_mesh

@@ -65,7 +65,19 @@ def _multislice_order(devs, num_slices: Optional[int]):
             f"{len(devs)} devices do not split into {num_slices} slices"
         )
     per_slice = len(devs) // num_slices
-    if reported is not None and reported == num_slices and reported > 1:
+    if reported is not None and reported > 1:
+        # Hardware reports real slices: sort along slice boundaries and
+        # require num_slices to be a multiple of the hardware count, so
+        # each contiguous dcn row subdivides ONE slice (subdividing is
+        # conservative — some "dcn" hops are really ICI — but a row
+        # spanning two slices would silently put DCN hops inside an ICI
+        # axis, which the row check below rejects in every case).
+        if num_slices % reported:
+            raise ValueError(
+                f"num_slices={num_slices} does not tile the {reported} "
+                "hardware slices (must be a multiple, so no dcn row "
+                "spans two slices)"
+            )
         devs = sorted(devs, key=lambda d: (d.slice_index, d.id))
         for row in range(num_slices):
             row_devs = devs[row * per_slice:(row + 1) * per_slice]
@@ -75,12 +87,6 @@ def _multislice_order(devs, num_slices: Optional[int]):
                     f"dcn row {row} spans slices "
                     f"{sorted({d.slice_index for d in row_devs})}"
                 )
-    elif reported is not None and reported > num_slices:
-        raise ValueError(
-            f"num_slices={num_slices} but devices report {reported} "
-            "slices (grouping fewer virtual slices than hardware slices "
-            "would put DCN hops inside an ICI axis)"
-        )
     return devs, num_slices
 
 

@@ -40,6 +40,7 @@ _COMMANDS = {
     "strtonum": "dmlc_tpu.tools.strtonum",
     "rowrec": "dmlc_tpu.tools.rowrec",
     "serve": "dmlc_tpu.tools.serve",
+    "parity": "dmlc_tpu.tools.parity",
 }
 
 

@@ -1,0 +1,308 @@
+"""CPU↔TPU bit-parity harness for the allreduce-SGD loop.
+
+North star (BASELINE.json): "bit-exact loss parity vs the CPU/MPI path".
+Two layers of control (SURVEY §7 hard parts: "deterministic reduction
+order; f32 accumulation control"):
+
+1. **Reduction-order control — bit-exact by construction on one
+   backend.** Gradients cross the socket engine as a ``[W, N]`` SLOT
+   EXCHANGE: rank r contributes its packed grads in row r and zeros
+   elsewhere. Under ANY allreduce fold order — tree, ring, any world
+   size — row r of the summed matrix is rank r's bytes unchanged,
+   because ``0.0 + x == x`` bitwise for every x. Every path then folds
+   rows 0..W-1 left-to-right in f32 and applies the SGD update in host
+   numpy. The single-process path computes the same W per-part partial
+   grads (same InputSplit partition, same jitted kernel) and folds
+   identically — so a W-process socket run and a single-process run on
+   the same backend produce BIT-IDENTICAL parameter trajectories, for
+   any W and either topology (tested at tree and forced-ring; the
+   reference's rabit makes the same bit-reproducibility claim for its
+   tree, tracker.py:185-225 — this construction extends it across
+   topologies AND across world sizes).
+
+2. **Cross-backend measurement.** TPU-vs-CPU bitwise equality is not a
+   meaningful target: the local gradient kernels differ (MXU matmul
+   accumulation order, FMA contraction), and by construction that is the
+   ONLY difference left. The harness compares the per-step ``[W, N]``
+   gradient matrices entry-wise (max ulp distance) and asserts the loss
+   trajectory agrees within ``--rtol`` (default 1e-5 — the documented
+   achieved tolerance; run with the chip up to record the real number in
+   the JSON artifact).
+
+Usage::
+
+    python -m dmlc_tpu.tools parity [--world 2] [--steps 5] [--uri U]
+        [--force-ring] [--single-backend default|cpu] [--rtol 1e-5]
+
+Prints ONE JSON line: bitexact flag, max grad ulp / param diff / loss
+rel-diff, per-step losses from both paths, and both backends' names.
+Exit 0 iff parity holds (bit-exact on same backend; within rtol across).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import tempfile
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_PACK_TAIL = 3  # gb, loss_sum, wsum appended to gw
+
+
+def _part_dense(uri: str, part: int, nparts: int,
+                num_features: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse part k/n of the libsvm URI to dense [rows, F] f32 + labels.
+    Both paths use this SAME partition, so the per-part row sets match."""
+    from dmlc_tpu.data import create_parser
+
+    parser = create_parser(uri, part, nparts, nthread=1)
+    xs, ys = [], []
+    for block in parser:
+        n = len(block)
+        x = np.zeros((n, num_features), np.float32)
+        offs = np.asarray(block.offset)
+        idx = np.asarray(block.index)
+        val = (np.asarray(block.value) if block.value is not None
+               else np.ones(len(idx), np.float32))
+        for i in range(n):
+            lo, hi = offs[i], offs[i + 1]
+            x[i, idx[lo:hi]] = val[lo:hi]
+        xs.append(x)
+        ys.append(np.asarray(block.label, np.float32))
+    parser.close()
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def _make_grad_fn():
+    """One jitted local-gradient kernel shared by both paths."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmlc_tpu.ops.objectives import margin_loss_grad
+
+    @jax.jit
+    def grads(w, b, x, y):
+        margin = x @ w + b
+        loss, gmargin = margin_loss_grad("logistic", margin, y)
+        return (x.T @ gmargin, jnp.sum(gmargin), jnp.sum(loss),
+                jnp.float32(x.shape[0]))
+
+    return grads
+
+
+def _pack(gw, gb, loss_sum, wsum) -> np.ndarray:
+    return np.concatenate([
+        np.asarray(gw, np.float32),
+        np.asarray([gb, loss_sum, wsum], np.float32),
+    ])
+
+
+def _fold_update(mat: np.ndarray, w: np.ndarray, b: np.float32,
+                 lr: float) -> Tuple[np.ndarray, np.float32, float]:
+    """Left fold of the [W, N] rows + SGD update, all host numpy f32 —
+    identical arithmetic on every path (the jax kernels end at the
+    per-part grads; fold and update never touch a device)."""
+    acc = mat[0].copy()
+    for r in range(1, mat.shape[0]):
+        acc = acc + mat[r]
+    gw = acc[:-_PACK_TAIL]
+    gb, loss_sum, wsum = acc[-_PACK_TAIL:]
+    denom = np.float32(max(wsum, np.float32(1e-12)))
+    w = w - np.float32(lr) * (gw / denom)
+    b = np.float32(b - np.float32(lr) * (gb / denom))
+    return w, b, float(loss_sum / denom)
+
+
+def _run_steps(part_data, grad_fn, steps: int, lr: float):
+    """Shared driver: per-part grads → [W, N] matrix → fold/update.
+    Returns (per-step losses, per-step grad matrices, final w, b)."""
+    import jax.numpy as jnp
+
+    num_features = part_data[0][0].shape[1]
+    w = np.zeros(num_features, np.float32)
+    b = np.float32(0.0)
+    losses, mats = [], []
+    for _ in range(steps):
+        rows = []
+        for x, y in part_data:
+            gw, gb, ls, ws = grad_fn(
+                jnp.asarray(w), jnp.asarray(b), jnp.asarray(x),
+                jnp.asarray(y))
+            rows.append(_pack(np.asarray(gw), gb, ls, ws))
+        mat = np.stack(rows)
+        mats.append(mat)
+        w, b, loss = _fold_update(mat, w, b, lr)
+        losses.append(loss)
+    return losses, mats, w, b
+
+
+def _worker(uri, rank, world, steps, lr, num_features, tracker_port,
+            force_ring, q):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # workers ARE the CPU path
+    import jax.numpy as jnp
+
+    from dmlc_tpu.collective.socket_engine import SocketEngine
+
+    x, y = _part_dense(uri, rank, world, num_features)
+    engine = SocketEngine(tracker_uri="127.0.0.1",
+                          tracker_port=tracker_port, world_size=world)
+    if force_ring:
+        engine.ring_threshold_bytes = 0
+    try:
+        grad_fn = _make_grad_fn()
+        w = np.zeros(num_features, np.float32)
+        b = np.float32(0.0)
+        losses, mats = [], []
+        for _ in range(steps):
+            gw, gb, ls, ws = grad_fn(
+                jnp.asarray(w), jnp.asarray(b), jnp.asarray(x),
+                jnp.asarray(y))
+            row = _pack(np.asarray(gw), gb, ls, ws)
+            slot = np.zeros((world, row.shape[0]), np.float32)
+            slot[rank] = row
+            mat = engine.allreduce(slot)  # rows transport bit-exactly
+            mats.append(mat)
+            w, b, loss = _fold_update(mat, w, b, lr)
+            losses.append(loss)
+        if rank == 0:
+            q.put({"losses": losses, "w": w, "b": float(b), "mats": mats})
+    finally:
+        engine.shutdown()
+
+
+def _ulp_diff(a: np.ndarray, b: np.ndarray) -> int:
+    """Max ulp distance between two f32 arrays: map bit patterns to a
+    total order (positive floats keep their bits; negative floats mirror
+    below zero so ±0.0 coincide and the line is monotonic), then diff."""
+    def ordinal(x):
+        bits = x.astype(np.float32).view(np.uint32).astype(np.int64)
+        return np.where(bits < (1 << 31), bits, (1 << 31) - bits)
+
+    if a.size == 0:
+        return 0
+    return int(np.max(np.abs(ordinal(a) - ordinal(b))))
+
+
+def _ensure_default_data(num_features: int) -> str:
+    path = os.path.join(tempfile.gettempdir(),
+                        f"dmlc_tpu_parity_{num_features}.svm")
+    if os.path.exists(path) and os.path.getsize(path) > 0:
+        return path
+    rng = np.random.RandomState(11)
+    with open(path + ".tmp", "w") as fh:
+        for _ in range(2000):
+            label = rng.randint(0, 2)
+            vals = rng.rand(num_features)
+            fh.write(str(label) + " " + " ".join(
+                f"{j}:{vals[j]:.6f}" for j in range(num_features)) + "\n")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def run_parity(uri: Optional[str] = None, world: int = 2, steps: int = 5,
+               lr: float = 0.5, num_features: int = 12,
+               force_ring: bool = False, single_backend: str = "default",
+               rtol: float = 1e-5) -> dict:
+    """Run both paths; → result dict (the JSON artifact's content)."""
+    from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+    if uri is None:
+        uri = _ensure_default_data(num_features)
+
+    # CPU socket world
+    tracker = RabitTracker("127.0.0.1", world, port=19400, port_end=19500)
+    tracker.start(world)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker,
+                    args=(uri, r, world, steps, lr, num_features,
+                          tracker.port, force_ring, q))
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        socket_out = q.get(timeout=300)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        tracker.close()
+
+    # single-process path (the chip path when a TPU is attached)
+    import jax
+
+    if single_backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    part_data = [_part_dense(uri, k, world, num_features)
+                 for k in range(world)]
+    losses, mats, w, b = _run_steps(part_data, _make_grad_fn(), steps, lr)
+
+    max_grad_ulp = max(
+        _ulp_diff(sm, dm) for sm, dm in zip(socket_out["mats"], mats))
+    loss_rel = [
+        abs(a - c) / max(abs(c), 1e-12)
+        for a, c in zip(socket_out["losses"], losses)
+    ]
+    bitexact = (
+        max_grad_ulp == 0
+        and np.array_equal(socket_out["w"], w)
+        and socket_out["b"] == float(b)
+        and socket_out["losses"] == losses
+    )
+    return {
+        "world": world,
+        "steps": steps,
+        "topology": "ring" if force_ring else "tree",
+        "socket_backend": "cpu",
+        "single_backend": jax.devices()[0].platform,
+        "bitexact": bitexact,
+        "max_grad_ulp": max_grad_ulp,
+        "max_param_abs_diff": float(
+            np.max(np.abs(socket_out["w"] - w))),
+        "max_loss_rel": max(loss_rel) if loss_rel else 0.0,
+        "rtol": rtol,
+        "socket_losses": socket_out["losses"],
+        "single_losses": losses,
+        "pass": bool(
+            bitexact
+            if jax.devices()[0].platform == "cpu"
+            else (loss_rel and max(loss_rel) <= rtol)
+        ),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--uri", default=None)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--features", type=int, default=12)
+    ap.add_argument("--force-ring", action="store_true")
+    ap.add_argument("--single-backend", default="default",
+                    choices=["default", "cpu"])
+    ap.add_argument("--rtol", type=float, default=1e-5)
+    args = ap.parse_args(argv)
+    out = run_parity(
+        uri=args.uri, world=args.world, steps=args.steps, lr=args.lr,
+        num_features=args.features, force_ring=args.force_ring,
+        single_backend=args.single_backend, rtol=args.rtol,
+    )
+    print(json.dumps(out))
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

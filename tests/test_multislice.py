@@ -111,11 +111,26 @@ class TestMultisliceOrder:
             _multislice_order(devs, 2)
 
     def test_fewer_virtual_than_hardware_slices_rejected(self):
+        """num_slices that does not tile the hardware slice count would
+        put DCN hops inside an ICI axis — rejected."""
         from dmlc_tpu.parallel.mesh import _multislice_order
 
         devs = [_FakeDev(d, slice_index=d // 2) for d in range(8)]
-        with pytest.raises(ValueError, match="report 4 slices"):
-            _multislice_order(devs, 2)
+        with pytest.raises(ValueError, match="does not tile"):
+            _multislice_order(devs, 2)  # 2 rows over 4 hardware slices
+
+    def test_subdividing_hardware_slices_sorts_first(self):
+        """num_slices = k x hardware slices is allowed (each dcn row
+        subdivides ONE slice) — and interleaved-reporting devices must be
+        sorted so rows never mix slices."""
+        from dmlc_tpu.parallel.mesh import _multislice_order
+
+        devs = [_FakeDev(d, slice_index=d % 2) for d in range(8)]
+        ordered, n = _multislice_order(devs, 4)
+        assert n == 4
+        rows = [ordered[i * 2:(i + 1) * 2] for i in range(4)]
+        for row in rows:
+            assert len({d.slice_index for d in row}) == 1
 
 
 class TestHybridDpStep:

@@ -1,0 +1,49 @@
+"""CPU↔TPU bit-parity harness (tools/parity.py).
+
+The reduction-order construction must make a W-process socket-engine run
+and a single-process run BIT-IDENTICAL on the same backend — for any
+world size and either topology (the [W, N] slot exchange is exact under
+any fold order because 0.0 + x == x bitwise). Cross-backend (the chip
+path) reuses the same harness with a measured tolerance; on the CPU test
+mesh both paths share a backend, so bitexact is the assertion here.
+"""
+
+import numpy as np
+
+from dmlc_tpu.tools.parity import _ulp_diff, run_parity
+
+
+class TestUlpDiff:
+    def test_zero_for_identical(self):
+        a = np.array([1.5, -2.25, 0.0, 3e-9], np.float32)
+        assert _ulp_diff(a, a.copy()) == 0
+
+    def test_one_ulp_neighbors(self):
+        a = np.array([1.0], np.float32)
+        b = np.nextafter(a, np.float32(2.0))
+        assert _ulp_diff(a, b) == 1
+
+    def test_across_zero(self):
+        a = np.array([np.float32(-1e-45)])  # smallest negative subnormal
+        b = np.array([np.float32(1e-45)])
+        assert _ulp_diff(a, b) == 2
+
+
+class TestBitExactParity:
+    def test_world2_tree_bitexact(self):
+        out = run_parity(world=2, steps=3, single_backend="cpu")
+        assert out["bitexact"] is True
+        assert out["max_grad_ulp"] == 0
+        assert out["max_param_abs_diff"] == 0.0
+        assert out["socket_losses"] == out["single_losses"]
+        assert out["pass"] is True
+
+    def test_world3_forced_ring_bitexact(self):
+        """Ring reduce-scatter folds in a completely different order than
+        the tree — the slot exchange must make that invisible."""
+        out = run_parity(world=3, steps=2, force_ring=True,
+                         single_backend="cpu")
+        assert out["topology"] == "ring"
+        assert out["bitexact"] is True
+        assert out["max_grad_ulp"] == 0
+        assert out["pass"] is True
