@@ -128,6 +128,29 @@ def allreduce_algo_metrics(n: int, nbytes: int, dt: float,
     return metrics
 
 
+def crossover_sweep(world: int = 4,
+                    sizes=(64 << 10, 256 << 10, 1 << 20, 2 << 20, 4 << 20),
+                    iters: int = 4) -> dict:
+    """Tree vs ring allreduce at a ladder of sizes → the measured
+    crossover (how SocketEngine.ring_threshold_bytes was derived; rerun
+    on a new host/network to re-justify it). Returns per-size GB/s for
+    both topologies plus ``crossover_bytes``: the first size where the
+    ring at least matches the tree (None if the tree wins everywhere)."""
+    cases = []
+    for s in sizes:
+        cases.append((f"tree_{s}", s, "tree"))
+        cases.append((f"ring_{s}", s, "ring"))
+    out = socket_allreduce_metrics(world=world, cases=tuple(cases),
+                                   iters=iters)
+    crossover = None
+    for s in sizes:
+        if out[f"ring_{s}_gbps"] >= out[f"tree_{s}_gbps"]:
+            crossover = s
+            break
+    out["crossover_bytes"] = crossover
+    return out
+
+
 def _maybe_force_cpu_devices() -> None:
     """DMLC_TPU_BENCH_CPU_DEVICES: shape-coverage mode on a virtual CPU
     mesh. Every jax-touching tier must call this BEFORE jax.devices() —

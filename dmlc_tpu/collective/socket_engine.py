@@ -75,6 +75,12 @@ class SocketEngine:
         self.rank = rank
         self.world_size = world_size
         self._aborted = False
+        env_thresh = os.environ.get("DMLC_TPU_RING_THRESHOLD_BYTES")
+        if env_thresh is not None:
+            try:
+                self.ring_threshold_bytes = int(env_thresh)
+            except ValueError:
+                pass  # keep the measured default
         self.parent_rank = -1
         self.ring_prev = -1
         self.ring_next = -1
@@ -198,12 +204,26 @@ class SocketEngine:
     def _tree_children(self) -> List[int]:
         return sorted(r for r in self.tree_links if r != self.parent_rank)
 
-    # Messages at or above this size take the ring (bandwidth-optimal:
-    # 2(n-1)/n bytes per rank vs the tree's up-to-2x at the root); short
-    # messages stay on the tree (latency-optimal: log n hops vs 2(n-1)).
-    # This is the split rabit makes — the tracker builds BOTH topologies for
-    # exactly this reason (tracker.py:193-225).
-    ring_threshold_bytes: int = 1 << 18
+    # Messages at or above this size take the ring; short messages stay
+    # on the tree. This is the split rabit makes — the tracker builds BOTH
+    # topologies for exactly this reason (tracker.py:193-225).
+    #
+    # Cost model + measurement behind the 2 MB default (round 4,
+    # loopback world=4 sweep, post-TCP_NODELAY — see BASELINE.md):
+    #   tree  ≈ 2·depth·α            + serial full-N folds at the root
+    #   ring  ≈ 2(W-1)·α (more hops) + folds spread in N/W chunks
+    # Small N: the latency term dominates and the tree's 2·log2(W) hops
+    # beat the ring's 2(W-1) — measured 0.08-0.22x ring/tree at 4 KB to
+    # 256 KB. Large N: the root's serial recv+fold of full-N child
+    # payloads dominates and the ring's chunked schedule wins — measured
+    # crossover between 1 MB (ring/tree 0.65) and 3 MB (1.0-1.24), ≈2 MB
+    # at both reps; ring holds 1.1-1.4x through 16 MB. Loopback shares
+    # one memory bus, so absolute GB/s are contention floors, but the
+    # crossover compares the two schedules under identical contention.
+    # Real networks shift α and the fold rate — override via
+    # DMLC_TPU_RING_THRESHOLD_BYTES (read at engine construction) for a
+    # measured deployment.
+    ring_threshold_bytes: int = 2 << 20
 
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
         """Allreduce with rabit's topology split: tree (reduce-up in sorted

@@ -63,6 +63,15 @@ class TestDeviceTier:
         assert out["bucket_per_tensor_ms"] > 0
 
 
+class TestCrossoverSweep:
+    def test_sweep_reports_both_topologies_and_crossover(self):
+        out = bench_collective.crossover_sweep(
+            world=2, sizes=(4096, 65536), iters=2)
+        assert out["tree_4096_gbps"] > 0
+        assert out["ring_4096_gbps"] > 0
+        assert "crossover_bytes" in out  # may be None: tree can win both
+
+
 class TestBucketedAllreduce:
     def test_bucketed_matches_per_tensor(self):
         """bucket=True must be numerically identical to per-leaf psums,

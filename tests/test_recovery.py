@@ -31,8 +31,10 @@ WORKER = textwrap.dedent("""
     # ("none" = clean run; the launcher shell-joins argv, eating empty args)
     CRASH_RANKS = set(
         int(r) for r in sys.argv[2].split(",") if r not in ("", "none"))
-    # argv[3]: array size — >= 32768 f64 elements (256 KiB) takes the RING
-    # allreduce path, so a crash lands while neighbors are mid-ring
+    # argv[3]: array size. The RING-path test pins the topology via
+    # DMLC_TPU_RING_THRESHOLD_BYTES=1 in its env (not via size), so a
+    # crash lands while neighbors are mid-ring regardless of the
+    # engine's measured tree/ring threshold.
     SIZE = int(sys.argv[3])
 
     rabit.init()
@@ -69,10 +71,13 @@ WORKER = textwrap.dedent("""
 
 
 def _run_job(tmp_path, crash_ranks: str, world: int, size: int = 8,
-             tag: str = ""):
+             tag: str = "", force_ring: bool = False):
     script = tmp_path / "worker.py"
     script.write_text(WORKER.format(repo=REPO))
     ckpt = tmp_path / f"ckpt_{tag or (crash_ranks or 'clean')}.bin"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    if force_ring:  # every payload takes the RING path in the workers
+        env["DMLC_TPU_RING_THRESHOLD_BYTES"] = "1"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "dmlc-submit"),
          "--cluster", "local", "-n", str(world), "--max-attempts", "2",
@@ -80,7 +85,7 @@ def _run_job(tmp_path, crash_ranks: str, world: int, size: int = 8,
          sys.executable, str(script), str(ckpt), crash_ranks or "none",
          str(size)],
         capture_output=True, text=True, timeout=180,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        env=env,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     out = proc.stdout + proc.stderr
@@ -115,9 +120,14 @@ def test_crash_with_ring_allreduce_in_flight(tmp_path):
     """Survivors are blocked inside a RING allreduce (bandwidth path, not
     tree) when the peer dies: the ring hop errors, cascades into recover,
     and the replay still matches bit-exactly."""
-    world, size = 3, 40_000  # 320 KB > ring_threshold_bytes (256 KiB)
-    clean = _run_job(tmp_path, "", world=world, size=size, tag="ring_clean")
-    crashed = _run_job(tmp_path, "0", world=world, size=size, tag="ring_crash")
+    # force_ring pins the topology via DMLC_TPU_RING_THRESHOLD_BYTES=1 —
+    # a size-based trigger silently reverts to the tree whenever the
+    # measured threshold moves (it did: 256 KiB -> 2 MiB in round 4)
+    world, size = 3, 40_000
+    clean = _run_job(tmp_path, "", world=world, size=size,
+                     tag="ring_clean", force_ring=True)
+    crashed = _run_job(tmp_path, "0", world=world, size=size,
+                       tag="ring_crash", force_ring=True)
     expect = _expect(world)
     for rank in range(world):
         assert clean[rank] == expect
