@@ -25,7 +25,7 @@
 extern "C" {
 #endif
 
-#define DMLC_TPU_ABI_VERSION 4
+#define DMLC_TPU_ABI_VERSION 5
 
 /* ---- status codes (parsers and pipeline) ------------------------------ */
 enum {
@@ -125,6 +125,24 @@ void* ingest_push_reserve(void* handle, int64_t want);
 int ingest_push_commit(void* handle, int64_t n);
 int ingest_push_eof(void* handle);
 void ingest_push_abort(void* handle);
+
+/* Remote-ingest driver (ABI >= 5). Transport boundary, by design: this
+ * library ships no HTTP/object-store client — the consumer brings the
+ * transport (libcurl, an SDK, a socket; the Python package's s3://gs://
+ * readahead is one such consumer) and the pipeline brings record-boundary
+ * cutting, parse fan-out and ordered delivery. `fetch` is called serially
+ * with the next byte offset and a destination INSIDE the pipeline's push
+ * memory (readinto semantics — no staging copy); it returns the bytes
+ * written (<= len), 0 at end of stream, or < 0 on a transport error
+ * (which aborts the pipeline so blocked consumers fail fast instead of
+ * hanging). `total` < 0 streams until fetch returns 0; `fetch_bytes`
+ * <= 0 defaults to 1 MiB per call. On success the stream is EOF'd and
+ * the handle drains through ingest_peek/fetch as usual. Returns 0 or a
+ * pipeline error code. */
+typedef int64_t (*dmlc_tpu_fetch_fn)(void* ctx, int64_t offset, char* buf,
+                                     int64_t len);
+int ingest_drive_push(void* handle, dmlc_tpu_fetch_fn fetch, void* ctx,
+                      int64_t total, int64_t fetch_bytes);
 
 /* Block-at-a-time draining: peek blocks for the next in-order parsed block
  * (1 = ready, 0 = end of stream, <0 = pipeline error) and reports sizes;

@@ -371,6 +371,14 @@ class Pipeline {
     return 0;
   }
 
+  // The pipeline's current error code (0 = healthy) — lets the push
+  // driver report the REAL failure (e.g. a worker's kEParse) instead of
+  // guessing from a null reserve.
+  int LastError() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return error_;
+  }
+
   // Flush the remaining tail (the caller guarantees the pushed range ends
   // at a record boundary, so the tail is whole records) and close the
   // stream. Idempotent. Returns 0, or the pipeline's error code.
@@ -1376,6 +1384,50 @@ int ingest_push_commit(void* handle, int64_t n) {
 
 void ingest_push_abort(void* handle) {
   static_cast<Pipeline*>(handle)->PushAbort();
+}
+
+// Serial reserve -> caller-fetch -> commit loop over the whole stream (the
+// C-consumer twin of the Python readahead feeder; see the header for the
+// transport-boundary contract). Backpressure comes from PushCommit's
+// bounded work queue, exactly as for any other feeder.
+int ingest_drive_push(void* handle, dmlc_tpu_fetch_fn fetch, void* ctx,
+                      int64_t total, int64_t fetch_bytes) {
+  Pipeline* pl = static_cast<Pipeline*>(handle);
+  if (fetch == nullptr) return kEIo;
+  if (fetch_bytes <= 0) fetch_bytes = 1 << 20;
+  int64_t off = 0;
+  while (total < 0 || off < total) {
+    int64_t want = fetch_bytes;
+    if (total >= 0 && total - off < want) want = total - off;
+    if (want == 0) break;
+    char* dst = pl->PushReserve(want);
+    if (dst == nullptr) {
+      // null means OOM — or a pipeline that already failed (worker parse
+      // error); report the real code, not a guessed kEOom
+      int err = pl->LastError();
+      pl->PushAbort();
+      return err != 0 ? err : kEOom;
+    }
+    int64_t got = fetch(ctx, off, dst, want);
+    if (got < 0 || got > want) {
+      pl->PushAbort();
+      return kEIo;
+    }
+    if (got == 0) {
+      if (total >= 0) {
+        // premature EOF against a declared length (object truncated
+        // between stat and read, short HTTP body): consumers must see a
+        // failure, not a clean EOF with rows missing
+        pl->PushAbort();
+        return kEIo;
+      }
+      break;  // end of stream (unknown-length mode)
+    }
+    int rc = pl->PushCommit(got);
+    if (rc != 0) return rc;
+    off += got;
+  }
+  return pl->PushEof();
 }
 
 // Wait for the next in-order block and report its sizes without consuming

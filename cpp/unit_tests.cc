@@ -569,6 +569,81 @@ void test_push_reserve_commit() {
   ingest_close(h);
 }
 
+// ingest_drive_push: the C-consumer remote-ingest driver. The "transport"
+// here is a memory buffer served through the fetch callback in short,
+// varying slices (what a ranged-GET loop looks like to the pipeline).
+struct FetchCtx {
+  const std::string* text;
+  int64_t slice = 777;
+  bool fail_at_half = false;
+};
+
+int64_t MemFetch(void* vctx, int64_t offset, char* buf, int64_t len) {
+  FetchCtx* ctx = static_cast<FetchCtx*>(vctx);
+  int64_t total = static_cast<int64_t>(ctx->text->size());
+  if (ctx->fail_at_half && offset >= total / 2) return -1;  // transport err
+  if (offset >= total) return 0;  // end of stream
+  int64_t n = std::min<int64_t>(len, total - offset);
+  n = std::min<int64_t>(n, ctx->slice);  // short reads
+  ctx->slice = ctx->slice * 3 % 4096 + 64;
+  std::memcpy(buf, ctx->text->data() + offset, static_cast<size_t>(n));
+  return n;
+}
+
+void test_drive_push() {
+  const int kRows = 5000;
+  std::string text;
+  for (int i = 0; i < kRows; ++i) {
+    text += std::to_string(i % 2) + " 1:" + std::to_string(i) + ".5\n";
+  }
+  // unknown-length mode (total = -1): the callback's 0 return ends it
+  void* h = ingest_open_push(/*libsvm=*/0, /*nthread=*/2, /*chunk=*/1 << 14,
+                             /*capacity=*/4, 0);
+  CHECK_TRUE(h != nullptr);
+  FetchCtx ctx{&text};
+  CHECK_TRUE(ingest_drive_push(h, MemFetch, &ctx, -1, 1 << 12) == 0);
+  int64_t total_rows = 0;
+  for (;;) {
+    int64_t rows, nnz, ncols;
+    int32_t flags;
+    int rc = ingest_peek(h, &rows, &nnz, &ncols, &flags);
+    CHECK_TRUE(rc >= 0);
+    if (rc == 0) break;
+    std::vector<float> labels(rows), values(nnz);
+    std::vector<int64_t> offsets(rows + 1);
+    std::vector<uint32_t> indices(nnz);
+    CHECK_TRUE(ingest_fetch(h, labels.data(), nullptr, nullptr,
+                            offsets.data(), indices.data(), values.data(),
+                            nullptr) == 1);
+    total_rows += rows;
+  }
+  CHECK_TRUE(total_rows == kRows);
+  ingest_close(h);
+
+  // transport failure mid-stream must abort the pipeline: the driver
+  // returns an error and consumers see a failure, not a clean EOF
+  void* h2 = ingest_open_push(0, 1, 1 << 14, 4, 0);
+  CHECK_TRUE(h2 != nullptr);
+  FetchCtx bad{&text};
+  bad.fail_at_half = true;
+  CHECK_TRUE(ingest_drive_push(h2, MemFetch, &bad, -1, 1 << 12) < 0);
+  int64_t rows, nnz, ncols;
+  int32_t flags;
+  CHECK_TRUE(ingest_peek(h2, &rows, &nnz, &ncols, &flags) < 0);
+  ingest_close(h2);
+
+  // premature EOF against a declared length (truncated object / short
+  // body) must also fail, not deliver a clean-but-short stream
+  void* h3 = ingest_open_push(0, 1, 1 << 14, 4, 0);
+  CHECK_TRUE(h3 != nullptr);
+  FetchCtx trunc{&text};
+  CHECK_TRUE(ingest_drive_push(h3, MemFetch, &trunc,
+                               static_cast<int64_t>(text.size()) * 2,
+                               1 << 12) < 0);
+  CHECK_TRUE(ingest_peek(h3, &rows, &nnz, &ncols, &flags) < 0);
+  ingest_close(h3);
+}
+
 }  // namespace
 
 // Deterministic structured fuzz of the chunk parsers (the adversarial
@@ -670,6 +745,7 @@ int main() {
   test_pipeline_recordio_format();
   test_batch_coo_sharded();
   test_push_reserve_commit();
+  test_drive_push();
   std::printf("cpp unit tests ok (%d checks)\n", g_checks);
   return 0;
 }

@@ -199,7 +199,7 @@ def _load(path: str):
         _bind(lib)
     except (OSError, AttributeError):
         return None
-    if lib.dmlc_tpu_abi_version() != 4:
+    if lib.dmlc_tpu_abi_version() != 5:
         raise DMLCError(f"native ABI mismatch in {path}")
     return lib
 

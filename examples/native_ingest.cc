@@ -5,25 +5,51 @@
 // Build (from the repo root, after `make -C cpp`; one line):
 //   g++ -O2 -std=c++17 examples/native_ingest.cc
 //       -Icpp -Lcpp -ldmlc_tpu -Wl,-rpath,$PWD/cpp -o native_ingest
-//   ./native_ingest data.svm
+//   ./native_ingest data.svm            # local-file reader pipeline
+//   ./native_ingest --remote data.svm   # remote-shaped drive_push path
 //
-// Streams a libsvm file through the threaded native pipeline (reader
-// thread -> parse workers -> ordered CSR blocks) and prints totals — the
-// same engine the Python package drives through ctypes.
+// Default mode streams a libsvm file through the threaded native pipeline
+// (reader thread -> parse workers -> ordered CSR blocks) and prints
+// totals — the same engine the Python package drives through ctypes.
+//
+// --remote demonstrates ingest_drive_push, the C-consumer remote-ingest
+// surface: the pipeline ships no transport (the consumer brings libcurl /
+// an SDK / a socket — here a pread-backed callback stands in for ranged
+// GETs), and the fetch callback lands bytes directly in pipeline push
+// memory (readinto semantics, no staging copy). The driver blocks for
+// backpressure, so real consumers run it on a feeder thread while the
+// main thread drains — exactly what this program does.
 
 #include <cinttypes>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <sys/stat.h>
 
 #include "dmlc_tpu.h"
 
+namespace {
+
+// The stand-in "transport": serve [offset, offset+len) of a local file the
+// way a ranged-GET loop would. A real consumer points this at HTTP.
+int64_t FileFetch(void* ctx, int64_t offset, char* buf, int64_t len) {
+  std::FILE* f = static_cast<std::FILE*>(ctx);
+  if (std::fseek(f, static_cast<long>(offset), SEEK_SET) != 0) return -1;
+  size_t got = std::fread(buf, 1, static_cast<size_t>(len), f);
+  if (got == 0 && std::ferror(f)) return -1;
+  return static_cast<int64_t>(got);
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
-  if (argc != 2) {
-    std::fprintf(stderr, "usage: %s <file.svm>\n", argv[0]);
+  bool remote = argc == 3 && std::strcmp(argv[1], "--remote") == 0;
+  if (argc != 2 && !remote) {
+    std::fprintf(stderr, "usage: %s [--remote] <file.svm>\n", argv[0]);
     return 2;
   }
+  if (remote) argv[1] = argv[2];
   if (dmlc_tpu_abi_version() != DMLC_TPU_ABI_VERSION) {
     std::fprintf(stderr, "ABI mismatch: header %d, library %d\n",
                  DMLC_TPU_ABI_VERSION, dmlc_tpu_abi_version());
@@ -34,17 +60,41 @@ int main(int argc, char** argv) {
     std::perror("stat");
     return 1;
   }
-  // paths: NUL-terminated strings back to back (one file here)
-  std::string paths(argv[1]);
-  paths.push_back('\0');
   int64_t size = static_cast<int64_t>(st.st_size);
-  void* h = ingest_open(paths.data(), &size, /*nfiles=*/1,
-                        DMLC_TPU_FORMAT_LIBSVM, /*part=*/0, /*nparts=*/1,
-                        /*nthread=*/2, /*chunk_bytes=*/8 << 20,
-                        /*capacity=*/4, /*csv_expect_cols=*/0);
-  if (h == nullptr) {
-    std::fprintf(stderr, "ingest_open failed\n");
-    return 1;
+  void* h;
+  std::thread feeder;
+  std::FILE* remote_file = nullptr;
+  if (remote) {
+    h = ingest_open_push(DMLC_TPU_FORMAT_LIBSVM, /*nthread=*/2,
+                         /*chunk_bytes=*/8 << 20, /*capacity=*/4,
+                         /*csv_expect_cols=*/0);
+    if (h == nullptr) {
+      std::fprintf(stderr, "ingest_open_push failed\n");
+      return 1;
+    }
+    remote_file = std::fopen(argv[1], "rb");
+    if (remote_file == nullptr) {
+      std::perror("fopen");
+      ingest_close(h);
+      return 1;
+    }
+    feeder = std::thread([h, remote_file, size] {
+      int rc = ingest_drive_push(h, FileFetch, remote_file, size,
+                                 /*fetch_bytes=*/1 << 20);
+      if (rc != 0) std::fprintf(stderr, "drive_push rc=%d\n", rc);
+    });
+  } else {
+    // paths: NUL-terminated strings back to back (one file here)
+    std::string paths(argv[1]);
+    paths.push_back('\0');
+    h = ingest_open(paths.data(), &size, /*nfiles=*/1,
+                    DMLC_TPU_FORMAT_LIBSVM, /*part=*/0, /*nparts=*/1,
+                    /*nthread=*/2, /*chunk_bytes=*/8 << 20,
+                    /*capacity=*/4, /*csv_expect_cols=*/0);
+    if (h == nullptr) {
+      std::fprintf(stderr, "ingest_open failed\n");
+      return 1;
+    }
   }
   int64_t total_rows = 0, total_nnz = 0, blocks = 0;
   for (;;) {
@@ -54,6 +104,8 @@ int main(int argc, char** argv) {
     if (rc == 0) break;  // end of stream
     if (rc < 0) {
       std::fprintf(stderr, "pipeline error rc=%d\n", rc);
+      if (feeder.joinable()) feeder.join();
+      if (remote_file != nullptr) std::fclose(remote_file);
       ingest_close(h);
       return 1;
     }
@@ -71,6 +123,8 @@ int main(int argc, char** argv) {
   std::printf("rows=%" PRId64 " nnz=%" PRId64 " blocks=%" PRId64
               " bytes=%" PRId64 "\n",
               total_rows, total_nnz, blocks, ingest_bytes_read(h));
+  if (feeder.joinable()) feeder.join();
+  if (remote_file != nullptr) std::fclose(remote_file);
   ingest_close(h);
   return 0;
 }

@@ -77,3 +77,11 @@ def test_cpp_consumer_example_builds_and_runs(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "rows=3 nnz=6" in proc.stdout
+    # --remote: the ingest_drive_push consumer surface (fetch-callback
+    # transport + push pipeline) must produce identical totals
+    proc = subprocess.run(
+        [str(exe), "--remote", str(data)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "rows=3 nnz=6" in proc.stdout
