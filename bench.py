@@ -408,7 +408,7 @@ def _bench_criteo_sgd() -> dict:
                      nnz_bucket=1 << 19)
     step = make_linear_train_step(
         None, learning_rate=0.05, layout="csr",
-        num_features=CRITEO_DIM + 1,
+        num_features=CRITEO_DIM + 1, donate_batch=True,
     )
     params = init_linear_params(CRITEO_DIM + 1)
     velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
@@ -441,7 +441,8 @@ def _bench_recordio_sgd(path: str) -> dict:
     spec = BatchSpec(batch_size=16384, layout="dense", num_features=29)
     params = init_linear_params(29)
     velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
-    step = make_linear_train_step(None, learning_rate=0.1, layout="dense")
+    step = make_linear_train_step(None, learning_rate=0.1, layout="dense",
+                                  donate_batch=True)
     runs = _timed_sgd_epochs(
         lambda: DeviceFeed(
             create_parser(rec, 0, 1, data_format="recordio", nthread=1),
@@ -503,7 +504,8 @@ def _bench_device_feed(path: str) -> dict:
     params = init_linear_params(29)
     velocity = {"w": jnp.zeros_like(params["w"]),
                 "b": jnp.zeros_like(params["b"])}
-    step = make_linear_train_step(None, learning_rate=0.1, layout="dense")
+    step = make_linear_train_step(None, learning_rate=0.1, layout="dense",
+                                  donate_batch=True)
     sgd_runs = _timed_sgd_epochs(
         _feed, size_mb, step, "dense", params, velocity
     )
@@ -514,7 +516,8 @@ def _bench_device_feed(path: str) -> dict:
     cvel = {"w": jnp.zeros_like(cparams["w"]),
             "b": jnp.zeros_like(cparams["b"])}
     csr_step = make_linear_train_step(
-        None, learning_rate=0.1, layout="csr", num_features=29
+        None, learning_rate=0.1, layout="csr", num_features=29,
+        donate_batch=True,
     )
     csr_spec = BatchSpec(batch_size=16384, layout="csr", num_features=29,
                          nnz_bucket=1 << 19)

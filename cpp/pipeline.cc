@@ -379,6 +379,8 @@ class Pipeline {
     return error_;
   }
 
+  bool IsPushMode() const { return push_mode_; }
+
   // Flush the remaining tail (the caller guarantees the pushed range ends
   // at a record boundary, so the tail is whole records) and close the
   // stream. Idempotent. Returns 0, or the pipeline's error code.
@@ -1393,7 +1395,11 @@ void ingest_push_abort(void* handle) {
 int ingest_drive_push(void* handle, dmlc_tpu_fetch_fn fetch, void* ctx,
                       int64_t total, int64_t fetch_bytes) {
   Pipeline* pl = static_cast<Pipeline*>(handle);
-  if (fetch == nullptr) return kEIo;
+  // handle misuse (a reader-mode handle from ingest_open) is rejected
+  // up front WITHOUT failing the pipeline — the sibling push_* calls
+  // return kEIo the same way, and aborting a healthy reader pipeline
+  // would wedge its consumers for the caller's mistake
+  if (fetch == nullptr || !pl->IsPushMode()) return kEIo;
   if (fetch_bytes <= 0) fetch_bytes = 1 << 20;
   int64_t off = 0;
   while (total < 0 || off < total) {
@@ -1402,11 +1408,12 @@ int ingest_drive_push(void* handle, dmlc_tpu_fetch_fn fetch, void* ctx,
     if (want == 0) break;
     char* dst = pl->PushReserve(want);
     if (dst == nullptr) {
-      // null means OOM — or a pipeline that already failed (worker parse
-      // error); report the real code, not a guessed kEOom
+      // null here (push mode checked above) means the pipeline already
+      // failed (worker parse error — report its real code), was stopped
+      // by a concurrent close (kEIo), or hit OOM (PushReserve already
+      // failed the pipeline with kEOom); no extra abort needed
       int err = pl->LastError();
-      pl->PushAbort();
-      return err != 0 ? err : kEOom;
+      return err != 0 ? err : kEIo;
     }
     int64_t got = fetch(ctx, off, dst, want);
     if (got < 0 || got > want) {

@@ -86,6 +86,7 @@ def make_linear_train_step(
     num_features: int = 0,
     axis: str = "dp",
     use_pallas: Optional[bool] = None,
+    donate_batch: bool = False,
 ):
     """Build the jitted allreduce-SGD step.
 
@@ -104,10 +105,31 @@ def make_linear_train_step(
     gradient core through the fused Pallas kernel
     (ops/pallas_kernels.fused_linear_grads). Measured at parity with XLA's
     own fusion on v5e (BASELINE.md) — XLA stays the default.
+
+    ``donate_batch=True`` donates ALL step inputs — params, velocity, and
+    the batch arrays: the H2D landing buffers are released to XLA the
+    moment the step consumes them (HBM headroom for the next in-flight
+    transfer — SURVEY §7 hard parts: donation) and the parameter update
+    is in-place. Only for streaming callers that rebind params/velocity
+    each step and never touch a batch after its step (DeviceFeed loops,
+    the bench tiers, LinearLearner); reusing a donated input afterward is
+    an error by design. Default False keeps every input alive (the mesh
+    path has always donated params/velocity — that is unchanged).
     """
     check(layout in ("dense", "csr"), "layout must be dense or csr")
     if layout == "csr":
         check(num_features > 0, "csr layout requires num_features")
+    if donate_batch:
+        # batch leaves ([B,F] x, per-entry arrays) can never alias the
+        # outputs (w [F], scalars), so XLA warns "donated buffers were not
+        # usable" per compiled shape — the donation is still worth it for
+        # the early buffer release; silence the two known-benign messages
+        # narrowly instead of spamming every training log
+        import warnings
+
+        for msg in ("Some donated buffers were not usable",
+                    "Donation is not implemented"):
+            warnings.filterwarnings("ignore", message=msg)
     if use_pallas is None:
         import os
 
@@ -191,13 +213,16 @@ def make_linear_train_step(
 
     if mesh is None:
 
-        @jax.jit
         def step(params, velocity, batch):
             gw, gb, loss_sum, wsum = _local_grads(params, batch)
             params, velocity = _apply(params, velocity, gw, gb, wsum)
             return params, velocity, {"loss_sum": loss_sum, "weight_sum": wsum}
 
-        return step
+        # this path historically donated nothing — donation here is purely
+        # opt-in (tests and notebooks legitimately reuse inputs)
+        return jax.jit(
+            step, donate_argnums=(0, 1, 2) if donate_batch else ()
+        )
 
     # Mesh path: one shard_map; batch rows sharded, params replicated. The
     # csr layout ships SHARDED entries (ShardedCSRBatch: per-shard entry
@@ -234,7 +259,9 @@ def make_linear_train_step(
         in_specs=(P(), P(), batch_specs),
         out_specs=(P(), P(), P()),
     )
-    return jax.jit(step, donate_argnums=(0, 1))
+    return jax.jit(
+        step, donate_argnums=(0, 1, 2) if donate_batch else (0, 1)
+    )
 
 
 def make_feature_sharded_train_step(
@@ -356,6 +383,7 @@ class LinearLearner:
             momentum=self.param.momentum,
             layout=layout,
             num_features=nf,
+            donate_batch=True,  # fit_feed consumes each feed batch once
         )
 
     def fit_feed(self, feed, epochs: int = 1, log_every: int = 0):

@@ -159,11 +159,14 @@ def _bind(lib) -> None:
 _build_attempted = False
 
 
-def _try_build() -> None:
+def _try_build(force: bool = False) -> None:
     """`make -C cpp` so fresh checkouts get the native core (the .so is a
     build artifact, not committed). Cross-process safe: holds an exclusive
     flock for the build so concurrent workers don't dlopen a half-written
-    .so, and runs at most once per process."""
+    .so, and runs at most once per process. ``force`` adds -B: an
+    EXISTING .so that failed to load (stale ABI surviving a git pull) can
+    carry a fresh mtime, so a timestamp-based make would consider it up
+    to date and leave it broken."""
     global _build_attempted
     if _build_attempted:
         return
@@ -183,7 +186,7 @@ def _try_build() -> None:
         with open(lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
             subprocess.run(
-                ["make", "-C", cpp_dir],
+                ["make", "-C", cpp_dir] + (["-B"] if force else []),
                 capture_output=True, timeout=120, check=False,
             )
     except (OSError, subprocess.TimeoutExpired, ImportError):
@@ -191,16 +194,33 @@ def _try_build() -> None:
 
 
 def _load(path: str):
-    """dlopen+bind, or None when the file is unloadable — corrupt artifact,
-    or a stale build missing newly added symbols (AttributeError): returning
-    None lets the caller rebuild and retry."""
+    """dlopen+bind, or None when the file is unusable — corrupt artifact,
+    a stale build missing newly added symbols (AttributeError), or a
+    stale/foreign ABI version: returning None lets get_lib's retry loop
+    rebuild the .so (a gitignored artifact survives `git pull` across ABI
+    bumps, so mismatch must route to rebuild, not raise — additive bumps
+    like v5's ingest_drive_push add no Python-bound symbol that would
+    otherwise trip the AttributeError path)."""
     try:
         lib = ctypes.CDLL(path)
-        _bind(lib)
-    except (OSError, AttributeError):
+    except OSError:
         return None
-    if lib.dmlc_tpu_abi_version() != 5:
-        raise DMLCError(f"native ABI mismatch in {path}")
+    try:
+        _bind(lib)
+        ok = lib.dmlc_tpu_abi_version() == 5
+    except AttributeError:
+        ok = False
+    if not ok:
+        # dlclose the rejected handle: dlopen caches by path, so without
+        # this the post-rebuild retry would silently get the SAME stale
+        # image back instead of the fresh .so on disk
+        try:
+            import _ctypes
+
+            _ctypes.dlclose(lib._handle)
+        except Exception:
+            pass
+        return None
     return lib
 
 
@@ -215,16 +235,28 @@ def get_lib():
     if _tried and mode != "1":
         return None
     _tried = True
+    found_stale = False
     for attempt in range(2):
+        found_stale = False
         for path in _candidate_paths():
             if os.path.exists(path):
                 lib = _load(path)
                 if lib is not None:
                     _lib = lib
                     return _lib
+                found_stale = True
         if attempt == 0:
-            _try_build()
+            # an existing-but-unloadable .so needs a FORCED rebuild: it
+            # may be mtime-fresh (copied/pulled), so plain make would
+            # consider it up to date
+            _try_build(force=found_stale)
     if mode == "1":
+        if found_stale:
+            raise DMLCError(
+                "DMLC_TPU_NATIVE=1: libdmlc_tpu.so exists but is stale or "
+                "unloadable (wrong ABI?) and the forced rebuild failed; "
+                "run `make -B -C cpp` and check the toolchain"
+            )
         raise DMLCError(
             "DMLC_TPU_NATIVE=1 but libdmlc_tpu.so not found; run `make -C cpp`"
         )
