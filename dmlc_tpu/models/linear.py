@@ -76,6 +76,28 @@ def _margin_grad(objective: str, margin, label):
         raise DMLCError(str(err)) from err
 
 
+_donation_warnings_filtered = False
+
+
+def _filter_donation_warnings_once() -> None:
+    """Batch leaves ([B,F] x, per-entry arrays) can never alias a donating
+    step's outputs (w [F], scalars), so XLA warns "donated buffers were
+    not usable" per compiled shape — the donation is still worth it for
+    the early buffer release. Registered ONCE, and deliberately
+    process-global: the two messages are jax-specific and benign for any
+    same-shaped donation; re-registering per factory call would stack
+    duplicate filter entries."""
+    global _donation_warnings_filtered
+    if _donation_warnings_filtered:
+        return
+    _donation_warnings_filtered = True
+    import warnings
+
+    for msg in ("Some donated buffers were not usable",
+                "Donation is not implemented"):
+        warnings.filterwarnings("ignore", message=msg)
+
+
 def make_linear_train_step(
     mesh: Optional[Mesh],
     objective: str = "logistic",
@@ -120,16 +142,7 @@ def make_linear_train_step(
     if layout == "csr":
         check(num_features > 0, "csr layout requires num_features")
     if donate_batch:
-        # batch leaves ([B,F] x, per-entry arrays) can never alias the
-        # outputs (w [F], scalars), so XLA warns "donated buffers were not
-        # usable" per compiled shape — the donation is still worth it for
-        # the early buffer release; silence the two known-benign messages
-        # narrowly instead of spamming every training log
-        import warnings
-
-        for msg in ("Some donated buffers were not usable",
-                    "Donation is not implemented"):
-            warnings.filterwarnings("ignore", message=msg)
+        _filter_donation_warnings_once()
     if use_pallas is None:
         import os
 

@@ -192,23 +192,38 @@ class TestStaleLibRecovery:
         assert native._load(str(bad)) is None
 
     @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
-    def test_load_rejects_wrong_abi_and_dlcloses(self, tmp_path):
-        """A real .so exporting the wrong ABI version is rejected AND its
-        dlopen handle is closed, so a post-rebuild retry of the same path
-        reads the fresh file instead of the cached stale image."""
+    def test_load_rejects_wrong_abi_and_dlcloses(self, tmp_path,
+                                                 monkeypatch):
+        """The ABI-version gate itself, isolated from the symbol-surface
+        check (_bind is stubbed out): a .so exporting the wrong version is
+        rejected AND its dlopen handle is closed, so reloading the same
+        path after a rebuild reads the FRESH file — dlopen caches by
+        path, and without the dlclose the retry silently gets the stale
+        image back."""
         import subprocess
 
         from dmlc_tpu import native
 
-        src = tmp_path / "fake.c"
-        src.write_text(
-            "int dmlc_tpu_abi_version(void) { return 1; }\n"
-        )
-        so = tmp_path / "libdmlc_tpu.so"
-        subprocess.run(
-            ["g++", "-shared", "-fPIC", "-o", str(so), str(src)],
-            check=True, capture_output=True,
-        )
-        # rejected: right symbol surface is absent anyway, but even a lib
-        # that binds must fail the version gate
-        assert native._load(str(so)) is None
+        monkeypatch.setattr(native, "_bind", lambda lib: None)
+
+        def build(version: int):
+            src = tmp_path / "fake.cc"
+            src.write_text(
+                'extern "C" int dmlc_tpu_abi_version(void) '
+                "{ return %d; }\n" % version
+            )
+            tmp_so = tmp_path / "fresh.so"
+            subprocess.run(
+                ["g++", "-shared", "-fPIC", "-o", str(tmp_so), str(src)],
+                check=True, capture_output=True,
+            )
+            # atomic replace, like the Makefile's tmp+rename
+            tmp_so.replace(tmp_path / "libdmlc_tpu.so")
+
+        so = str(tmp_path / "libdmlc_tpu.so")
+        build(1)
+        assert native._load(so) is None  # version gate fires
+        build(5)  # "the rebuild" writes a current-ABI lib at the SAME path
+        lib = native._load(so)
+        assert lib is not None, "stale dlopen image not released"
+        assert lib.dmlc_tpu_abi_version() == 5
