@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""One-command TPU measurement sweep — run when the chip/tunnel is up.
+
+Captures every device-side number the host-only tiers cannot (the round-3
+lesson: a dead tunnel cost the round its TPU evidence, BENCH_r03.json
+`device_unavailable`). Each measurement runs in a FRESH subprocess with a
+hard timeout, so one hang cannot take down the sweep, and partial results
+survive to the artifact.
+
+    python scripts/tpu_measure.py [--out DIR]
+
+Artifacts (JSON) land in --out (default /tmp/dmlc_tpu_bench/tpu_sweep):
+  bench.json        full bench.py line (headline + device tiers:
+                    feed prefetch A/B, text/recordio/criteo ingest→SGD,
+                    psum + bucket A/B, parity)
+  pallas_flash.json pallas flash local kernel vs XLA attention at long T
+  summary.json      probe result + per-step status
+
+The driver's round-end bench captures the same tiers; this script exists
+so a transient tunnel-up window ANY time during a round can be harvested
+immediately and recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PALLAS_SNIPPET = r"""
+import json, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+import sys
+sys.path.insert(0, %(repo)r)
+from dmlc_tpu.ops.sequence_parallel import full_attention, make_pallas_flash_local
+
+# one JSON line PER ROW, flushed as measured: a compile hang at a later T
+# (killed by the parent's timeout) must not discard completed rows
+print(json.dumps({"device": jax.devices()[0].platform}), flush=True)
+B, H, D = 1, 8, 128
+flash = make_pallas_flash_local(causal=True)
+for T in (1024, 4096, 8192, 16384):
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+    fl = jax.jit(flash)
+    xla = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))
+    row = {"T": T}
+    for name, fn in (("pallas_ms", fl), ("xla_ms", xla)):
+        try:
+            r = fn(q, k, v)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = fn(q, k, v)
+            jax.block_until_ready(r)
+            row[name] = round((time.perf_counter() - t0) / 5 * 1e3, 2)
+        except Exception as err:
+            row[name] = f"error: {err}"
+    print(json.dumps(row), flush=True)
+"""
+
+
+def _run(name: str, argv, out_dir: str, timeout: int, env=None) -> dict:
+    """Run one measurement subprocess; save every JSON line it printed
+    (jsonl — partial output from a timed-out child still lands in the
+    artifact, per the round-3 lesson)."""
+    t0 = time.time()
+    stdout = ""
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO, env={**os.environ, **(env or {})},
+        )
+        status = {"rc": proc.returncode, "secs": round(time.time() - t0, 1)}
+        stdout = proc.stdout or ""
+        if proc.returncode != 0:
+            status["stderr_tail"] = (proc.stderr or "")[-500:]
+    except subprocess.TimeoutExpired as err:
+        status = {"rc": "timeout", "secs": timeout}
+        stdout = (err.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(err.stdout, bytes) else (err.stdout or "")
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    if lines:
+        with open(os.path.join(out_dir, name + ".json"), "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        status["artifact"] = name + ".json"
+    return status
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="/tmp/dmlc_tpu_bench/tpu_sweep")
+    ap.add_argument("--probe-timeout", type=int, default=90)
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+
+    summary = {"started": time.strftime("%Y-%m-%d %H:%M:%S"), "steps": {}}
+
+    def finish(result: str, code: int) -> int:
+        summary["result"] = result
+        with open(os.path.join(args.out, "summary.json"), "w") as fh:
+            json.dump(summary, fh, indent=1)
+        print(json.dumps(summary))
+        return code
+
+    # bounded probe first (bench.py owns the tunnel-hang probe logic:
+    # jax.devices() HANGS when the tunnel is down, and this script must
+    # never wedge a shell that polls it)
+    sys.path.insert(0, REPO)
+    from bench import _device_backend_probe_once
+
+    t0 = time.time()
+    ok, note = _device_backend_probe_once(args.probe_timeout)
+    summary["steps"]["probe"] = {
+        "ok": ok, "note": note, "secs": round(time.time() - t0, 1)}
+    if not ok:
+        return finish("tunnel down; nothing measured", 1)
+
+    summary["steps"]["bench"] = _run(
+        "bench", [sys.executable, "bench.py"], args.out, 2400,
+        env={"DMLC_TPU_BENCH_PROBE_ATTEMPTS": "2"},
+    )
+    summary["steps"]["pallas_flash"] = _run(
+        "pallas_flash",
+        [sys.executable, "-c", _PALLAS_SNIPPET % {"repo": REPO}],
+        args.out, 1200,
+    )
+    all_ok = all(s.get("rc") == 0 for s in summary["steps"].values()
+                 if "rc" in s)
+    return finish("sweep complete" if all_ok else "partial", 0 if all_ok
+                  else 1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
