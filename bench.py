@@ -240,24 +240,30 @@ def _combine_headline(sweeps: list) -> tuple:
     return headline, extra
 
 
-def _ensure_recordio(path: str) -> str:
-    """Binary row-group twin of the text file (data/rowrec.py): the
-    scan-free format — framing + memcpy — that binary shards should use."""
+def _ensure_rowrec(src: str, rec: str) -> str:
+    """Binary row-group twin of a text file (data/rowrec.py): the
+    scan-free format — framing + memcpy — that binary shards should use.
+    ``rec`` must encode the workload shape in its name (like the sources
+    do) so constant bumps regenerate it rather than silently benching a
+    stale conversion."""
     from dmlc_tpu.data.rowrec import convert_to_recordio
 
-    rec = os.path.join(CACHE_DIR, f"higgs_like_{ROWS}.rec")
     if not (os.path.exists(rec) and os.path.getsize(rec) > 0):
-        convert_to_recordio(path, rec + ".tmp", rows_per_group=4096)
+        convert_to_recordio(src, rec + ".tmp", rows_per_group=4096)
         os.replace(rec + ".tmp", rec)
     return rec
 
 
-def _recordio_sweep(path: str) -> dict:
-    """One recordio-ingest sweep → {probe_gbps, trials} (first trial is an
-    in-sweep warmup, dropped)."""
+def _ensure_recordio(path: str) -> str:
+    return _ensure_rowrec(
+        path, os.path.join(CACHE_DIR, f"higgs_like_{ROWS}.rec"))
+
+
+def _rowrec_sweep(rec: str, expected_rows: int) -> dict:
+    """One recordio-ingest sweep over a row-group file → {probe_gbps,
+    trials} (first trial is an in-sweep warmup, dropped)."""
     from dmlc_tpu.data import create_parser
 
-    rec = _ensure_recordio(path)
     probe = _host_probe()
     runs = []
     for _ in range(TRIALS + 1):
@@ -267,9 +273,32 @@ def _recordio_sweep(path: str) -> dict:
         dt = time.time() - t0
         mb = parser.bytes_read / (1 << 20)
         parser.close()
-        assert rows == ROWS, f"recordio row count mismatch: {rows}"
+        assert rows == expected_rows, f"recordio row mismatch: {rows}"
         runs.append(round(mb / dt, 1))
     return {"probe_gbps": probe, "trials": runs[1:]}
+
+
+def _recordio_sweep(path: str) -> dict:
+    return _rowrec_sweep(_ensure_recordio(path), ROWS)
+
+
+def _ensure_criteo_recordio() -> str:
+    """Binary row-group twin of the Criteo-shaped file: the sparse
+    north-star workload's steady-state shard format."""
+    return _ensure_rowrec(
+        _ensure_criteo_like(),
+        os.path.join(
+            CACHE_DIR,
+            f"criteo_like_{CRITEO_ROWS}x{CRITEO_NNZ}_d{CRITEO_DIM}.rec",
+        ),
+    )
+
+
+def _criteo_recordio_sweep() -> dict:
+    """One sparse binary-shard ingest sweep. Kept next to the text tier
+    so the 'binary shards hold their multiple on the sparse shape' claim
+    is harness-measured every round."""
+    return _rowrec_sweep(_ensure_criteo_recordio(), CRITEO_ROWS)
 
 
 def _combine_tier(sweeps: list) -> tuple:
@@ -650,6 +679,7 @@ def main() -> None:
     host_tiers = {
         "recordio_ingest": lambda: _recordio_sweep(path),
         "criteo_like_parse": _criteo_parse_sweep,
+        "criteo_recordio_ingest": _criteo_recordio_sweep,
         "remote_ingest": lambda: _remote_sweep(path),
     }
     tier_sweeps = {name: [] for name in host_tiers}
@@ -670,6 +700,8 @@ def main() -> None:
         "criteo_like_feature_space": CRITEO_DIM,
         "recordio_file_mb": round(
             os.path.getsize(_ensure_recordio(path)) / (1 << 20), 1),
+        "criteo_recordio_file_mb": round(
+            os.path.getsize(_ensure_criteo_recordio()) / (1 << 20), 1),
     }
     device_ok, device_note, probe_record = _device_backend_ok()
     extra["device_probe"] = probe_record
