@@ -223,7 +223,10 @@ def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
     return metrics
 
 
-def grad_bucket_metrics(iters: int = 20) -> dict:
+def grad_bucket_metrics(iters: int = 8) -> dict:  # min-of-8 from the tier's
+    # first artifact on (r04): each iter moves a ~25 MB pytree, so 8 bounds
+    # the tier's tunnel time; the within-run fused-vs-per-tensor A/B is the
+    # quantity of record, not the absolute ms
     """Fused-bucket vs per-tensor gradient allreduce A/B on whatever
     devices exist (preparing for the ICI-utilization target before
     multi-chip hardware does: one concatenated psum per step vs one psum

@@ -62,7 +62,7 @@ def _worker_main(uri, port, world, results):
         engine.shutdown()
 
 
-@pytest.mark.parametrize("world", [2, 4])
+@pytest.mark.parametrize("world", [2, 4, 8])
 def test_our_workers_against_reference_tracker(world):
     RefTracker = _load_reference_tracker()
     tracker = RefTracker("127.0.0.1", world, port=19491, port_end=19591)
