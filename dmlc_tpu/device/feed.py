@@ -27,6 +27,7 @@ from dataclasses import dataclass
 from typing import Iterator, Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
@@ -82,14 +83,29 @@ class DeviceFeed:
         self.spec = spec
         self._mesh = mesh
         self._axis = axis
+        # computed once: mesh/axis are immutable, and the multi-process
+        # branch scans the mesh's device array
+        self._shards = self._axis_shards()
         if mesh is not None:
+            # the per-PROCESS batch divides over this process's shards
+            # along the axis (== the full axis extent single-process)
             check(
-                spec.batch_size % mesh.shape[axis] == 0,
-                "batch_size %d must divide over mesh axis %s=%d",
+                spec.batch_size % self._shards == 0,
+                "batch_size %d must divide over this process's %d shards "
+                "of mesh axis %s",
                 spec.batch_size,
+                self._shards,
                 axis,
-                mesh.shape[axis],
             )
+            if jax.process_count() > 1 and spec.layout == "csr":
+                # auto bucketing sizes from LOCAL data; different hosts
+                # would pick different buckets and the global assembly
+                # needs identical local shapes — make the bucket explicit
+                check(
+                    spec.nnz_bucket is not None,
+                    "multi-process csr feeds require an explicit "
+                    "spec.nnz_bucket (auto bucketing is per-host)",
+                )
         # per-stage wall time (SURVEY §5.1: "where does feed time go?");
         # host_ns accumulates on the ThreadedIter thread, the rest on the
         # consuming thread — initialized BEFORE the producer thread starts
@@ -100,6 +116,34 @@ class DeviceFeed:
         self._host_iter = ThreadedIter(
             self._host_batches, max_capacity=host_prefetch, name="device-feed"
         )
+
+    def _axis_shards(self) -> int:
+        """How many shard sections THIS process builds along the batch
+        axis. Single-process: the full axis extent. Multi-process: only
+        the axis positions this process's devices occupy — each host
+        packs its local batch into its LOCAL shards and
+        ``make_array_from_process_local_data`` concatenates hosts into
+        the global array (packing by the GLOBAL extent instead would
+        interleave half of one host's shard with half of another's on
+        every device — garbage row offsets)."""
+        if self._mesh is None:
+            return 1
+        if jax.process_count() > 1:
+            axis_idx = self._mesh.axis_names.index(self._axis)
+            local_ids = {d.id for d in jax.local_devices()}
+            arr = self._mesh.devices
+            mask = np.frompyfunc(lambda d: d.id in local_ids, 1, 1)(
+                arr).astype(bool)
+            other = tuple(i for i in range(arr.ndim) if i != axis_idx)
+            shards = int(mask.any(axis=other).sum())
+            check(
+                shards > 0,
+                "mesh holds none of process %d's devices — a feed on "
+                "this process cannot contribute shards",
+                jax.process_index(),
+            )
+            return shards
+        return self._mesh.shape[self._axis]
 
     # ---- host side: re-batch parser blocks into fixed-size slices ------
     def _use_native_batches(self) -> bool:
@@ -148,7 +192,7 @@ class DeviceFeed:
     def _host_batches_native(self) -> Iterator:
         spec = self.spec
         bs = spec.batch_size
-        shards = self._mesh.shape[self._axis] if self._mesh is not None else 1
+        shards = self._shards
         while True:
             if spec.layout == "dense":
                 check(spec.num_features > 0,
@@ -220,9 +264,7 @@ class DeviceFeed:
             out["num_rows"] = len(block)
             return out
         if spec.layout == "csr":
-            shards = (
-                self._mesh.shape[self._axis] if self._mesh is not None else 1
-            )
+            shards = self._shards
             if shards > 1:
                 batch = pad_to_bucket_sharded(
                     block, spec.batch_size, shards,
