@@ -10,7 +10,8 @@ executing the code paths single-process tests cannot reach:
 - cross-process XLA collectives inside the jitted train step (the Gloo
   CPU backend standing in for ICI/DCN);
 - the multi-host ingest contract: each process parses its OWN InputSplit
-  part (part=rank), exactly-once across the world.
+  part (part=rank), exactly-once across the world;
+- ``DeviceEngine``'s world>1 allreduce/broadcast branch.
 
 This is the closest a single machine gets to the v5e-64 north star's
 launch shape (SURVEY §5.8: one process per host, global mesh).
@@ -25,18 +26,23 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-WORKER = r'''
+# shared bootstrap for every worker: force CPU before any backend, pin 2
+# virtual devices per process, rendezvous, then import the repo.
+# argv: rank world port [extras...]
+PREAMBLE = r'''
 import os, sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-rank, world, port, uri = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
-                          sys.argv[4])
+rank, world, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=2")
 jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
                            num_processes=world, process_id=rank)
 sys.path.insert(0, "__REPO__")
+'''
+
+TRAIN_BODY = r'''
 import numpy as np
 import jax.numpy as jnp
 
@@ -46,10 +52,10 @@ from dmlc_tpu.models.linear import (
     init_linear_params, make_linear_train_step, step_batch)
 from dmlc_tpu.parallel import data_parallel_mesh
 
+uri, LAYOUT = sys.argv[4], sys.argv[5]
 mesh = data_parallel_mesh()  # GLOBAL: 4 devices across 2 processes
 assert jax.process_count() == world and jax.device_count() == 2 * world
 
-LAYOUT = sys.argv[5]
 FEATS = 8 if LAYOUT == "dense" else 101
 # each process parses its OWN part (the multi-host ingest contract);
 # drop_remainder keeps per-process step counts equal for the collectives
@@ -78,6 +84,65 @@ print("RESULT rank=%d losses=%s rows=%d w0=%.8f"
          float(params["w"][0])), flush=True)
 '''
 
+ENGINE_BODY = r'''
+import numpy as np
+
+from dmlc_tpu.collective.device import DeviceEngine
+
+eng = DeviceEngine()
+assert eng.world_size == world and eng.rank == rank
+got = eng.allreduce(np.arange(5, dtype=np.float64) + 100.0 * rank)
+want = sum(np.arange(5) + 100.0 * r for r in range(world))
+assert np.array_equal(got, want), (got, want)
+gmax = eng.allreduce(np.array([rank + 1.0]), op="max")
+assert float(gmax[0]) == world
+bcast = eng.broadcast(
+    np.array([7, 8, 9], dtype=np.int64) if rank == 0 else None, root=0)
+assert list(bcast) == [7, 8, 9]
+print("RESULT rank=%d ok=1" % rank, flush=True)
+'''
+
+
+def _launch_workers(tmp_path, body: str, port: str, extra_args=(),
+                    world: int = 2, timeout: int = 300):
+    """Run the PREAMBLE+body worker in ``world`` processes → list of
+    outputs. Kills every child on any failure/timeout — a leaked worker
+    would keep the coordinator port bound and wedge the next run."""
+    script = tmp_path / "worker.py"
+    script.write_text((PREAMBLE + body).replace("__REPO__", REPO))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)  # the worker pins its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(world), port,
+             *map(str, extra_args)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, out[-1500:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+@pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
+                    reason="multihost tier disabled")
+def test_device_engine_collectives_across_processes(tmp_path):
+    """DeviceEngine's world>1 branch (make_array_from_process_local_data
+    + XLA AllReduce over the process mesh, broadcast framing) — the rabit
+    data plane across REAL processes, unreachable single-process."""
+    for out in _launch_workers(tmp_path, ENGINE_BODY, "19791"):
+        assert "ok=1" in out
+
 
 def _oracle_losses(uri, world, layout, feats, epochs=2):
     """Single-process reference: replay the SAME global batches — step k
@@ -86,11 +151,10 @@ def _oracle_losses(uri, world, layout, feats, epochs=2):
     import jax.numpy as jnp
 
     from dmlc_tpu.data import create_parser
-    from dmlc_tpu.models.linear import (
-        init_linear_params, make_linear_train_step)
-
     from dmlc_tpu.data.row_block import RowBlockContainer
     from dmlc_tpu.device.csr import pad_to_bucket
+    from dmlc_tpu.models.linear import (
+        init_linear_params, make_linear_train_step)
 
     # raw per-part row lists (label, ids, vals) in part order
     part_rows = []
@@ -163,24 +227,8 @@ def test_two_process_mesh_trains_and_agrees(tmp_path, layout, port):
                 ids = sorted(rng.choice(100, size=5, replace=False))
                 fh.write(str(rng.randint(0, 2)) + " " + " ".join(
                     f"{j}:{rng.rand():.5f}" for j in ids) + "\n")
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER.replace("__REPO__", REPO))
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-    env.pop("XLA_FLAGS", None)  # worker pins its own device count
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(r), str(world), port,
-             str(uri), layout],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env,
-        )
-        for r in range(world)
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
-        assert p.returncode == 0, out[-1500:]
+    outs = _launch_workers(tmp_path, TRAIN_BODY, port,
+                           extra_args=(uri, layout))
     results = {}
     for out in outs:
         line = next(ln for ln in out.splitlines() if "RESULT" in ln)
