@@ -103,6 +103,39 @@ print("RESULT rank=%d ok=1" % rank, flush=True)
 '''
 
 
+PS_BODY = r'''
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dmlc_tpu.models.linear import make_feature_sharded_train_step
+
+devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+mesh = Mesh(np.asarray(devs).reshape(2, 2), ("dp", "mp"))  # dp SPANS procs
+step, sh = make_feature_sharded_train_step(mesh, learning_rate=0.3)
+rng = np.random.RandomState(0)  # same seed both ranks: global batches
+B, F = 16, 4
+params = {
+    "w": jax.device_put(jnp.zeros(F), sh["w"]),
+    "b": jax.device_put(jnp.zeros(()), sh["b"]),
+}
+losses = []
+for _ in range(3):
+    x = rng.rand(B, F).astype(np.float32)
+    y = (rng.rand(B) > 0.5).astype(np.float32)
+    w = np.ones(B, np.float32)
+    params, m = step(
+        params,
+        jax.device_put(jnp.asarray(x), sh["x"]),
+        jax.device_put(jnp.asarray(y), sh["label"]),
+        jax.device_put(jnp.asarray(w), sh["weight"]),
+    )
+    losses.append(round(float(m["loss_sum"]) / float(m["weight_sum"]), 8))
+print("RESULT rank=%d losses=%s" % (
+    rank, ",".join("%.8f" % v for v in losses)), flush=True)
+'''
+
+
 def _launch_workers(tmp_path, body: str, port: str, extra_args=(),
                     world: int = 2, timeout: int = 300):
     """Run the PREAMBLE+body worker in ``world`` processes → list of
@@ -142,6 +175,41 @@ def test_device_engine_collectives_across_processes(tmp_path):
     data plane across REAL processes, unreachable single-process."""
     for out in _launch_workers(tmp_path, ENGINE_BODY, "19791"):
         assert "ok=1" in out
+
+
+@pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
+                    reason="multihost tier disabled")
+def test_feature_sharded_step_across_processes(tmp_path):
+    """The PS-analog (dp x mp) step with dp SPANNING processes: psums
+    cross the process boundary and device_put places global arrays onto
+    a partly non-addressable sharding. Must match a mesh-less oracle on
+    the same batches."""
+    import jax.numpy as jnp
+
+    outs = _launch_workers(tmp_path, PS_BODY, "19795")
+    losses = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if "RESULT" in ln)
+        losses.append(line.split("losses=")[1])
+    assert losses[0] == losses[1], losses  # replicated metrics agree
+
+    from dmlc_tpu.models.linear import (
+        init_linear_params, make_linear_train_step)
+
+    step = make_linear_train_step(None, learning_rate=0.3)
+    params = init_linear_params(4)
+    velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
+    rng = np.random.RandomState(0)  # the workers' exact batch stream
+    oracle = []
+    for _ in range(3):
+        x = rng.rand(16, 4).astype(np.float32)
+        y = (rng.rand(16) > 0.5).astype(np.float32)
+        batch = {"x": jnp.asarray(x), "label": jnp.asarray(y),
+                 "weight": jnp.ones(16)}
+        params, velocity, m = step(params, velocity, batch)
+        oracle.append(float(m["loss_sum"]) / float(m["weight_sum"]))
+    got = [float(v) for v in losses[0].split(",")]
+    np.testing.assert_allclose(got, oracle, rtol=1e-5)
 
 
 def _oracle_losses(uri, world, layout, feats, epochs=2):
