@@ -167,6 +167,76 @@ def _launch_workers(tmp_path, body: str, port: str, extra_args=(),
     return outs
 
 
+SUBMIT_WORKER = r'''
+import os, sys
+sys.path.insert(0, "__REPO__")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+from dmlc_tpu.parallel.distributed import initialize_from_env
+
+initialize_from_env()  # the DMLC_TPU_* half of the launcher contract
+from dmlc_tpu import collective as rabit
+
+rabit.init()  # the classic DMLC_* half (control plane via the tracker)
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dmlc_tpu.parallel import data_parallel_mesh
+
+mesh = data_parallel_mesh()
+total = jax.jit(jax.shard_map(
+    lambda: jax.lax.psum(jnp.float32(1.0), "dp"),
+    mesh=mesh, in_specs=(), out_specs=P()))()
+rabit.tracker_print(
+    "WORKER rank=%d global_devices=%d psum=%.1f"
+    % (jax.process_index(), jax.device_count(), float(total)))
+rabit.finalize()
+'''
+
+
+@pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
+                    reason="multihost tier disabled")
+def test_dmlc_submit_cluster_tpu_end_to_end(tmp_path):
+    """The north-star COMMAND, end to end on one machine:
+    ``dmlc-submit --cluster=tpu -n 2 -H hosts`` spawns one worker per
+    (local)host, each rendezvouses on BOTH contracts — the classic
+    DMLC_* tracker (control plane) and DMLC_TPU_* jax.distributed (data
+    plane) — and a psum spans the resulting 4-device global mesh."""
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost\nlocalhost\n")
+    worker = tmp_path / "worker.py"
+    worker.write_text(SUBMIT_WORKER.replace("__REPO__", REPO))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)
+    # own session + killpg cleanup: on a timeout, killing only dmlc-submit
+    # would leak its shell=True worker grandchildren holding the
+    # coordinator port (same hazard _launch_workers guards against);
+    # a unique --tpu-coordinator-port isolates runs either way
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "dmlc-submit"),
+         "--cluster", "tpu", "-n", "2", "-H", str(hostfile),
+         "--host-ip", "127.0.0.1", "--tpu-coordinator-port", "19797",
+         sys.executable, str(worker)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO, start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=300)
+    finally:
+        if proc.poll() is None:
+            import signal
+
+            os.killpg(proc.pid, signal.SIGKILL)
+    assert proc.returncode == 0, out[-1500:]
+    for rank in range(2):
+        assert f"WORKER rank={rank} global_devices=4 psum=4.0" in out, out
+
+
 @pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
                     reason="multihost tier disabled")
 def test_device_engine_collectives_across_processes(tmp_path):
