@@ -167,6 +167,95 @@ def _launch_workers(tmp_path, body: str, port: str, extra_args=(),
     return outs
 
 
+
+def _worker_losses(outs):
+    """Parse each worker's RESULT losses= field; assert ranks agree →
+    the shared per-step loss list."""
+    fields = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if "RESULT" in ln)
+        fields.append(line.split("losses=")[1].split()[0])
+    assert len(set(fields)) == 1, fields  # replicated metrics agree
+    return [float(v) for v in fields[0].split(",")]
+
+
+def _meshless_oracle(seed, lr, feats, batch, steps):
+    """Replay the workers' exact batch stream through a mesh-less step →
+    per-step losses (the numerical reference every distributed variant
+    must match)."""
+    import jax.numpy as jnp
+
+    from dmlc_tpu.models.linear import (
+        init_linear_params, make_linear_train_step)
+
+    step = make_linear_train_step(None, learning_rate=lr)
+    params = init_linear_params(feats)
+    velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        x = rng.rand(batch, feats).astype(np.float32)
+        y = (rng.rand(batch) > 0.5).astype(np.float32)
+        b = {"x": jnp.asarray(x), "label": jnp.asarray(y),
+             "weight": jnp.ones(batch)}
+        params, velocity, m = step(params, velocity, b)
+        losses.append(float(m["loss_sum"]) / float(m["weight_sum"]))
+    return losses
+
+
+MULTISLICE_BODY = r'''
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.models.linear import (
+    init_linear_params, make_linear_train_step)
+from dmlc_tpu.parallel import make_multislice_mesh
+
+# each PROCESS is a virtual slice: the dcn axis crosses the process
+# boundary (Gloo standing in for the data-center network), the inner dp
+# axis stays within a process (standing in for ICI) — the true
+# multi-slice communication shape
+devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+mesh = make_multislice_mesh({"dp": 2}, num_slices=world, devices=devs)
+assert mesh.axis_names == ("dcn", "dp")
+step = make_linear_train_step(mesh, learning_rate=0.4,
+                              axis=("dcn", "dp"))
+rng = np.random.RandomState(1)  # same seed: global batches everywhere
+B, F = 16, 6
+params = init_linear_params(F)
+velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
+sharding = NamedSharding(mesh, P(("dcn", "dp")))
+losses = []
+for _ in range(3):
+    x = rng.rand(B, F).astype(np.float32)
+    y = (rng.rand(B) > 0.5).astype(np.float32)
+    batch = {
+        "x": jax.device_put(jnp.asarray(x), sharding),
+        "label": jax.device_put(jnp.asarray(y), sharding),
+        "weight": jax.device_put(jnp.ones(B), sharding),
+    }
+    params, velocity, m = step(params, velocity, batch)
+    losses.append(round(float(m["loss_sum"]) / float(m["weight_sum"]), 8))
+print("RESULT rank=%d losses=%s" % (
+    rank, ",".join("%.8f" % v for v in losses)), flush=True)
+'''
+
+
+@pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
+                    reason="multihost tier disabled")
+def test_multislice_hybrid_dp_across_processes(tmp_path):
+    """Hybrid dp=(dcn, dp) with the dcn axis CROSSING real process
+    boundaries — each process is one virtual slice, so the psum's outer
+    hop rides the inter-process transport exactly as DCN would. Must
+    match the mesh-less oracle on the same batches."""
+    got = _worker_losses(_launch_workers(tmp_path, MULTISLICE_BODY,
+                                         "19799"))
+    np.testing.assert_allclose(
+        got, _meshless_oracle(seed=1, lr=0.4, feats=6, batch=16, steps=3),
+        rtol=1e-5)
+
+
 SUBMIT_WORKER = r'''
 import os, sys
 sys.path.insert(0, "__REPO__")
@@ -254,32 +343,10 @@ def test_feature_sharded_step_across_processes(tmp_path):
     cross the process boundary and device_put places global arrays onto
     a partly non-addressable sharding. Must match a mesh-less oracle on
     the same batches."""
-    import jax.numpy as jnp
-
-    outs = _launch_workers(tmp_path, PS_BODY, "19795")
-    losses = []
-    for out in outs:
-        line = next(ln for ln in out.splitlines() if "RESULT" in ln)
-        losses.append(line.split("losses=")[1])
-    assert losses[0] == losses[1], losses  # replicated metrics agree
-
-    from dmlc_tpu.models.linear import (
-        init_linear_params, make_linear_train_step)
-
-    step = make_linear_train_step(None, learning_rate=0.3)
-    params = init_linear_params(4)
-    velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
-    rng = np.random.RandomState(0)  # the workers' exact batch stream
-    oracle = []
-    for _ in range(3):
-        x = rng.rand(16, 4).astype(np.float32)
-        y = (rng.rand(16) > 0.5).astype(np.float32)
-        batch = {"x": jnp.asarray(x), "label": jnp.asarray(y),
-                 "weight": jnp.ones(16)}
-        params, velocity, m = step(params, velocity, batch)
-        oracle.append(float(m["loss_sum"]) / float(m["weight_sum"]))
-    got = [float(v) for v in losses[0].split(",")]
-    np.testing.assert_allclose(got, oracle, rtol=1e-5)
+    got = _worker_losses(_launch_workers(tmp_path, PS_BODY, "19795"))
+    np.testing.assert_allclose(
+        got, _meshless_oracle(seed=0, lr=0.3, feats=4, batch=16, steps=3),
+        rtol=1e-5)
 
 
 def _oracle_losses(uri, world, layout, feats, epochs=2):
