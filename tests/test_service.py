@@ -249,6 +249,28 @@ class TestServeCLI:
             if proc.poll() is None:
                 proc.kill()
 
+    def test_serve_cli_grace_bounds_exit_with_idle_consumer(self, svm_file):
+        """--grace forwards to BlockService.wait: an idle consumer must
+        not hold the server past the grace window after drain."""
+        import socket
+        import time
+
+        proc, addr = _spawn_serve(svm_file, "--grace", "1")
+        try:
+            idle = socket.create_connection(addr)  # never requests
+            p = RemoteBlockParser(addr)
+            rows = sum(len(b) for b in p)
+            p.close()
+            assert rows == ROWS
+            t0 = time.monotonic()
+            proc.wait(timeout=30)
+            assert proc.returncode == 0
+            assert time.monotonic() - t0 < 25
+            idle.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
     def test_serve_cli_rejects_bad_part(self, svm_file):
         import os
         import subprocess
