@@ -48,6 +48,10 @@ def _socket_bench_worker(uri, port, world, cases, iters, q):
     engine = SocketEngine(
         tracker_uri=uri, tracker_port=port, world_size=world
     )
+    # the engine may have applied a DMLC_TPU_RING_THRESHOLD_BYTES override
+    # at construction; restore THAT after each forced-topology case, not
+    # the class default, so the straggler-max allreduce below honors it
+    constructed_threshold = engine.ring_threshold_bytes
     try:
         out = {}
         for name, nbytes, topo in cases:
@@ -58,7 +62,7 @@ def _socket_bench_worker(uri, port, world, cases, iters, q):
             for _ in range(iters):
                 engine.allreduce(arr)
             local_dt = (time.perf_counter() - t0) / iters
-            engine.ring_threshold_bytes = SocketEngine.ring_threshold_bytes
+            engine.ring_threshold_bytes = constructed_threshold
             worst = float(
                 engine.allreduce(
                     np.array([local_dt], dtype=np.float64), op="max"

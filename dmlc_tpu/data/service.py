@@ -249,22 +249,38 @@ class BlockService:
             t.start()
             self._threads.append(t)
 
-    def wait(self, timeout: float = 10.0) -> None:
-        """Block until the stream is exhausted (unbounded — serving IS the
-        job), then give remaining connections grace windows of ``timeout``
-        seconds to finish — the CLI server's natural exit point.
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until the stream is exhausted and every consumer connection
+        has finished.
 
-        Exit semantic (a deliberate tradeoff — bounded exit vs waiting for
-        consumers that may never return): windows extend as long as there is
-        measurable progress — a response completed or a connection finished
-        during the window. One full window with NO progress ends the wait,
-        cutting off consumers that connected but never issued their final
-        request (they would otherwise hold a recv forever) — and, by the
-        same clock, any consumer that goes silent for longer than
-        ``timeout`` after the drain; raise ``timeout`` if consumers do long
-        post-drain work between pulls. Any stashed undelivered blocks still
-        unclaimed are counted and logged as lost by :meth:`close`."""
+        The library default (``timeout=None``) is UNBOUNDED: a healthy
+        consumer may legitimately go silent between its last block and its
+        closing request for however long one train step takes (a jit
+        compile can be minutes), and the library must never cut such a
+        consumer off — ``RemoteBlockParser`` would see a reset instead of
+        clean EOF and a previously-clean job would fail.
+
+        With ``timeout`` set, post-drain delivery gets grace windows of
+        ``timeout`` seconds — the serve CLI's bounded-exit mode
+        (``--grace``): windows extend as long as there is measurable
+        progress — a response completed or a connection finished during the
+        window. One full window with NO progress ends the wait, cutting off
+        consumers that connected but never issued their final request (they
+        would otherwise hold a recv forever) — and, by the same clock, any
+        consumer that goes silent for longer than ``timeout`` after the
+        drain; size ``timeout`` well above plausible per-step consumer
+        work. Any stashed undelivered blocks still unclaimed are counted
+        and logged as lost by :meth:`close`."""
         self._drained.wait()
+        if timeout is None:
+            # Unbounded join; the thread list can grow while we drain
+            # (late-connecting consumers), so loop until a full pass finds
+            # every thread finished.
+            while True:
+                for t in list(self._threads):
+                    t.join()
+                if not any(t.is_alive() for t in list(self._threads)):
+                    return
         with self._lock:
             last_done, last_sent = self._responses_done, self._bytes_sent
         last_alive = len([t for t in list(self._threads) if t.is_alive()])

@@ -76,26 +76,45 @@ def _margin_grad(objective: str, margin, label):
         raise DMLCError(str(err)) from err
 
 
-_donation_warnings_filtered = False
-
-
-def _filter_donation_warnings_once() -> None:
+def _suppress_donation_warnings(step):
     """Batch leaves ([B,F] x, per-entry arrays) can never alias a donating
     step's outputs (w [F], scalars), so XLA warns "donated buffers were
     not usable" per compiled shape — the donation is still worth it for
-    the early buffer release. Registered ONCE, and deliberately
-    process-global: the two messages are jax-specific and benign for any
-    same-shaped donation; re-registering per factory call would stack
-    duplicate filter entries."""
-    global _donation_warnings_filtered
-    if _donation_warnings_filtered:
-        return
-    _donation_warnings_filtered = True
+    the early buffer release. The suppression is scoped to THIS step's
+    call sites via catch_warnings, not installed process-globally: a
+    user's own jitted function emitting the same message may be flagging
+    a real missed donation, and this package must not eat that signal.
+
+    The warnings fire only at trace/compile time (once per argument-shape
+    signature), so the suppression engages only on calls with an unseen
+    signature: steady-state steps call straight through — no per-step
+    catch_warnings, whose filter-version bump would invalidate every
+    module's __warningregistry__ and make unrelated once-per-location
+    warnings re-fire each iteration. (catch_warnings swaps the global
+    filter list for the compile call's duration; the swap is not atomic
+    across threads — the stdlib limitation — but the window is one
+    compile, not every step.)"""
+    import functools
     import warnings
 
-    for msg in ("Some donated buffers were not usable",
-                "Donation is not implemented"):
-        warnings.filterwarnings("ignore", message=msg)
+    seen = set()
+
+    @functools.wraps(step)
+    def wrapped(*args, **kwargs):
+        key = tuple(
+            (getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
+            for x in jax.tree_util.tree_leaves((args, kwargs))
+        )
+        if key in seen:
+            return step(*args, **kwargs)
+        seen.add(key)
+        with warnings.catch_warnings():
+            for msg in ("Some donated buffers were not usable",
+                        "Donation is not implemented"):
+                warnings.filterwarnings("ignore", message=msg)
+            return step(*args, **kwargs)
+
+    return wrapped
 
 
 def make_linear_train_step(
@@ -141,8 +160,6 @@ def make_linear_train_step(
     check(layout in ("dense", "csr"), "layout must be dense or csr")
     if layout == "csr":
         check(num_features > 0, "csr layout requires num_features")
-    if donate_batch:
-        _filter_donation_warnings_once()
     if use_pallas is None:
         import os
 
@@ -233,9 +250,8 @@ def make_linear_train_step(
 
         # this path historically donated nothing — donation here is purely
         # opt-in (tests and notebooks legitimately reuse inputs)
-        return jax.jit(
-            step, donate_argnums=(0, 1, 2) if donate_batch else ()
-        )
+        fn = jax.jit(step, donate_argnums=(0, 1, 2) if donate_batch else ())
+        return _suppress_donation_warnings(fn) if donate_batch else fn
 
     # Mesh path: one shard_map; batch rows sharded, params replicated. The
     # csr layout ships SHARDED entries (ShardedCSRBatch: per-shard entry
@@ -272,9 +288,10 @@ def make_linear_train_step(
         in_specs=(P(), P(), batch_specs),
         out_specs=(P(), P(), P()),
     )
-    return jax.jit(
+    fn = jax.jit(
         step, donate_argnums=(0, 1, 2) if donate_batch else (0, 1)
     )
+    return _suppress_donation_warnings(fn) if donate_batch else fn
 
 
 def make_feature_sharded_train_step(

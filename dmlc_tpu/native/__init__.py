@@ -193,6 +193,42 @@ def _try_build(force: bool = False) -> None:
         pass
 
 
+def _expected_abi_version() -> int:
+    """DMLC_TPU_ABI_VERSION parsed out of THIS checkout's cpp/dmlc_tpu.h —
+    the same header _try_build compiles, which is what the ctypes
+    signatures in _bind were written against. Deliberately NOT read from
+    a header adjacent to DMLC_TPU_NATIVE_LIB: a stale foreign lib must
+    not self-validate against its own old header (the gate exists to
+    protect _bind's signature contract, and that contract tracks this
+    repo's header only). Falls back to the bound version constant when
+    sources are absent (installed package) — bump _BOUND_ABI together
+    with any header bump; it is asserted against the header by
+    tests/test_native.py so the two cannot drift in a checkout."""
+    global _expected_abi
+    if _expected_abi is None:
+        _expected_abi = _BOUND_ABI
+        header = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "cpp", "dmlc_tpu.h",
+        )
+        try:
+            with open(header) as fh:
+                for line in fh:
+                    if line.startswith("#define DMLC_TPU_ABI_VERSION"):
+                        _expected_abi = int(line.split()[2])
+                        break
+        except (OSError, ValueError, IndexError):
+            pass
+    return _expected_abi
+
+
+# the ABI generation _bind's ctypes signatures target; the header is
+# authoritative in a checkout (see _expected_abi_version)
+_BOUND_ABI = 5
+_expected_abi = None
+
+
 def _load(path: str):
     """dlopen+bind, or None when the file is unusable — corrupt artifact,
     a stale build missing newly added symbols (AttributeError), or a
@@ -207,7 +243,7 @@ def _load(path: str):
         return None
     try:
         _bind(lib)
-        ok = lib.dmlc_tpu_abi_version() == 5
+        ok = lib.dmlc_tpu_abi_version() == _expected_abi_version()
     except AttributeError:
         ok = False
     if not ok:

@@ -9,7 +9,12 @@ order; f32 accumulation control"):
    EXCHANGE: rank r contributes its packed grads in row r and zeros
    elsewhere. Under ANY allreduce fold order — tree, ring, any world
    size — row r of the summed matrix is rank r's bytes unchanged,
-   because ``0.0 + x == x`` bitwise for every x. Every path then folds
+   because ``0.0 + x == x`` bitwise for every x except ``x = -0.0``
+   (IEEE: ``0.0 + -0.0 == +0.0``, so a transported gradient entry that
+   is exactly -0.0 lands as +0.0 — the pass criteria treat ±0 as equal,
+   `array_equal` and the ulp metric both, so trajectories are unaffected;
+   strict bit-identity of raw patterns holds for every non-negative-zero
+   entry). Every path then folds
    rows 0..W-1 left-to-right in f32 and applies the SGD update in host
    numpy. The single-process path computes the same W per-part partial
    grads (same InputSplit partition, same jitted kernel) and folds

@@ -16,7 +16,7 @@ host per part; within a part, consumers still shard dynamically).
 
 Prints ``serving HOST PORT`` on stdout once listening. Exits when the
 stream is exhausted and post-drain delivery goes silent for ``--grace``
-seconds (default 10 — raise it when consumers do long work between pulls;
+seconds (default 60 — raise it when consumers do long work between pulls;
 see BlockService.wait for the exact progress semantics). ``--linger``
 keeps serving end-of-stream markers to late consumers until killed.
 """
@@ -42,9 +42,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--format", default="auto",
                     choices=["auto", "libsvm", "libfm", "csv", "recordio"])
     ap.add_argument("--nthread", type=int, default=2)
-    ap.add_argument("--grace", type=float, default=10.0,
+    ap.add_argument("--grace", type=float, default=60.0,
                     help="post-drain grace window seconds for slow "
-                         "consumers (forwarded to BlockService.wait)")
+                         "consumers (forwarded to BlockService.wait); "
+                         "size it well above one consumer train step")
     ap.add_argument("--linger", action="store_true",
                     help="keep serving end-of-stream to late consumers")
     args = ap.parse_args(argv)

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-long TPU tunnel watcher: probe every PERIOD seconds; the moment the
+# tunnel answers, run the full tpu_measure.py harvest and stop.  Partial
+# results land in OUT even if a later step hangs (tpu_measure runs each
+# step in its own subprocess with a hard timeout).
+#
+# Usage: scripts/tpu_watch.sh [OUT_DIR] [PERIOD_S] [MAX_HOURS]
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${1:-/tmp/dmlc_tpu_bench/tpu_sweep}"
+PERIOD="${2:-600}"
+MAX_HOURS="${3:-11}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+mkdir -p "$OUT"
+LOG="$OUT/watch.log"
+echo "[tpu_watch] start $(date -u +%FT%TZ) period=${PERIOD}s deadline_h=${MAX_HOURS}" >> "$LOG"
+attempt=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  attempt=$((attempt+1))
+  t0=$(date +%s)
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'; print('up:', jax.devices())" >> "$LOG" 2>&1; then
+    echo "[tpu_watch] TUNNEL UP at attempt $attempt $(date -u +%FT%TZ) — harvesting" >> "$LOG"
+    timeout 5400 python "$REPO/scripts/tpu_measure.py" --out "$OUT" >> "$LOG" 2>&1
+    rc=$?
+    echo "[tpu_watch] harvest rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+    if [ $rc -eq 0 ] && [ -s "$OUT/summary.json" ]; then
+      echo "[tpu_watch] DONE" >> "$LOG"
+      exit 0
+    fi
+    # harvest failed mid-way (tunnel died again?) — keep watching
+  else
+    echo "[tpu_watch] attempt $attempt down ($(( $(date +%s) - t0 ))s) $(date -u +%FT%TZ)" >> "$LOG"
+  fi
+  sleep "$PERIOD"
+done
+echo "[tpu_watch] deadline reached without a successful harvest" >> "$LOG"
+exit 1

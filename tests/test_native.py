@@ -3,6 +3,7 @@
 Skipped when the .so has not been built (`make -C cpp`).
 """
 
+import os
 import shutil
 import numpy as np
 import pytest
@@ -227,3 +228,23 @@ class TestStaleLibRecovery:
         lib = native._load(so)
         assert lib is not None, "stale dlopen image not released"
         assert lib.dmlc_tpu_abi_version() == 5
+
+
+def test_abi_version_gate_tracks_header():
+    """The Python-side expected ABI comes from cpp/dmlc_tpu.h (the header
+    _try_build compiles), and the sources-absent fallback constant must
+    match it — this assertion is what makes a header bump that forgets
+    native._BOUND_ABI fail loudly in a checkout instead of silently
+    routing every install-without-sources load through the gate."""
+    header = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "cpp", "dmlc_tpu.h",
+    )
+    with open(header) as fh:
+        versions = [int(line.split()[2]) for line in fh
+                    if line.startswith("#define DMLC_TPU_ABI_VERSION")]
+    assert len(versions) == 1
+    assert native._expected_abi_version() == versions[0]
+    assert native._BOUND_ABI == versions[0], (
+        "cpp/dmlc_tpu.h ABI bumped without updating native._BOUND_ABI"
+    )
