@@ -27,6 +27,21 @@ host: 334 MB/s (see BASELINE.md "measured" section).
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": "MB/s", "vs_baseline": N, "extra": {...}}
+
+Artifact discipline (round-4 lesson: the full per-sweep JSON outgrew the
+driver's tail-capture window and the round's headline number survived
+nowhere machine-readable): the stdout line is a COMPACT summary — headline
+context, every tier's median, device status — bounded well under 2 KB.
+The complete per-sweep/per-trial record is written to a detail file
+(env DMLC_TPU_BENCH_DETAIL, default $DMLC_TPU_BENCH_DIR/bench_detail.json)
+whose path the stdout line carries.
+
+When the live device probe fails, the best tpu_measure.py harvest carrying
+device tiers (searched: env DMLC_TPU_HARVEST_DIR, then
+$DMLC_TPU_BENCH_DIR/tpu_sweep, then the repo's committed
+artifacts/tpu_sweep/) is embedded under extra["harvest"] with provenance
+and age, so a round-end artifact still carries device tiers measured
+during a transient tunnel-up window earlier in the round.
 """
 
 import json
@@ -668,6 +683,198 @@ def _remote_sweep(path: str) -> dict:
                 os.environ[k] = v
 
 
+# keys lifted verbatim from the full record into the compact stdout line:
+# every tier median + device/collective status the verdict reads
+_COMPACT_KEYS = (
+    "recordio_ingest_mbps", "criteo_like_parse_mbps",
+    "criteo_recordio_ingest_mbps", "remote_ingest_mbps",
+    "feed_dense_mbps", "sgd_e2e_mbps", "sgd_csr_e2e_mbps",
+    "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
+    "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",
+    "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
+    "socket_note", "psum_single_device_gbps", "psum_step_ms",
+    "psum_devices", "psum_platform", "psum_algo_gbps",
+    "psum_ici_utilization", "bucket_fused_ms", "bucket_per_tensor_ms",
+    "engine_allreduce_gbps", "engine_reduce_single_process_gbps",
+    "headline_cfg_nthread", "headline_spread_mbps", "headline_sweep",
+)
+
+
+# a harvest is only worth embedding if it carries DEVICE evidence — every
+# bench record (including device-less runs) has host-tier keys, so those
+# must not qualify a candidate
+_DEVICE_TIER_KEYS = (
+    "feed_dense_mbps", "sgd_e2e_mbps", "sgd_csr_e2e_mbps",
+    "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
+)
+
+
+def _harvest_dirs():
+    env = os.environ.get("DMLC_TPU_HARVEST_DIR")
+    if env:
+        yield env
+    yield os.path.join(CACHE_DIR, "tpu_sweep")
+    yield os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "artifacts", "tpu_sweep"
+    )
+
+
+def _read_json_lines(path, want):
+    """First JSON line in ``path`` for which ``want(obj)`` is truthy."""
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line.startswith("{"):
+                    obj = json.loads(line)
+                    if want(obj):
+                        return obj
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _scan_harvest_dir(d):
+    """One candidate dir → (has_device_tiers, timestamp, harvest dict) or
+    None. Everything (selection score, timestamp, record) is captured in
+    ONE pass so the chosen record and its provenance can't describe
+    different files."""
+    record = None
+    mtime = None
+    for name in ("bench_detail.json", "bench.json"):
+        p = os.path.join(d, name)
+        if not os.path.exists(p):
+            continue
+        cand = _read_json_lines(
+            p, lambda o: "extra" in o or "feed_dense_mbps" in o)
+        if cand is not None:
+            record = cand.get("extra", cand)
+            mtime = os.path.getmtime(p)
+            break
+    if record is None:
+        return None
+    out = {"provenance": "harvested", "dir": d}
+    # measurement time comes from INSIDE the artifacts (summary.json's
+    # "started"); file mtime is a fallback only and labeled as such —
+    # a git checkout rewrites mtimes, so committed artifacts would
+    # otherwise claim age ~0
+    summary = _read_json_lines(
+        os.path.join(d, "summary.json"), lambda o: "started" in o)
+    if summary:
+        out["harvested_at"] = summary["started"]
+        try:
+            ts = time.mktime(
+                time.strptime(summary["started"], "%Y-%m-%d %H:%M:%S"))
+            out["age_hours"] = round((time.time() - ts) / 3600, 1)
+        except ValueError:
+            pass
+    else:
+        out["harvested_at"] = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(mtime))
+        out["age_hours"] = round((time.time() - mtime) / 3600, 1)
+        out["timestamp_source"] = "file-mtime (no summary.json)"
+    for key in _COMPACT_KEYS:
+        if key in record and not key.startswith(("socket_", "headline_")):
+            out[key] = record[key]
+    if isinstance(record.get("parity"), dict):
+        out["parity"] = record["parity"]
+    pallas = os.path.join(d, "pallas_flash.json")
+    if os.path.exists(pallas):
+        rows = []
+        try:
+            with open(pallas) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line.startswith("{"):
+                        row = json.loads(line)
+                        if "T" in row:
+                            rows.append(row)
+        except (OSError, ValueError):
+            pass
+        if rows:
+            out["pallas_flash"] = rows
+    has_device = any(k in out for k in _DEVICE_TIER_KEYS) or \
+        "pallas_flash" in out
+    return has_device, out.get("age_hours", 1e9), out
+
+
+def _load_latest_harvest():
+    """Best available tpu_measure.py harvest → compact device-tier dict
+    with provenance, or None. A dead tunnel at round end must not erase
+    device numbers captured during a tunnel-up window earlier in the
+    round — the harvest's own timestamp and age make the provenance
+    explicit (these are NOT live numbers and are labeled so). Candidates
+    WITH device tiers always outrank device-less records (a later failed
+    sweep must not shadow an earlier good one); among equals, newest
+    wins."""
+    best = None  # (has_device, -age) ranking
+    for d in _harvest_dirs():
+        scanned = _scan_harvest_dir(d)
+        if scanned is None:
+            continue
+        has_device, age, out = scanned
+        rank = (1 if has_device else 0, -age)
+        if best is None or rank > best[0]:
+            best = (rank, out)
+    if best is None or best[0][0] == 0:
+        return None  # nothing with device evidence — embed nothing
+    return best[1]
+
+
+def _compact_summary(headline: float, extra: dict) -> dict:
+    """The single stdout line: bounded (≤2 KB) so the driver's tail capture
+    can never truncate it mid-JSON again (BENCH_r04 'parsed: null')."""
+    compact = {}
+    for key in _COMPACT_KEYS:
+        if key in extra:
+            compact[key] = extra[key]
+    if isinstance(extra.get("parity"), dict):
+        compact["parity"] = extra["parity"]
+    probe = extra.get("device_probe", {}).get("attempts", [])
+    compact["device_probe_ok"] = bool(probe) and probe[-1].get("ok", False)
+    if "device_unavailable" in extra:
+        compact["device_unavailable"] = extra["device_unavailable"][:120]
+    for key, val in extra.items():
+        if key.endswith("_error"):
+            compact[key] = str(val)[:120]
+    if "harvest" in extra:
+        compact["harvest"] = extra["harvest"]
+    if "detail_path" in extra:
+        compact["detail_path"] = extra["detail_path"]
+    line = {
+        "metric": "higgs_libsvm_ingest",
+        "value": round(headline, 1),
+        "unit": "MB/s",
+        "vs_baseline": round(headline / REFERENCE_MBPS, 3),
+        "extra": compact,
+    }
+    # hard bound: shed payloads in increasing order of verdict value until
+    # the line fits — first the bulky optionals, then error texts, then
+    # non-tier context keys; the loop cannot exit oversize while anything
+    # sheddable remains (the bare metric/value core is ~120 bytes)
+    def _oversize():
+        return len(json.dumps(line)) > 2048
+
+    if _oversize() and isinstance(compact.get("harvest"), dict):
+        compact["harvest"].pop("pallas_flash", None)
+    for drop in ("harvest", "parity"):
+        if _oversize():
+            compact.pop(drop, None)
+    if _oversize():
+        for key in [k for k in compact if k.endswith("_error")]:
+            compact.pop(key, None)
+            if not _oversize():
+                break
+    if _oversize():
+        for key in [k for k in compact
+                    if k.startswith(("socket_", "headline_", "psum_",
+                                     "bucket_", "engine_", "device_"))]:
+            compact.pop(key, None)
+            if not _oversize():
+                break
+    return line
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     path = _ensure_data()
@@ -705,9 +912,17 @@ def main() -> None:
     }
     device_ok, device_note, probe_record = _device_backend_ok()
     extra["device_probe"] = probe_record
+    # host-speed context bracketing the device tiers (the probe itself is
+    # not sweep-controlled like the tiers — r03→r04 it swung 1.12→0.71
+    # with the documented host bimodality; a pre AND post reading makes a
+    # slow window visible instead of letting it masquerade as a device
+    # regression)
     extra["device_feed_probe_gbps"] = _host_probe()
     if not device_ok:
         extra["device_unavailable"] = device_note + "; device tiers skipped"
+        harvest = _load_latest_harvest()
+        if harvest:
+            extra["harvest"] = harvest
     else:
         for tier_fn, err_key in (
             (lambda: _bench_device_feed(path), "device_feed_error"),
@@ -733,6 +948,7 @@ def main() -> None:
             }
         except Exception as err:
             extra["parity_error"] = str(err)
+        extra["device_feed_probe_gbps_post"] = _host_probe()
 
     sweeps.append(_headline_sweep(path))
     run_host_tier_sweeps()  # tier sweep 2
@@ -770,17 +986,29 @@ def main() -> None:
     headline, headline_extra = _combine_headline(sweeps)
     extra = {**headline_extra, **extra}
 
-    print(
-        json.dumps(
-            {
-                "metric": "higgs_libsvm_ingest",
-                "value": round(headline, 1),
-                "unit": "MB/s",
-                "vs_baseline": round(headline / REFERENCE_MBPS, 3),
-                "extra": extra,
-            }
-        )
+    # full record to the detail file; COMPACT summary (≤2 KB) to stdout
+    detail_path = os.environ.get(
+        "DMLC_TPU_BENCH_DETAIL",
+        os.path.join(CACHE_DIR, "bench_detail.json"),
     )
+    detail_line = json.dumps(
+        {
+            "metric": "higgs_libsvm_ingest",
+            "value": round(headline, 1),
+            "unit": "MB/s",
+            "vs_baseline": round(headline / REFERENCE_MBPS, 3),
+            "extra": extra,
+        }
+    )
+    try:
+        os.makedirs(os.path.dirname(detail_path) or ".", exist_ok=True)
+        with open(detail_path, "w") as fh:
+            fh.write(detail_line + "\n")
+        extra["detail_path"] = detail_path
+    except OSError as err:  # detail is best-effort; the summary must print
+        extra["detail_write_error"] = str(err)[:120]
+
+    print(json.dumps(_compact_summary(headline, extra)))
 
 
 if __name__ == "__main__":

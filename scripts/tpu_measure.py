@@ -126,7 +126,12 @@ def main(argv=None) -> int:
 
     summary["steps"]["bench"] = _run(
         "bench", [sys.executable, "bench.py"], args.out, 2400,
-        env={"DMLC_TPU_BENCH_PROBE_ATTEMPTS": "2"},
+        env={"DMLC_TPU_BENCH_PROBE_ATTEMPTS": "2",
+             # bench.py's stdout is now a compact summary; route the full
+             # per-sweep record into the harvest dir so the embed path
+             # (bench._load_latest_harvest) finds every device tier
+             "DMLC_TPU_BENCH_DETAIL": os.path.join(
+                 args.out, "bench_detail.json")},
     )
     summary["steps"]["pallas_flash"] = _run(
         "pallas_flash",
