@@ -944,7 +944,8 @@ def main() -> None:
             extra["parity"] = {
                 k: parity[k]
                 for k in ("single_backend", "bitexact", "max_grad_ulp",
-                          "max_loss_rel", "max_param_abs_diff", "pass")
+                          "max_loss_rel", "max_param_abs_diff",
+                          "criterion", "pass")
             }
         except Exception as err:
             extra["parity_error"] = str(err)

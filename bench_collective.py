@@ -32,7 +32,10 @@ DEFAULT_SOCKET_CASES = (
     ("socket_tree_64k", 64 << 10, "tree"),
     ("socket_ring_8m", 8 << 20, "ring"),
 )
-DEFAULT_SOCKET_WORLD = 4
+# DMLC_TPU_BENCH_SOCKET_WORLD re-derives the tree/ring crossover at other
+# world sizes on capable hosts (socket_engine.ring_threshold_bytes notes
+# why the world=4 figure shouldn't be trusted at 8+)
+DEFAULT_SOCKET_WORLD = int(os.environ.get("DMLC_TPU_BENCH_SOCKET_WORLD", 4))
 DEFAULT_SOCKET_ITERS = 10
 
 

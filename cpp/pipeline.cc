@@ -28,13 +28,19 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
+// POSIX (any unix): the mmap zero-copy reader
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
 #if defined(__GLIBC__)
-#include <malloc.h>
+#include <malloc.h>  // mallopt (TuneMallocOnce) is glibc-only
 #endif
 
 // The public header carries every cross-TU declaration (parse.cc hot
@@ -136,13 +142,34 @@ struct Buf {
 struct Chunk {
   Buf data;
   int64_t seq = 0;
+  // Borrowed view into the reader's mmap (zero-copy path): when set, the
+  // chunk's bytes are ext[0..ext_len) and `data` stays empty. The mapping
+  // outlives every in-flight chunk (munmap happens in Close after joins).
+  const char* ext = nullptr;
+  int64_t ext_len = 0;
+
+  const char* ptr() const { return ext != nullptr ? ext : data.p; }
+  int64_t len() const { return ext != nullptr ? ext_len : data.size; }
 };
+
+struct BlockPool;
 
 // One parsed CSR batch. Buffers are malloc'd to a generous bound derived
 // from the chunk length (every row and every token is >= 2 bytes, so
 // len/2+2 bounds both) — untouched slack pages are virtual-only, which
 // beats pre-scanning the chunk to size exactly. Indices/fields are u32
 // storage written directly by the 32-bit parse variants.
+//
+// Returnable-block contract (extends the ThreadedIter recycle idea,
+// threadediter.h:442-454, ACROSS the ownership boundary): a block whose
+// text-parse arrays were sized to `cap_bound` elements can be returned to
+// its origin pipeline's BlockPool instead of freed — the next chunk then
+// parses into the SAME already-faulted pages. Release goes through
+// ReleaseBlock() everywhere (including ingest_block_free, i.e. Python
+// owners via the numpy-view finalizer), so the reuse survives the C ABI;
+// blocks from the exact-size parsers (csv, recordio row-groups) keep
+// cap_bound = 0 and always free. `pool` is reset while pooled so the
+// free list never holds the refcount that keeps its own pool alive.
 struct Block {
   float* labels = nullptr;
   float* weights = nullptr;
@@ -154,8 +181,11 @@ struct Block {
   int64_t rows = 0, nnz = 0, ncols = 0;
   int flags = 0;
   int64_t seq = 0;
+  int64_t cap_bound = 0;  // text-parse array capacity (elements); 0 = not
+                          // poolable (exact-size csv/recordio arrays)
+  std::shared_ptr<BlockPool> pool;  // origin pipeline's pool, while alive
 
-  ~Block() {
+  void FreeArrays() {
     std::free(labels);
     std::free(weights);
     std::free(values);
@@ -163,8 +193,68 @@ struct Block {
     std::free(offsets);
     std::free(indices);
     std::free(fields);
+    labels = weights = values = nullptr;
+    qids = offsets = nullptr;
+    indices = fields = nullptr;
+    cap_bound = 0;
+  }
+
+  ~Block() { FreeArrays(); }
+};
+
+// Bounded free list of recycled Blocks, shared between the pipeline's
+// workers and whoever frees blocks (native consumers or Python GC, any
+// thread). Outlives its Pipeline via shared_ptr from in-flight blocks:
+// after Close(), returns route to plain delete.
+struct BlockPool {
+  std::mutex mu;
+  std::vector<Block*> free_list;
+  size_t cap = 8;
+  bool closed = false;
+
+  Block* Acquire() {
+    std::lock_guard<std::mutex> lk(mu);
+    if (free_list.empty()) return nullptr;
+    Block* b = free_list.back();
+    free_list.pop_back();
+    return b;
+  }
+
+  // true when pooled; false -> caller deletes
+  bool Put(Block* b) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (closed || free_list.size() >= cap) return false;
+    free_list.push_back(b);
+    return true;
+  }
+
+  void Close() {
+    std::vector<Block*> drop;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      closed = true;
+      drop.swap(free_list);
+    }
+    for (Block* b : drop) delete b;
   }
 };
+
+// The one release path for every Block regardless of owner: recycle into
+// the origin pool when the block is poolable and the pipeline is still
+// alive, else free. Per-parse fields are reset here (arrays and
+// cap_bound survive — they are the point).
+void ReleaseBlock(Block* b) {
+  if (b == nullptr) return;
+  std::shared_ptr<BlockPool> pool;
+  pool.swap(b->pool);
+  if (pool != nullptr && b->cap_bound > 0) {
+    b->rows = b->nnz = b->ncols = 0;
+    b->flags = 0;
+    b->seq = 0;
+    if (pool->Put(b)) return;
+  }
+  delete b;
+}
 
 template <typename T>
 T* AllocArray(int64_t n) {
@@ -283,6 +373,12 @@ class Pipeline {
         csv_expect_cols_(csv_expect_cols),
         push_mode_(push_mode) {
     TuneMallocOnce();
+    // DMLC_TPU_BLOCK_POOL=0 opts out (cap 0: every Put declines and
+    // blocks free as before) — the A/B lever for measuring the recycle
+    const char* env = std::getenv("DMLC_TPU_BLOCK_POOL");
+    pool_->cap = (env != nullptr && env[0] == '0')
+                     ? 0
+                     : static_cast<size_t>(out_capacity_ + nthread_ + 4);
   }
 
   ~Pipeline() { Close(); }
@@ -446,7 +542,7 @@ class Pipeline {
     if (indices != nullptr) std::memcpy(indices, b->indices, z * 4);
     if (values != nullptr) std::memcpy(values, b->values, z * 4);
     if (fields != nullptr) std::memcpy(fields, b->fields, z * 4);
-    delete b;
+    ReleaseBlock(b);
     return 1;
   }
 
@@ -486,7 +582,7 @@ class Pipeline {
         current_ = nullptr;  // take ownership
       }
       if (b->rows == 0) {
-        delete b;
+        ReleaseBlock(b);
         continue;
       }
       staged_.push_back(Span{b, 0});
@@ -742,6 +838,15 @@ class Pipeline {
     for (Span& sp : staged_) delete sp.block;
     staged_.clear();
     staged_rows_ = 0;
+    // after this, blocks still owned by consumers (Python views) free
+    // directly on release instead of returning here
+    pool_->Close();
+    // all chunk views are dead (reader + workers joined, queues cleared)
+    if (map_base_ != nullptr) {
+      ::munmap(map_base_, map_len_);
+      map_base_ = nullptr;
+      map_len_ = 0;
+    }
   }
 
  private:
@@ -769,7 +874,7 @@ class Pipeline {
     sp.row += rows;
     staged_rows_ -= rows;
     if (sp.row >= sp.block->rows) {
-      delete sp.block;
+      ReleaseBlock(sp.block);
       staged_.pop_front();
     }
   }
@@ -873,7 +978,12 @@ class Pipeline {
     }
     int64_t begin = AdjustBoundary(&rd, raw_begin);
     int64_t end = AdjustBoundary(&rd, raw_end);
-    if (begin < 0 || end < 0 || !rd.SeekGlobal(begin)) {
+    if (begin < 0 || end < 0) {
+      Fail(kEIo);
+      return;
+    }
+    if (begin < end && TryMmapReader(begin, end)) return;
+    if (!rd.SeekGlobal(begin)) {
       Fail(kEIo);
       return;
     }
@@ -958,23 +1068,107 @@ class Pipeline {
   // just past the last EOL char (line_split.cc FindLastRecordBegin).
   // RecordIO: the last aligned head frame (the chunk starts at a head, so
   // in-buffer heads stay 4B-aligned; see AdjustBoundary notes).
-  int64_t LastRecordBegin(const Buf& buf) const {
+  int64_t LastRecordBegin(const char* p, int64_t size) const {
     if (format_ == kRecordIO) {
-      for (int64_t i = (buf.size - 8) & ~int64_t(3); i >= 4; i -= 4) {
+      for (int64_t i = (size - 8) & ~int64_t(3); i >= 4; i -= 4) {
         uint32_t w;
-        std::memcpy(&w, buf.p + i, 4);
+        std::memcpy(&w, p + i, 4);
         if (w != kRioMagic) continue;
         uint32_t lrec;
-        std::memcpy(&lrec, buf.p + i + 4, 4);
+        std::memcpy(&lrec, p + i + 4, 4);
         uint32_t cflag = lrec >> 29;
         if (cflag == 0 || cflag == 1) return i;
       }
       return 0;
     }
-    for (int64_t i = buf.size - 1; i >= 1; --i) {
-      if (is_eol(buf.p[i])) return i + 1;
+    for (int64_t i = size - 1; i >= 1; --i) {
+      if (is_eol(p[i])) return i + 1;
     }
     return 0;
+  }
+
+  int64_t LastRecordBegin(const Buf& buf) const {
+    return LastRecordBegin(buf.p, buf.size);
+  }
+
+  // Zero-copy reader: serve the partition's chunks as borrowed views into
+  // one mmap of the file instead of fread-ing into owned buffers. On a
+  // host where reader and workers share cores (every TPU-host ingest is
+  // CPU-bound on parse), the fread memcpy is pure serial overhead —
+  // ~10-15% of wall on the criteo shape. Engages only when the whole
+  // byte range lies inside ONE file (a record spanning two files needs
+  // the copying reader's stitch loop); the mapping outlives in-flight
+  // chunks (munmap in Close after joins). DMLC_TPU_MMAP=0 opts out
+  // (e.g. files on file systems where SIGBUS-on-truncate is a concern —
+  // the fread path misreads a concurrently truncated file, this one
+  // faults; neither is a supported use).
+  // Returns true when it served the range (or was stopped mid-way);
+  // false -> caller runs the fread loop.
+  bool TryMmapReader(int64_t begin, int64_t end) {
+    const char* env = std::getenv("DMLC_TPU_MMAP");
+    if (env != nullptr && env[0] == '0') return false;
+    int file_idx = -1;
+    int64_t file_base = 0, acc = 0;
+    for (size_t i = 0; i < sizes_.size(); ++i) {
+      if (begin >= acc && end <= acc + sizes_[i]) {
+        file_idx = static_cast<int>(i);
+        file_base = acc;
+        break;
+      }
+      acc += sizes_[i];
+    }
+    if (file_idx < 0 || sizes_[file_idx] <= 0) return false;
+    int64_t tr = NowNs();
+    int fd = ::open(paths_[file_idx].c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    size_t mlen = static_cast<size_t>(sizes_[file_idx]);
+    void* base = ::mmap(nullptr, mlen, PROT_READ, MAP_PRIVATE, fd, 0);
+    ::close(fd);
+    if (base == MAP_FAILED) return false;
+    ::madvise(base, mlen, MADV_SEQUENTIAL);
+    map_base_ = base;
+    map_len_ = mlen;
+    reader_io_ns_.fetch_add(NowNs() - tr);
+    const char* p = static_cast<const char*>(base);
+    int64_t pos = begin - file_base;
+    const int64_t le = end - file_base;
+    int64_t seq = 0;
+    while (pos < le) {
+      // same cut discipline as the fread loop: last record begin inside
+      // the window, doubling the window when a record outgrows it
+      int64_t window = chunk_bytes_;
+      int64_t cut;
+      for (;;) {
+        int64_t target = std::min<int64_t>(pos + window, le);
+        if (target >= le) {
+          cut = le;
+          break;
+        }
+        int64_t c = LastRecordBegin(p + pos, target - pos);
+        if (c > 0) {
+          cut = pos + c;
+          break;
+        }
+        window *= 2;
+      }
+      if (cut > pos) {
+        Chunk* chunk = AcquireChunk();
+        if (chunk == nullptr) {  // stopped
+          FinishReader(seq);
+          return true;
+        }
+        chunk->ext = p + pos;
+        chunk->ext_len = cut - pos;
+        chunk->seq = seq++;
+        if (!PushWork(chunk)) {
+          FinishReader(seq);
+          return true;
+        }
+      }
+      pos = cut;
+    }
+    FinishReader(seq);
+    return true;
   }
 
   Chunk* AcquireChunk() {
@@ -993,6 +1187,8 @@ class Pipeline {
       Chunk* c = free_chunks_.back();
       free_chunks_.pop_back();
       c->data.size = 0;
+      c->ext = nullptr;
+      c->ext_len = 0;
       return c;
     }
     return new Chunk();
@@ -1056,18 +1252,20 @@ class Pipeline {
       int rc;
       int64_t t0 = NowNs();
       try {
-        block = new Block();
+        block = pool_->Acquire();
+        if (block == nullptr) block = new Block();
+        block->pool = pool_;
         block->seq = chunk->seq;
-        rc = ParseChunk(chunk->data, block);
+        rc = ParseChunk(chunk->ptr(), chunk->len(), block);
       } catch (const std::bad_alloc&) {
         rc = kEOom;
       }
       parse_ns_.fetch_add(NowNs() - t0);
       chunk_count_.fetch_add(1);
-      bytes_read_.fetch_add(chunk->data.size);
+      bytes_read_.fetch_add(chunk->len());
       ReleaseChunk(chunk);
       if (rc != kOk) {
-        delete block;
+        ReleaseBlock(block);
         Fail(rc);
         return;
       }
@@ -1081,7 +1279,7 @@ class Pipeline {
                block->seq == next_seq_out_;
       });
       if (stop_ || error_ != 0) {
-        delete block;
+        ReleaseBlock(block);
         return;
       }
       done_.emplace(block->seq, block);
@@ -1089,35 +1287,43 @@ class Pipeline {
     }
   }
 
-  int ParseChunk(const Buf& data, Block* b) {
-    const char* p = data.p;
-    int64_t len = data.size;
+  int ParseChunk(const char* p, int64_t len, Block* b) {
     if (format_ == kCsv) return ParseCsvChunk(p, len, b);
     if (format_ == kRecordIO) return ParseRecordIOChunk(p, len, b);
     int64_t bound = len / 2 + 2;  // rows and nnz are both >= 2 bytes each
-    b->labels = AllocArray<float>(bound);
-    b->offsets = AllocArray<int64_t>(bound + 1);
-    // u32 storage, filled directly by the 32-bit parse variants (no
-    // narrowing pass); Block::indices stays a u64* holder by type only
-    b->indices = AllocArray<uint32_t>(bound);
-    b->values = AllocArray<float>(bound);
-    if (b->labels == nullptr || b->offsets == nullptr ||
-        b->indices == nullptr || b->values == nullptr) {
-      return kEOom;
+    if (b->cap_bound < bound) {
+      // recycled arrays too small (or a fresh block): (re)allocate the
+      // full set at this bound. Equal-size chunks make this a one-time
+      // cost per pooled block — steady state re-parses into warm pages.
+      b->FreeArrays();
+      b->labels = AllocArray<float>(bound);
+      b->offsets = AllocArray<int64_t>(bound + 1);
+      // u32 storage, filled directly by the 32-bit parse variants (no
+      // narrowing pass); Block::indices stays a u64* holder by type only
+      b->indices = AllocArray<uint32_t>(bound);
+      b->values = AllocArray<float>(bound);
+      if (b->labels == nullptr || b->offsets == nullptr ||
+          b->indices == nullptr || b->values == nullptr) {
+        return kEOom;
+      }
+      if (format_ == kLibsvm) {
+        b->weights = AllocArray<float>(bound);
+        b->qids = AllocArray<int64_t>(bound);
+        if (b->weights == nullptr || b->qids == nullptr) return kEOom;
+      } else {
+        b->fields = AllocArray<uint32_t>(bound);
+        if (b->fields == nullptr) return kEOom;
+      }
+      b->cap_bound = bound;
     }
     int64_t rows = 0, nnz = 0;
     int rc;
     if (format_ == kLibsvm) {
-      b->weights = AllocArray<float>(bound);
-      b->qids = AllocArray<int64_t>(bound);
-      if (b->weights == nullptr || b->qids == nullptr) return kEOom;
       rc = parse_libsvm32(p, len, b->labels, b->weights, b->qids,
                           b->offsets + 1,
                           b->indices, b->values,
                           bound, bound, &rows, &nnz, &b->flags);
     } else {
-      b->fields = AllocArray<uint32_t>(bound);
-      if (b->fields == nullptr) return kEOom;
       rc = parse_libfm32(p, len, b->labels, b->offsets + 1,
                          b->fields, b->indices, b->values,
                          bound, bound, &rows, &nnz);
@@ -1313,6 +1519,13 @@ class Pipeline {
   std::condition_variable cv_work_, cv_work_space_, cv_out_, cv_out_space_;
   std::deque<Chunk*> work_;
   std::vector<Chunk*> free_chunks_;
+  // returnable parsed blocks (see Block/BlockPool): sized past the
+  // in-flight bound (out queue + one per worker + staging slack) so a
+  // prompt consumer's returns always find room
+  std::shared_ptr<BlockPool> pool_ = std::make_shared<BlockPool>();
+  // zero-copy reader mapping (TryMmapReader); unmapped in Close
+  void* map_base_ = nullptr;
+  size_t map_len_ = 0;
   std::map<int64_t, Block*> done_;
   int64_t next_seq_out_ = 0;
   int64_t total_chunks_ = -1;
@@ -1486,7 +1699,11 @@ void* ingest_fetch_view(void* handle, float** labels, float** weights,
   return b;
 }
 
-void ingest_block_free(void* block) { delete static_cast<Block*>(block); }
+void ingest_block_free(void* block) {
+  // routes poolable blocks back to their origin pipeline's free list
+  // (cross-ABI recycle); frees otherwise
+  ReleaseBlock(static_cast<Block*>(block));
+}
 
 // ---- native batch staging (fixed-shape TPU feed) -------------------------
 // Stage the next batch of up to batch_size rows (pulling parsed blocks in

@@ -223,6 +223,12 @@ class SocketEngine:
     # Real networks shift α and the fold rate — override via
     # DMLC_TPU_RING_THRESHOLD_BYTES (read at engine construction) for a
     # measured deployment.
+    # Derivation scope: world=4 loopback on a 1-core host (bench_collective
+    # forced-topology cases, BENCH_r04). The tree's root-serialization term
+    # grows with W while the ring's per-hop chunk shrinks, so at world 8+
+    # the crossover should move DOWN; re-run the forced-topology sweep on a
+    # multi-core host (DMLC_TPU_BENCH_SOCKET_WORLD=8) before trusting the
+    # 2 MB figure there.
     ring_threshold_bytes: int = 2 << 20
 
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:

@@ -47,3 +47,42 @@ class TestBitExactParity:
         assert out["bitexact"] is True
         assert out["max_grad_ulp"] == 0
         assert out["pass"] is True
+
+
+class TestCrossBackendArm:
+    """The rtol comparison arm (the criterion the chip run will use) must
+    be proven BEFORE a harvest window: a wrong rtol plumb or a broken
+    pass/exit path would otherwise only surface with the tunnel up
+    (VERDICT r04 weak #4). The 'reordered'/'perturbed' kernels are
+    CPU-only stand-ins for a second backend's accumulation-order and
+    transcendental-rounding differences."""
+
+    def test_reordered_kernel_passes_rtol(self):
+        out = run_parity(world=2, steps=2, single_backend="cpu",
+                         single_kernel="reordered", criterion="rtol")
+        assert out["criterion"] == "rtol"
+        assert out["bitexact"] is False        # grads really differ
+        assert out["max_grad_ulp"] > 0
+        assert out["max_loss_rel"] <= out["rtol"]
+        assert out["pass"] is True             # ...but within tolerance
+
+    def test_perturbed_kernel_pass_and_fail_by_rtol(self):
+        """The same measured loss divergence passes a realistic tolerance
+        and fails a too-tight one — both directions of the criterion.
+        The pass-side rtol (1e-3) sits well above the divergence range
+        the perturbed kernel can produce (~1e-7..1e-4), so the test can't
+        go red from a jax/libm version nudging the rounding."""
+        out = run_parity(world=2, steps=3, single_backend="cpu",
+                         single_kernel="perturbed", criterion="rtol",
+                         rtol=1e-3)
+        assert 0.0 < out["max_loss_rel"] <= 1e-3
+        assert out["pass"] is True
+        tight = run_parity(world=2, steps=3, single_backend="cpu",
+                           single_kernel="perturbed", criterion="rtol",
+                           rtol=out["max_loss_rel"] / 10)
+        assert tight["pass"] is False
+
+    def test_auto_criterion_stays_bitexact_on_same_backend(self):
+        out = run_parity(world=2, steps=2, single_backend="cpu")
+        assert out["criterion"] == "bitexact"
+        assert out["pass"] is True

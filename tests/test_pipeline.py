@@ -361,3 +361,79 @@ def test_device_feed_stats(svm_file):
     assert stats["host_batch_ns"] > 0
     assert stats["dispatch_ns"] > 0
     assert stats["pipeline"]["bytes_read"] > 0
+
+
+
+def test_mmap_reader_matches_fread(svm_file, monkeypatch):
+    """The zero-copy mmap reader (pipeline.cc TryMmapReader) must produce
+    byte-identical blocks to the fread loop for every partitioning — same
+    cut discipline, same exactly-once boundary semantics."""
+    baselines = {}
+    for nparts in (1, 2, 5):
+        monkeypatch.setenv("DMLC_TPU_MMAP", "0")
+        for part in range(nparts):
+            baselines[(nparts, part)] = _collect(
+                create_parser(svm_file, part, nparts, nthread=1)
+            )
+        monkeypatch.setenv("DMLC_TPU_MMAP", "1")
+        for part in range(nparts):
+            rows, labels, indices, values = _collect(
+                create_parser(svm_file, part, nparts, nthread=1)
+            )
+            brows, blabels, bindices, bvalues = baselines[(nparts, part)]
+            assert rows == brows
+            np.testing.assert_array_equal(labels, blabels)
+            np.testing.assert_array_equal(indices, bindices)
+            np.testing.assert_array_equal(values, bvalues)
+
+
+
+def test_block_pool_recycles_buffers(tmp_path):
+    """Blocks released by the consumer (the numpy-view finalizer, via
+    ingest_block_free) return to the pipeline's BlockPool: a prompt
+    consumer sees the same physical buffers again instead of fresh
+    mallocs. The file must span MANY chunks (the chunk floor is 64 KB)
+    and the assertion is unconditional — a silently disengaged pool is
+    exactly the regression this exists to catch."""
+    from dmlc_tpu.native import IngestPipeline
+
+    path = tmp_path / "big.svm"
+    with open(path, "w") as fh:
+        for i in range(40_000):  # ~1.2 MB -> ~10 blocks at 128 KB chunks
+            fh.write(f"{i % 2} {i % 97 + 1}:0.5 {i % 89 + 101}:1.5\n")
+    pipe = IngestPipeline(
+        [str(path)], [os.path.getsize(path)], native.INGEST_LIBSVM, 0, 1,
+        nthread=1, chunk_bytes=1 << 17,
+    )
+    addrs = []
+    rows = 0
+    while True:
+        blk = pipe.next_block()
+        if blk is None:
+            break
+        rows += len(blk["labels"])
+        addrs.append(blk["labels"].__array_interface__["data"][0])
+        del blk  # view GC -> ingest_block_free -> pool return
+    pipe.close()
+    assert rows == 40_000
+    assert len(addrs) >= 4, f"expected many chunks, got {len(addrs)}"
+    assert len(set(addrs)) < len(addrs), (
+        "no buffer reuse across blocks — BlockPool disengaged: %r" % addrs
+    )
+
+
+
+def test_block_pool_survives_consumer_holding_blocks(svm_file):
+    """A consumer that HOLDS every block (defeating the pool) must still
+    get correct, independent data — pooling is an optimization, never an
+    aliasing hazard: a held block's arrays must not be re-filled."""
+    parser = create_parser(svm_file, 0, 1, nthread=1)
+    held = [b for b in parser]
+    parser.close()
+    total = sum(len(b) for b in held)
+    assert total == 997
+    # concatenation must reproduce the whole file exactly (no aliasing)
+    labels = np.concatenate([b.label for b in held])
+    assert labels.shape[0] == 997
+    expected = np.array([i % 2 for i in range(997)], dtype=np.float32)
+    np.testing.assert_array_equal(labels, expected)
