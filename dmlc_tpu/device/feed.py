@@ -21,6 +21,7 @@ Batch layouts:
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -41,6 +42,40 @@ from dmlc_tpu.device.csr import (
 )
 from dmlc_tpu.utils.logging import check
 from dmlc_tpu.utils.threaded_iter import ThreadedIter
+
+
+def _available_cpus() -> int:
+    """CPUs actually usable by this process: cgroup/affinity-aware
+    (os.cpu_count() reports the machine and would spawn a useless
+    producer thread in a 1-CPU container on a big host)."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):  # non-linux
+        return os.cpu_count() or 1
+
+
+class _SyncIter:
+    """ThreadedIter-shaped adapter running the producer inline (no
+    thread): `host_prefetch=0`. Same consumer surface — iteration,
+    close(), before_first() restart."""
+
+    def __init__(self, factory):
+        self._factory = factory
+        self._gen = factory()
+
+    def __iter__(self):
+        return self._gen
+
+    def next(self):
+        return next(self._gen, None)
+
+    def before_first(self) -> None:
+        self._gen = self._factory()
+
+    def close(self) -> None:
+        gen, self._gen = self._gen, iter(())
+        if hasattr(gen, "close"):
+            gen.close()
 
 
 @dataclass
@@ -75,8 +110,12 @@ class DeviceFeed:
         axis: str = "dp",
         part_index: int = 0,
         num_parts: int = 1,
-        host_prefetch: int = 2,  # ThreadedIter queue depth (host blocks)
+        host_prefetch: Optional[int] = None,  # ThreadedIter queue depth
+        # (host blocks); 0 = synchronous (no producer thread); None =
+        # auto: 0 on a 1-core host, else 2
     ):
+        if host_prefetch is None:
+            host_prefetch = 0 if _available_cpus() <= 1 else 2
         if isinstance(source, str):
             source = create_parser(source, part_index, num_parts)
         self._parser = source
@@ -113,9 +152,18 @@ class DeviceFeed:
         self._dispatch_ns = 0
         self._wait_ns = 0
         self._batches = 0
-        self._host_iter = ThreadedIter(
-            self._host_batches, max_capacity=host_prefetch, name="device-feed"
-        )
+        self._sync_host = host_prefetch <= 0
+        if self._sync_host:
+            # synchronous host stage: on a 1-core host the prefetch
+            # thread cannot overlap anything and only adds context
+            # switches (~5% of the recordio->SGD epoch); a real TPU host
+            # (many cores) keeps the thread and the overlap
+            self._host_iter = _SyncIter(self._host_batches)
+        else:
+            self._host_iter = ThreadedIter(
+                self._host_batches, max_capacity=host_prefetch,
+                name="device-feed"
+            )
 
     def _axis_shards(self) -> int:
         """How many shard sections THIS process builds along the batch
@@ -226,6 +274,16 @@ class DeviceFeed:
         device_put pays the dispatch overhead N times (measured ~5 ms/call
         through a tunneled runtime); a pytree device_put batches them."""
         if self._mesh is None:
+            if jax.default_backend() == "cpu" and \
+                    os.environ.get("DMLC_TPU_FEED_PUT") != "1":
+                # CPU single-device: the jit boundary performs the
+                # (aligned, possibly zero-copy) ingest itself — an eager
+                # device_put is one extra full copy on the same core the
+                # parse/densify pipeline runs on (measured ~15% of the
+                # recordio->SGD epoch). On an accelerator the eager put
+                # IS the async H2D overlap, so only cpu skips.
+                # DMLC_TPU_FEED_PUT=1 restores the put for A/B.
+                return arrays
             return jax.device_put(arrays)
         if jax.process_count() > 1:
             # multi-host assembly is per-array by API shape
@@ -320,7 +378,12 @@ class DeviceFeed:
             except StopIteration:
                 break
             finally:
-                self._wait_ns += time.monotonic_ns() - t0
+                # sync mode has no producer thread to wait on: the time
+                # inside next() IS host production and already accrues to
+                # _host_ns — also counting it here would double-book the
+                # stage breakdown
+                if not self._sync_host:
+                    self._wait_ns += time.monotonic_ns() - t0
             t1 = time.monotonic_ns()
             pending.append(self._to_device(block))  # async dispatch
             self._dispatch_ns += time.monotonic_ns() - t1
