@@ -626,9 +626,10 @@ class Pipeline {
         // and every row-group written from dense data) densifies as ONE
         // memcpy instead of 28+ dependent scattered stores — the
         // densify was the dominant ingest->SGD stage (~60% of
-        // host_batch time on the recordio bench). The ramp memcmp is a
-        // sequential 4n-byte compare, and the ramp rebuilds only when
-        // (base, n) changes — once per file in practice.
+        // host_batch time on the recordio bench). Cost: dense rows pay
+        // one sequential O(n) compare scan (cheap next to the scatter it
+        // replaces); sparse/irregular rows reject on the single
+        // last-element compare below.
         int64_t n = hi - lo;
         if (has_v && n > 0 && static_cast<int64_t>(idx[lo]) + n <=
                                   num_features) {

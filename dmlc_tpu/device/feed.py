@@ -70,6 +70,10 @@ class _SyncIter:
         return next(self._gen, None)
 
     def before_first(self) -> None:
+        # close the old generator first (ThreadedIter.before_first fully
+        # shuts down its producer): a suspended generator would keep a
+        # staged native batch and parser state pinned alongside the new one
+        self.close()
         self._gen = self._factory()
 
     def close(self) -> None:

@@ -454,3 +454,203 @@ def test_two_process_mesh_trains_and_agrees(tmp_path, layout, port):
     # alone is not correctness)
     oracle = _oracle_losses(uri, world, layout, feats)
     np.testing.assert_allclose(losses, oracle, rtol=2e-5)
+
+
+RECOVERY_WORKER = r'''
+import os, sys
+sys.path.insert(0, "__REPO__")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+from dmlc_tpu.parallel.distributed import initialize_from_env
+
+initialize_from_env()  # jax.distributed: 2 procs -> 4-device world
+from dmlc_tpu import collective as rabit
+
+rabit.init()  # tracker control plane (socket engine; recover keeps rank)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.models.linear import init_linear_params, make_linear_train_step
+from dmlc_tpu.parallel import data_parallel_mesh
+
+CKPT, MODE = sys.argv[1], sys.argv[2]
+EPOCHS, STEPS, B, F = 4, 2, 64, 6
+rank = rabit.rank()
+attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", 0))
+assert jax.device_count() == 4, jax.device_count()
+mesh = data_parallel_mesh()
+step = make_linear_train_step(mesh, learning_rate=0.5)
+sharding = NamedSharding(mesh, P("dp"))
+
+
+def round_fn():
+    # rabit round contract: START from checkpoint state so a replay (or a
+    # restarted process) resumes from the last agreed snapshot
+    state = rabit.load_checkpoint(CKPT)
+    if state is None:
+        p0 = init_linear_params(F)
+        state = (0, {k: np.asarray(v) for k, v in p0.items()},
+                 {k: np.zeros_like(np.asarray(v)) for k, v in p0.items()},
+                 [])
+    epoch, pnp, vnp, losses = state
+    if epoch >= EPOCHS:
+        return state
+    if MODE == "crash" and rank == 0 and attempt == 0 and epoch == 2:
+        os._exit(17)  # hard kill AFTER epoch-2 checkpoint exists
+    params = {k: jnp.asarray(v) for k, v in pnp.items()}
+    vel = {k: jnp.asarray(v) for k, v in vnp.items()}
+    rng = np.random.RandomState(100 + epoch)  # same global batches: SPMD
+    lsum = wsum = 0.0
+    for _ in range(STEPS):
+        x = rng.rand(B, F).astype(np.float32)
+        y = (rng.rand(B) > 0.5).astype(np.float32)
+        batch = {"x": jax.device_put(jnp.asarray(x), sharding),
+                 "label": jax.device_put(jnp.asarray(y), sharding),
+                 "weight": jax.device_put(jnp.ones(B), sharding)}
+        params, vel, m = step(params, vel, batch)
+        lsum += float(m["loss_sum"]); wsum += float(m["weight_sum"])
+    state = (epoch + 1,
+             {k: np.asarray(v) for k, v in params.items()},
+             {k: np.asarray(v) for k, v in vel.items()},
+             losses + [round(lsum / max(wsum, 1e-12), 8)])
+    if rank == 0:
+        rabit.checkpoint(state, CKPT)  # shared URI: restarts resync here
+    else:
+        rabit.checkpoint(state)
+    return state
+
+
+state = (0, None, None, [])
+while state[0] < EPOCHS:
+    # socket-plane failures recover in-process (cmd='recover' keeps the
+    # rank); a jax-plane failure is fail-stop by design — the process
+    # exits and the tpu launcher's per-task retry restarts it into a
+    # fresh jax.distributed rendezvous (SURVEY §5.3 TPU mapping)
+    state = rabit.run_with_recovery(round_fn)
+print("RESULT rank=%d attempt=%d losses=%s w0=%.8f"
+      % (rank, attempt, ",".join("%.8f" % v for v in state[3]),
+         float(state[1]["w"][0])), flush=True)
+rabit.finalize()
+'''
+
+
+def _free_port() -> str:
+    import socket as _socket
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
+def _run_recovery_job(tmp_path, mode: str, port: str):
+    """dmlc-submit --cluster=tpu with per-task retries; → {rank: (attempt,
+    losses, w0)} parsed from worker RESULT lines."""
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost\nlocalhost\n")
+    worker = tmp_path / f"worker_{mode}.py"
+    worker.write_text(RECOVERY_WORKER.replace("__REPO__", REPO))
+    ckpt = tmp_path / f"ckpt_{mode}.bin"
+    if ckpt.exists():  # a retried job must not resume a prior attempt's
+        ckpt.unlink()  # checkpoint (the crash epoch would never re-run)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "dmlc-submit"),
+         "--cluster", "tpu", "-n", "2", "-H", str(hostfile),
+         "--host-ip", "127.0.0.1", "--tpu-coordinator-port", port,
+         "--max-attempts", "3",
+         sys.executable, str(worker), str(ckpt), mode],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO, start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=540)
+    finally:
+        if proc.poll() is None:
+            import signal
+
+            os.killpg(proc.pid, signal.SIGKILL)
+    assert proc.returncode == 0, out[-2000:]
+    # regex, not line splitting: the two workers' RESULT prints can land
+    # glued on one pipe line (launcher relay buffering), which a
+    # line-oriented parse collapses into a single rank
+    import re
+
+    results = {}
+    for m in re.finditer(
+        r"RESULT rank=(\d+) attempt=(\d+) "
+        r"losses=([0-9.,\-]+?) w0=(-?\d+\.\d+)", out
+    ):
+        results[int(m.group(1))] = (
+            int(m.group(2)), m.group(3), float(m.group(4)))
+    assert sorted(results) == [0, 1], out[-2000:]
+    return results
+
+
+def _recovery_oracle():
+    """Mesh-less replay of the exact batch stream → (losses, w0)."""
+    import jax.numpy as jnp
+
+    from dmlc_tpu.models.linear import (
+        init_linear_params, make_linear_train_step)
+
+    step = make_linear_train_step(None, learning_rate=0.5)
+    params = init_linear_params(6)
+    vel = {k: jnp.zeros_like(v) for k, v in params.items()}
+    losses = []
+    for epoch in range(4):
+        rng = np.random.RandomState(100 + epoch)
+        lsum = wsum = 0.0
+        for _ in range(2):
+            x = rng.rand(64, 6).astype(np.float32)
+            y = (rng.rand(64) > 0.5).astype(np.float32)
+            b = {"x": jnp.asarray(x), "label": jnp.asarray(y),
+                 "weight": jnp.ones(64)}
+            params, vel, m = step(params, vel, b)
+            lsum += float(m["loss_sum"]); wsum += float(m["weight_sum"])
+        losses.append(lsum / max(wsum, 1e-12))
+    return losses, float(params["w"][0])
+
+
+@pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
+                    reason="multihost tier disabled")
+def test_multihost_elastic_recovery_kill_and_rejoin(tmp_path):
+    """VERDICT r04 missing #4, end to end at the multihost tier: one of
+    the two REAL jax.distributed processes is killed mid-training (after
+    the epoch-2 checkpoint) and rejoins — the tpu launcher's per-task
+    retry restarts it, the tracker re-entry keeps its rank, both
+    processes re-rendezvous in a fresh jax.distributed world, training
+    resumes from the collective checkpoint URI, and the final trajectory
+    matches both the crash-free multihost run and the mesh-less oracle.
+    (Reference analog: tracker.py:279-291 recover re-entry + rabit
+    checkpoint replay.)"""
+    # dynamic ports (a fixed pair lands in TIME_WAIT between back-to-back
+    # runs); the probe-then-bind gap is racy, so one retry with a fresh
+    # port absorbs a lost race instead of flaking the tier
+    def run(mode):
+        try:
+            return _run_recovery_job(tmp_path, mode, _free_port())
+        except AssertionError:
+            return _run_recovery_job(tmp_path, mode, _free_port())
+
+    clean = run("clean")
+    crashed = run("crash")
+    # ranks agree within each run
+    assert clean[0][1] == clean[1][1], clean
+    assert crashed[0][1] == crashed[1][1], crashed
+    # the killed worker really died and came back on a later attempt
+    assert crashed[0][0] >= 1, crashed
+    # crash+rejoin reproduces the crash-free trajectory exactly
+    assert crashed[0][1] == clean[0][1], (crashed, clean)
+    assert crashed[0][2] == pytest.approx(clean[0][2], rel=1e-6)
+    # and the multihost trajectory matches the mesh-less oracle
+    oracle_losses, oracle_w0 = _recovery_oracle()
+    got = [float(v) for v in clean[0][1].split(",")]
+    np.testing.assert_allclose(got, oracle_losses, rtol=1e-5)
+    assert clean[0][2] == pytest.approx(oracle_w0, rel=1e-4)
