@@ -23,6 +23,7 @@ libfm_parser.h, csv_parser.h, strtonum.h):
 from __future__ import annotations
 
 import concurrent.futures
+import os
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
@@ -743,6 +744,110 @@ class NativePipelineParser:
             pass
 
 
+def _try_native_cached(
+    spec: URISpec,
+    data_format: str,
+    part_index: int,
+    num_parts: int,
+    nthread: int,
+) -> Optional["NativePipelineParser"]:
+    """``#cachefile`` on a local libsvm uri, the TPU-native way.
+
+    DiskRowIter's build-then-stream contract
+    (/root/reference/src/data/disk_row_iter.h:95-141: BuildCache spills
+    parsed pages, TryLoadCache streams them back per epoch) with the
+    cache in the binary row-group format (data/rowrec.py): the first
+    parser instance parses its text part through the native pipeline and
+    spills row groups; every later epoch — and every later parser
+    instance over the same uri — ingests the cache with the scan-free
+    recordio path (~5-9x the text parse on this host class). The cache
+    carries a sidecar meta with the source signature so a changed source
+    rebuilds instead of silently serving stale rows (the reference
+    reuses blindly; cheap to do better). Scope: libsvm only — libfm
+    carries fields the row-group layout omits, csv has a table layout,
+    recordio is already binary.
+    """
+    if data_format != "libsvm":
+        return None
+    from dmlc_tpu import native
+
+    if not native.available():
+        return None
+    from dmlc_tpu.io.filesystem import list_split_files
+
+    try:
+        files = list_split_files(spec.uri)
+    except Exception:
+        return None
+    if not files or not all(
+        info.path.protocol in ("file://", "") for info in files
+    ):
+        return None
+    import json as _json
+
+    # a DISTINCT path from the user's #cachefile name: the Python stack's
+    # CachedInputSplit/DiskRowIter use that exact path in incompatible
+    # formats and reuse whatever exists — a later fallback run (native
+    # lib unavailable) must find ITS cache absent, not misparse
+    # row-group binary as framed text chunks
+    cache = spec.cache_file + ".rowrec"
+    meta_path = cache + ".meta"
+    tmp_tag = ".tmp.%d" % os.getpid()  # concurrent builders must not
+    # interleave writes into one shared tmp; last atomic replace wins
+    try:
+        sig = {
+            "format": "rowrec-v1",
+            "src_bytes": int(sum(info.size for info in files)),
+            # ns-resolution mtime: a same-length in-place rewrite within
+            # the same second must still invalidate
+            "src_mtime_ns": max(
+                os.stat(info.path.name).st_mtime_ns for info in files
+            ),
+            "part": part_index,
+            "num_parts": num_parts,
+        }
+        valid = False
+        if os.path.exists(cache) and os.path.exists(meta_path):
+            try:
+                with open(meta_path) as fh:
+                    valid = _json.load(fh) == sig
+            except (OSError, ValueError):
+                valid = False
+        if not valid:
+            from dmlc_tpu.data.rowrec import RowGroupWriter
+            from dmlc_tpu.io.filesystem import create_stream
+
+            base = NativePipelineParser(
+                [info.path.name for info in files],
+                [info.size for info in files],
+                "libsvm", part_index, num_parts,
+                nthread=nthread, args=spec.args,
+            )
+            try:
+                with create_stream(cache + tmp_tag, "w") as out:
+                    writer = RowGroupWriter(out, rows_per_group=4096)
+                    for block in base:
+                        writer.write_block(block)
+            finally:
+                base.close()
+            os.replace(cache + tmp_tag, cache)
+            with open(meta_path + tmp_tag, "w") as fh:
+                _json.dump(sig, fh)
+            os.replace(meta_path + tmp_tag, meta_path)
+        # the cache holds exactly THIS part's rows: serve it whole
+        return NativePipelineParser(
+            [cache], [os.path.getsize(cache)], "recordio", 0, 1,
+            nthread=nthread, args=spec.args,
+        )
+    except Exception:
+        for tmp in (cache + tmp_tag, meta_path + tmp_tag):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        return None
+
+
 def _try_native_pipeline(
     spec: URISpec,
     data_format: str,
@@ -759,7 +864,9 @@ def _try_native_pipeline(
     if data_format not in ("libsvm", "libfm", "csv", "recordio"):
         return None
     if spec.cache_file:
-        return None
+        return _try_native_cached(
+            spec, data_format, part_index, num_parts, nthread
+        )
     from dmlc_tpu import native
 
     if not native.available():

@@ -437,3 +437,56 @@ def test_block_pool_survives_consumer_holding_blocks(svm_file):
     assert labels.shape[0] == 997
     expected = np.array([i % 2 for i in range(997)], dtype=np.float32)
     np.testing.assert_array_equal(labels, expected)
+
+
+def test_cachefile_routes_native_rowgroup(tmp_path):
+    """#cachefile on a local libsvm uri = DiskRowIter's build-then-stream
+    contract (disk_row_iter.h:95-141) with a binary row-group cache served
+    by the native recordio path: first instance builds, later instances
+    stream the cache, content identical to the plain text parse; a changed
+    source invalidates the cache via the meta signature."""
+    import time as _time
+
+    path = tmp_path / "d.svm"
+    with open(path, "w") as fh:
+        for i in range(5000):
+            fh.write(f"{i % 2} {i % 7 + 1}:0.25 {i % 11 + 30}:1.5\n")
+    cache = tmp_path / "d.cache"
+    uri = f"{path}#{cache}"
+
+    def collect(u):
+        return _collect(create_parser(u, 0, 1, nthread=1))
+
+    first = collect(uri)          # builds the cache
+    # the native cache gets its own .rowrec suffix so the Python stack's
+    # CachedInputSplit (different format, same #cachefile name) can never
+    # pick it up by accident
+    assert (tmp_path / "d.cache.rowrec").exists()
+    assert (tmp_path / "d.cache.rowrec.meta").exists()
+    assert not cache.exists()
+    cached = collect(uri)         # streams it
+    plain = collect(str(path))
+    for got in (first, cached):
+        assert got[0] == plain[0] == 5000
+        np.testing.assert_array_equal(got[1], plain[1])
+        np.testing.assert_array_equal(got[2], plain[2])
+        np.testing.assert_array_equal(got[3], plain[3])
+    # the cached instance must be the native recordio pipeline
+    p = create_parser(uri, 0, 1, nthread=1)
+    assert isinstance(p, NativePipelineParser)
+    p.close()
+    # parts get their own caches; union is exactly-once
+    total = 0
+    for part in range(3):
+        pp = create_parser(uri, part, 3, nthread=1)
+        total += sum(len(b) for b in pp)
+        pp.close()
+    assert total == 5000
+    assert (tmp_path / "d.cache.split3.part2.rowrec").exists()
+    # source change -> stale cache rebuilt, not served
+    with open(path, "a") as fh:
+        fh.write("1 3:9.0\n")
+    now = _time.time() + 10
+    os.utime(path, (now, now))
+    rebuilt = collect(uri)
+    assert rebuilt[0] == 5001
