@@ -811,8 +811,20 @@ def _scan_harvest_dir(d):
             pass
         if rows:
             out["pallas_flash"] = rows
-    has_device = any(k in out for k in _DEVICE_TIER_KEYS) or \
-        "pallas_flash" in out
+    # CPU-fallback records carry the same tier keys on the cpu backend;
+    # only an actual accelerator run counts as harvest-worthy device
+    # evidence (embedding cpu numbers as "harvested" would defeat the
+    # provenance discipline). Detection must not hinge on the "device"
+    # key alone — a TPU run whose feed tier errored doesn't set it but
+    # its surviving SGD tiers are still real evidence — so fallback runs
+    # are identified by their own markers: device=="cpu" or the
+    # device_unavailable note both label the cpu path.
+    cpu_fallback = (
+        record.get("device") == "cpu" or "device_unavailable" in record
+    )
+    has_device = "pallas_flash" in out or (
+        not cpu_fallback and any(k in out for k in _DEVICE_TIER_KEYS)
+    )
     return has_device, out.get("age_hours", 1e9), out
 
 
@@ -936,12 +948,7 @@ def main() -> None:
     # slow window visible instead of letting it masquerade as a device
     # regression)
     extra["device_feed_probe_gbps"] = _host_probe()
-    if not device_ok:
-        extra["device_unavailable"] = device_note + "; device tiers skipped"
-        harvest = _load_latest_harvest()
-        if harvest:
-            extra["harvest"] = harvest
-    else:
+    def _run_device_tiers():
         for tier_fn, err_key in (
             (lambda: _bench_device_feed(path), "device_feed_error"),
             (lambda: _bench_recordio_sgd(path), "recordio_sgd_error"),
@@ -968,6 +975,31 @@ def main() -> None:
         except Exception as err:
             extra["parity_error"] = str(err)
         extra["device_feed_probe_gbps_post"] = _host_probe()
+
+    if not device_ok:
+        extra["device_unavailable"] = device_note + "; device tiers skipped"
+        harvest = _load_latest_harvest()
+        if harvest:
+            extra["harvest"] = harvest
+        # CPU-backend fallback: the ingest->SGD tiers are meaningful on
+        # the CPU device and belong in the artifact (a dead tunnel must
+        # not erase them). Forcing the platform BEFORE any backend init
+        # is the one safe order — the tunneled plugin HANGS at init, and
+        # env vars are overridden by the runtime's sitecustomize.
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            _run_device_tiers()
+            # amend only once the tiers actually ran — the message must
+            # never claim measurements that don't exist
+            extra["device_unavailable"] = device_note + (
+                "; device tiers measured on the cpu backend"
+            )
+        except Exception as err:
+            extra["device_cpu_fallback_error"] = str(err)
+    else:
+        _run_device_tiers()
 
     sweeps.append(_headline_sweep(path))
     run_host_tier_sweeps()  # tier sweep 2

@@ -792,8 +792,12 @@ def _try_native_cached(
     # row-group binary as framed text chunks
     cache = spec.cache_file + ".rowrec"
     meta_path = cache + ".meta"
-    tmp_tag = ".tmp.%d" % os.getpid()  # concurrent builders must not
-    # interleave writes into one shared tmp; last atomic replace wins
+    import uuid
+
+    # unique per BUILDER (pid alone shares a name across threads of one
+    # process): concurrent builders must not interleave writes into one
+    # shared tmp; last atomic replace wins
+    tmp_tag = ".tmp.%d.%s" % (os.getpid(), uuid.uuid4().hex[:8])
     try:
         sig = {
             "format": "rowrec-v1",
