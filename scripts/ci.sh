@@ -32,7 +32,11 @@ python -m pytest tests/ -q -x
 
 if [ "$MODE" = "full" ]; then
   echo "== bench smoke (one JSON line) =="
-  python bench.py
+  # bound the device probe: CI asserts the bench MACHINERY (one parseable
+  # line, every tier runs), not tunnel availability — the full-patience
+  # probe belongs to driver/harvest runs
+  DMLC_TPU_BENCH_PROBE_ATTEMPTS=1 DMLC_TPU_BENCH_PROBE_TIMEOUT=45 \
+    python bench.py
 fi
 
 echo "CI OK"

@@ -490,3 +490,38 @@ def test_cachefile_routes_native_rowgroup(tmp_path):
     os.utime(path, (now, now))
     rebuilt = collect(uri)
     assert rebuilt[0] == 5001
+
+
+def test_cachefile_concurrent_builders(tmp_path):
+    """Two builders racing on the same uri must both produce correct rows
+    and leave a valid cache (pid+uuid tmp names; last atomic replace
+    wins) — interleaved writes into a shared tmp would corrupt silently."""
+    import threading
+
+    path = tmp_path / "c.svm"
+    with open(path, "w") as fh:
+        for i in range(20000):
+            fh.write(f"{i % 2} {i % 13 + 1}:0.5\n")
+    uri = f"{path}#{tmp_path / 'race.cache'}"
+    results = []
+    errors = []
+
+    def build():
+        try:
+            p = create_parser(uri, 0, 1, nthread=1)
+            results.append(sum(len(b) for b in p))
+            p.close()
+        except Exception as err:  # surfaced below
+            errors.append(err)
+
+    threads = [threading.Thread(target=build) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert results == [20000, 20000], results
+    # the surviving cache replays correctly
+    p = create_parser(uri, 0, 1, nthread=1)
+    assert sum(len(b) for b in p) == 20000
+    p.close()
