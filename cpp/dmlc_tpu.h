@@ -25,7 +25,7 @@
 extern "C" {
 #endif
 
-#define DMLC_TPU_ABI_VERSION 5
+#define DMLC_TPU_ABI_VERSION 6
 
 /* ---- status codes (parsers and pipeline) ------------------------------ */
 enum {
@@ -113,6 +113,18 @@ void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
                   int32_t format, int32_t part, int32_t nparts,
                   int32_t nthread, int64_t chunk_bytes, int32_t capacity,
                   int64_t csv_expect_cols);
+
+/* ingest_open + seeded chunk-shuffled visit order (the reference's
+ * input_split_shuffle.h semantic: sub-splits visited in random order per
+ * epoch, here at chunk granularity). shuffle_seed < 0 = off (identical to
+ * ingest_open). Requires the zero-copy mmap reader (single-file byte
+ * range, local, DMLC_TPU_MMAP != 0): the streaming reader cannot reorder
+ * without deadlocking its bounded queues, so an unsatisfiable request
+ * returns NULL and the caller falls back to its host-side shuffle. */
+void* ingest_open_ex(const char* paths, const int64_t* sizes, int32_t nfiles,
+                     int32_t format, int32_t part, int32_t nparts,
+                     int32_t nthread, int64_t chunk_bytes, int32_t capacity,
+                     int64_t csv_expect_cols, int64_t shuffle_seed);
 void* ingest_open_push(int32_t format, int32_t nthread, int64_t chunk_bytes,
                        int32_t capacity, int64_t csv_expect_cols);
 

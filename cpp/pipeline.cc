@@ -30,7 +30,9 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <random>
 #include <string>
+#include <utility>
 #include <thread>
 #include <vector>
 
@@ -361,7 +363,8 @@ class Pipeline {
  public:
   Pipeline(std::vector<std::string> paths, std::vector<int64_t> sizes,
            int format, int part, int nparts, int nthread, int64_t chunk_bytes,
-           int capacity, int64_t csv_expect_cols, bool push_mode = false)
+           int capacity, int64_t csv_expect_cols, bool push_mode = false,
+           int64_t shuffle_seed = -1)
       : paths_(std::move(paths)),
         sizes_(std::move(sizes)),
         format_(format),
@@ -371,7 +374,8 @@ class Pipeline {
         chunk_bytes_(chunk_bytes < (1 << 16) ? (1 << 16) : chunk_bytes),
         out_capacity_(capacity < 2 ? 2 : capacity),
         csv_expect_cols_(csv_expect_cols),
-        push_mode_(push_mode) {
+        push_mode_(push_mode),
+        shuffle_seed_(shuffle_seed) {
     TuneMallocOnce();
     // DMLC_TPU_BLOCK_POOL=0 opts out (cap 0: every Put declines and
     // blocks free as before) — the A/B lever for measuring the recycle
@@ -1024,7 +1028,18 @@ class Pipeline {
       Fail(kEIo);
       return;
     }
-    if (begin < end && TryMmapReader(begin, end)) return;
+    if (begin >= end) {  // legitimately empty part (no record begins in
+      FinishReader(0);   // its byte window) — zero rows, not an error
+      return;
+    }
+    if (TryMmapReader(begin, end)) return;
+    if (shuffle_seed_ >= 0) {
+      // the caller asked for shuffled visit order and the zero-copy
+      // reader declined (multi-file span, mmap failure): silent
+      // sequential epochs would be a correctness lie for SGD
+      Fail(kEIo);
+      return;
+    }
     if (!rd.SeekGlobal(begin)) {
       Fail(kEIo);
       return;
@@ -1175,42 +1190,91 @@ class Pipeline {
     int64_t pos = begin - file_base;
     const int64_t le = end - file_base;
     int64_t seq = 0;
+    if (shuffle_seed_ < 0) {
+      // sequential: emit each chunk the moment its cut is known — the
+      // boundary probe's page faults overlap parse work, and a stop
+      // (AcquireChunk returning null) ends the scan promptly
+      while (pos < le) {
+        int64_t cut = NextCut(p, pos, le);
+        if (cut > pos) {
+          Chunk* chunk = AcquireChunk();
+          if (chunk == nullptr) {  // stopped
+            FinishReader(seq);
+            return true;
+          }
+          chunk->ext = p + pos;
+          chunk->ext_len = cut - pos;
+          chunk->seq = seq++;
+          if (!PushWork(chunk)) {
+            FinishReader(seq);
+            return true;
+          }
+        }
+        pos = cut;
+      }
+      FinishReader(seq);
+      return true;
+    }
+    // shuffle: phase 1 computes every chunk's [pos, cut) up front
+    // (boundaries are data-deterministic, so a given (file, chunk_bytes)
+    // always yields the same segment list), checking the stop flag so
+    // ingest_close never blocks on a whole-part scan; phase 2 is a
+    // seeded Fisher-Yates over mt19937_64 — the reference's
+    // input_split_shuffle.h semantic (sub-splits visited in seeded
+    // random order per epoch) at chunk granularity. std::shuffle is
+    // implementation-defined; a shuffled EPOCH must be reproducible
+    // from its seed alone. Random-access emission is only possible
+    // here — the streaming reader cannot reorder without deadlocking
+    // its bounded queues (ingest_open_ex refuses such requests).
+    std::vector<std::pair<int64_t, int64_t>> segments;
     while (pos < le) {
-      // same cut discipline as the fread loop: last record begin inside
-      // the window, doubling the window when a record outgrows it
-      int64_t window = chunk_bytes_;
-      int64_t cut;
-      for (;;) {
-        int64_t target = std::min<int64_t>(pos + window, le);
-        if (target >= le) {
-          cut = le;
-          break;
-        }
-        int64_t c = LastRecordBegin(p + pos, target - pos);
-        if (c > 0) {
-          cut = pos + c;
-          break;
-        }
-        window *= 2;
-      }
-      if (cut > pos) {
-        Chunk* chunk = AcquireChunk();
-        if (chunk == nullptr) {  // stopped
-          FinishReader(seq);
-          return true;
-        }
-        chunk->ext = p + pos;
-        chunk->ext_len = cut - pos;
-        chunk->seq = seq++;
-        if (!PushWork(chunk)) {
-          FinishReader(seq);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (stop_ || error_ != 0) {
+          FinishReader(0);
           return true;
         }
       }
+      int64_t cut = NextCut(p, pos, le);
+      if (cut > pos) segments.emplace_back(pos, cut);
       pos = cut;
+    }
+    if (segments.size() > 1) {
+      std::mt19937_64 rng(static_cast<uint64_t>(shuffle_seed_));
+      for (size_t i = segments.size() - 1; i > 0; --i) {
+        size_t j = static_cast<size_t>(rng() % (i + 1));
+        std::swap(segments[i], segments[j]);
+      }
+    }
+    for (const auto& seg : segments) {
+      Chunk* chunk = AcquireChunk();
+      if (chunk == nullptr) {  // stopped
+        FinishReader(seq);
+        return true;
+      }
+      chunk->ext = p + seg.first;
+      chunk->ext_len = seg.second - seg.first;
+      chunk->seq = seq++;
+      if (!PushWork(chunk)) {
+        FinishReader(seq);
+        return true;
+      }
     }
     FinishReader(seq);
     return true;
+  }
+
+  // Next chunk cut in [pos, le): same discipline as the fread loop — last
+  // record begin inside the window, doubling when a record outgrows it.
+  int64_t NextCut(const char* p, int64_t pos, int64_t le) const {
+    int64_t window = chunk_bytes_;
+    for (;;) {
+      int64_t target = std::min<int64_t>(pos + window, le);
+      if (target >= le) return le;
+      int64_t c = LastRecordBegin(p + pos, target - pos);
+      if (c > 0) return pos + c;
+      window *= 2;
+    }
   }
 
   Chunk* AcquireChunk() {
@@ -1565,6 +1629,8 @@ class Pipeline {
   // in-flight bound (out queue + one per worker + staging slack) so a
   // prompt consumer's returns always find room
   std::shared_ptr<BlockPool> pool_ = std::make_shared<BlockPool>();
+  // seeded chunk-shuffle (ingest_open_ex); -1 = sequential
+  const int64_t shuffle_seed_ = -1;
   // zero-copy reader mapping (TryMmapReader); unmapped in Close
   void* map_base_ = nullptr;
   size_t map_len_ = 0;
@@ -1584,12 +1650,19 @@ extern "C" {
 
 // paths: '\0'-joined (nfiles entries); sizes: byte size per file.
 // format: 0=libsvm 1=libfm 2=csv. Returns NULL on bad args.
-void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
-                  int32_t format, int32_t part, int32_t nparts,
-                  int32_t nthread, int64_t chunk_bytes, int32_t capacity,
-                  int64_t csv_expect_cols) {
+void* ingest_open_ex(const char* paths, const int64_t* sizes, int32_t nfiles,
+                     int32_t format, int32_t part, int32_t nparts,
+                     int32_t nthread, int64_t chunk_bytes, int32_t capacity,
+                     int64_t csv_expect_cols, int64_t shuffle_seed) {
   if (nfiles <= 0 || part < 0 || nparts <= 0 || part >= nparts) return nullptr;
   if (format < 0 || format > 3) return nullptr;
+  if (shuffle_seed >= 0) {
+    // shuffled visit order needs the random-access mmap reader: refuse
+    // up front what the reader could only fail at runtime (multi-file
+    // datasets span mappings; DMLC_TPU_MMAP=0 opts the reader out)
+    const char* env = std::getenv("DMLC_TPU_MMAP");
+    if (nfiles != 1 || (env != nullptr && env[0] == '0')) return nullptr;
+  }
   std::vector<std::string> path_vec;
   const char* p = paths;
   for (int32_t i = 0; i < nfiles; ++i) {
@@ -1599,9 +1672,19 @@ void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
   std::vector<int64_t> size_vec(sizes, sizes + nfiles);
   Pipeline* pl =
       new Pipeline(std::move(path_vec), std::move(size_vec), format, part,
-                   nparts, nthread, chunk_bytes, capacity, csv_expect_cols);
+                   nparts, nthread, chunk_bytes, capacity, csv_expect_cols,
+                   /*push_mode=*/false, shuffle_seed);
   pl->Start();
   return pl;
+}
+
+void* ingest_open(const char* paths, const int64_t* sizes, int32_t nfiles,
+                  int32_t format, int32_t part, int32_t nparts,
+                  int32_t nthread, int64_t chunk_bytes, int32_t capacity,
+                  int64_t csv_expect_cols) {
+  return ingest_open_ex(paths, sizes, nfiles, format, part, nparts, nthread,
+                        chunk_bytes, capacity, csv_expect_cols,
+                        /*shuffle_seed=*/-1);
 }
 
 // Push-mode pipeline: no reader thread — the caller streams the partition's

@@ -488,6 +488,7 @@ class NativePipelineParser:
         args: Optional[Dict[str, str]] = None,
         remote_fs=None,
         remote_uris=None,
+        shuffle_seed: int = -1,
     ):
         from dmlc_tpu import native
 
@@ -499,6 +500,7 @@ class NativePipelineParser:
             "recordio": native.INGEST_RECORDIO,
         }[data_format]
         self._open_args = (paths, sizes, part_index, num_parts, nthread)
+        self._shuffle_seed = shuffle_seed
         self._remote_fs = remote_fs
         self._remote_uris = remote_uris
         self._csv_param = None
@@ -520,9 +522,20 @@ class NativePipelineParser:
 
         paths, sizes, part, nparts, nthread = self._open_args
         if self._remote_fs is None:
-            self._pipe = native.IngestPipeline(
-                paths, sizes, self._fmt, part, nparts, nthread=nthread
-            )
+            if self._shuffle_seed >= 0:
+                # shuffle granularity is the chunk: 1 MB chunks give a
+                # ~100MB file >=100 visit-order permutation slots (the
+                # reference's InputSplitShuffle uses 16 sub-splits per
+                # part) at a small throughput cost vs 8 MB chunks
+                self._pipe = native.IngestPipeline(
+                    paths, sizes, self._fmt, part, nparts,
+                    nthread=nthread, chunk_bytes=1 << 20,
+                    shuffle_seed=self._shuffle_seed,
+                )
+            else:
+                self._pipe = native.IngestPipeline(
+                    paths, sizes, self._fmt, part, nparts, nthread=nthread
+                )
             return
         from dmlc_tpu.io.readahead import (
             DEFAULT_CONNECTIONS,
@@ -839,9 +852,12 @@ def _try_native_cached(
                 _json.dump(sig, fh)
             os.replace(meta_path + tmp_tag, meta_path)
         # the cache holds exactly THIS part's rows: serve it whole
+        # (shuffle_chunks applies to the cached epochs as well — the
+        # cache is one local file, the mmap reader's best case)
         return NativePipelineParser(
             [cache], [os.path.getsize(cache)], "recordio", 0, 1,
             nthread=nthread, args=spec.args,
+            shuffle_seed=_shuffle_seed_arg(spec),
         )
     except Exception:
         for tmp in (cache + tmp_tag, meta_path + tmp_tag):
@@ -850,6 +866,26 @@ def _try_native_cached(
             except OSError:
                 pass
         return None
+
+
+def _shuffle_seed_arg(spec: URISpec) -> int:
+    """``?shuffle_chunks=SEED`` URI arg → seed int, or -1 when absent.
+    The native mmap reader visits the part's chunks in seeded random
+    order (input_split_shuffle.h semantics at chunk granularity); the
+    Python stack maps the same request onto InputSplitShuffle. A fresh
+    seed per epoch (caller's choice) gives fresh visit orders; the same
+    seed replays an epoch exactly."""
+    raw = spec.args.get("shuffle_chunks")
+    if raw is None:
+        return -1
+    try:
+        seed = int(raw)
+    except ValueError:
+        raise DMLCError(
+            f"shuffle_chunks must be an integer seed, got {raw!r}"
+        ) from None
+    check(seed >= 0, "shuffle_chunks seed must be >= 0, got %d", seed)
+    return seed
 
 
 def _try_native_pipeline(
@@ -885,13 +921,18 @@ def _try_native_pipeline(
         return None
     local = all(info.path.protocol in ("file://", "") for info in files)
     sizes = [info.size for info in files]
+    shuffle_seed = _shuffle_seed_arg(spec)
     try:
         if local:
             return NativePipelineParser(
                 [info.path.name for info in files], sizes,
                 data_format, part_index, num_parts,
                 nthread=nthread, args=spec.args,
+                shuffle_seed=shuffle_seed,
             )
+        if shuffle_seed >= 0:
+            return None  # remote push path streams sequentially; the
+            # Python stack's InputSplitShuffle takes the request
         # one remote filesystem for the whole dataset
         keys = {(info.path.protocol, info.path.host) for info in files}
         if len(keys) != 1 or any(s <= 0 for s in sizes):
@@ -1006,8 +1047,14 @@ def create_parser(
         )
         if native_parser is not None:
             return native_parser
+    shuffle_seed = _shuffle_seed_arg(spec)
     source = create_input_split(
-        uri, part_index, num_parts, _SPLIT_TYPE.get(data_format, "text")
+        uri, part_index, num_parts, _SPLIT_TYPE.get(data_format, "text"),
+        # the Python stack answers shuffle_chunks with InputSplitShuffle
+        # (sub-split visit order — the same reference semantic the native
+        # mmap reader implements at chunk granularity)
+        num_shuffle_parts=16 if shuffle_seed >= 0 else 0,
+        seed=max(shuffle_seed, 0),
     )
     base = entry(source, spec.args, nthread)
     return ThreadedParser(base) if threaded else base

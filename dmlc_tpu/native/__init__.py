@@ -93,6 +93,12 @@ def _bind(lib) -> None:
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, i64, ctypes.c_int32, i64,
     ]
+    lib.ingest_open_ex.restype = ctypes.c_void_p
+    lib.ingest_open_ex.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, i64, ctypes.c_int32, i64, i64,
+    ]
     lib.ingest_open_push.restype = ctypes.c_void_p
     lib.ingest_open_push.argtypes = [
         ctypes.c_int32, ctypes.c_int32, i64, ctypes.c_int32, i64,
@@ -225,7 +231,7 @@ def _expected_abi_version() -> int:
 
 # the ABI generation _bind's ctypes signatures target; the header is
 # authoritative in a checkout (see _expected_abi_version)
-_BOUND_ABI = 5
+_BOUND_ABI = 6
 _expected_abi = None
 
 
@@ -546,6 +552,7 @@ class IngestPipeline:
         capacity: int = 8,
         csv_expect_cols: int = 0,
         push: bool = False,
+        shuffle_seed: int = -1,
     ):
         lib = get_lib()
         if lib is None:
@@ -564,13 +571,18 @@ class IngestPipeline:
                 for p in paths
             )
             size_arr = np.asarray(sizes, dtype=np.int64)
-            self._handle = lib.ingest_open(
+            self._handle = lib.ingest_open_ex(
                 path_blob, _ptr(size_arr), len(paths),
                 fmt, part, nparts, nthread, chunk_bytes, capacity,
-                csv_expect_cols,
+                csv_expect_cols, shuffle_seed,
             )
         if not self._handle:
-            raise DMLCError("ingest_open failed (bad arguments)")
+            raise DMLCError(
+                "ingest_open failed (bad arguments"
+                + (", or chunk shuffle unavailable for this dataset"
+                   if shuffle_seed >= 0 else "")
+                + ")"
+            )
 
     # ---- push mode (remote ingest feeders) ---------------------------
 

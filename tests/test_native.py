@@ -222,12 +222,13 @@ class TestStaleLibRecovery:
             tmp_so.replace(tmp_path / "libdmlc_tpu.so")
 
         so = str(tmp_path / "libdmlc_tpu.so")
-        build(1)
+        current = native._expected_abi_version()
+        build(current - 1)
         assert native._load(so) is None  # version gate fires
-        build(5)  # "the rebuild" writes a current-ABI lib at the SAME path
+        build(current)  # "the rebuild" writes a current-ABI lib, SAME path
         lib = native._load(so)
         assert lib is not None, "stale dlopen image not released"
-        assert lib.dmlc_tpu_abi_version() == 5
+        assert lib.dmlc_tpu_abi_version() == current
 
 
 def test_abi_version_gate_tracks_header():
