@@ -42,7 +42,17 @@ def main() -> int:
     ap.add_argument("--num-features", type=int, default=0,
                     help="0 = discover from the data (epoch-0 max index + 1)")
     ap.add_argument("--checkpoint-uri", default="")
+    ap.add_argument("--shuffle", type=int, default=None, metavar="SEED",
+                    help="visit each epoch's chunks in seeded random "
+                         "order (?shuffle_chunks=SEED+epoch: fresh "
+                         "permutation per epoch, replayable from SEED)")
     args = ap.parse_args()
+
+    def epoch_uri(epoch: int) -> str:
+        if args.shuffle is None:
+            return args.uri
+        sep = "&" if "?" in args.uri else "?"
+        return f"{args.uri}{sep}shuffle_chunks={args.shuffle + epoch}"
 
     rabit.init()
     rank, world = rabit.rank(), rabit.world_size()
@@ -71,7 +81,7 @@ def main() -> int:
         w = np.zeros(num_features + 1, dtype=np.float64)  # [weights..., bias]
 
     for epoch in range(start_epoch, args.epochs):
-        parser = create_parser(args.uri, rank, world)
+        parser = create_parser(epoch_uri(epoch), rank, world)
         grad = np.zeros_like(w)
         loss = 0.0
         weight_sum = 0.0
