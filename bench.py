@@ -572,14 +572,14 @@ def _bench_device_feed(path: str) -> dict:
     # here at the native recordio rate. Scored like every tier: warmup
     # epoch (the build) dropped, median of warm epochs.
     cache_uri = path + "#" + os.path.join(CACHE_DIR, "higgs_sgd_cache.rec")
-    cparams = init_linear_params(29)
-    cvelocity = {"w": jnp.zeros_like(cparams["w"]),
-                 "b": jnp.zeros_like(cparams["b"])}
+    kparams = init_linear_params(29)
+    kvel = {"w": jnp.zeros_like(kparams["w"]),
+            "b": jnp.zeros_like(kparams["b"])}
     cached_runs = _timed_sgd_epochs(
         lambda: DeviceFeed(
             create_parser(cache_uri, 0, 1, nthread=nthread), spec
         ),
-        size_mb, step, "dense", cparams, cvelocity,
+        size_mb, step, "dense", kparams, kvel,
     )
 
     # sparse path e2e: csr layout (native COO staging) through the csr
@@ -737,18 +737,25 @@ def _harvest_dirs():
     )
 
 
-def _read_json_lines(path, want):
-    """First JSON line in ``path`` for which ``want(obj)`` is truthy."""
+def _json_lines(path):
+    """Parsed JSON objects from a jsonl-ish file (missing/corrupt -> [])."""
+    out = []
     try:
         with open(path) as fh:
             for line in fh:
                 line = line.strip()
                 if line.startswith("{"):
-                    obj = json.loads(line)
-                    if want(obj):
-                        return obj
+                    out.append(json.loads(line))
     except (OSError, ValueError):
         pass
+    return out
+
+
+def _read_json_lines(path, want):
+    """First JSON line in ``path`` for which ``want(obj)`` is truthy."""
+    for obj in _json_lines(path):
+        if want(obj):
+            return obj
     return None
 
 
@@ -796,21 +803,10 @@ def _scan_harvest_dir(d):
             out[key] = record[key]
     if isinstance(record.get("parity"), dict):
         out["parity"] = record["parity"]
-    pallas = os.path.join(d, "pallas_flash.json")
-    if os.path.exists(pallas):
-        rows = []
-        try:
-            with open(pallas) as fh:
-                for line in fh:
-                    line = line.strip()
-                    if line.startswith("{"):
-                        row = json.loads(line)
-                        if "T" in row:
-                            rows.append(row)
-        except (OSError, ValueError):
-            pass
-        if rows:
-            out["pallas_flash"] = rows
+    rows = [r for r in _json_lines(os.path.join(d, "pallas_flash.json"))
+            if "T" in r]
+    if rows:
+        out["pallas_flash"] = rows
     # CPU-fallback records carry the same tier keys on the cpu backend;
     # only an actual accelerator run counts as harvest-worthy device
     # evidence (embedding cpu numbers as "harvested" would defeat the

@@ -782,19 +782,8 @@ def _try_native_cached(
     """
     if data_format != "libsvm":
         return None
-    from dmlc_tpu import native
-
-    if not native.available():
-        return None
-    from dmlc_tpu.io.filesystem import list_split_files
-
-    try:
-        files = list_split_files(spec.uri)
-    except Exception:
-        return None
-    if not files or not all(
-        info.path.protocol in ("file://", "") for info in files
-    ):
+    files = _native_local_files(spec)
+    if files is None:
         return None
     import json as _json
 
@@ -866,6 +855,26 @@ def _try_native_cached(
             except OSError:
                 pass
         return None
+
+
+def _native_local_files(spec: URISpec):
+    """Listable, all-local split files when the native lib is usable, else
+    None — the shared precondition of every native routing decision."""
+    from dmlc_tpu import native
+
+    if not native.available():
+        return None
+    from dmlc_tpu.io.filesystem import list_split_files
+
+    try:
+        files = list_split_files(spec.uri)
+    except Exception:
+        return None
+    if not files or not all(
+        info.path.protocol in ("file://", "") for info in files
+    ):
+        return None
+    return files
 
 
 def _shuffle_seed_arg(spec: URISpec) -> int:
