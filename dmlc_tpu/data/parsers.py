@@ -670,7 +670,8 @@ class NativePipelineParser:
         self, batch_size: int, nnz_bucket=None, nnz_floor: int = 256
     ):
         """→ DeviceCSRBatch or None at end of stream. The nnz bucket is
-        fixed when given, else the power-of-two policy of device/csr.py."""
+        fixed when given, else device/csr.round_up_bucket's
+        sixteenth-octave policy."""
         from dmlc_tpu.device.csr import DeviceCSRBatch, round_up_bucket
 
         staged = self._stage(batch_size)
@@ -697,8 +698,8 @@ class NativePipelineParser:
         nnz_floor: int = 256,
     ):
         """→ ShardedCSRBatch (per-shard entry sections, local row ids) or
-        None at end of stream. Bucket = power-of-two over the max shard
-        nnz unless fixed."""
+        None at end of stream. Bucket = round_up_bucket (sixteenth-octave
+        steps) over the max shard nnz unless fixed."""
         from dmlc_tpu.device.csr import ShardedCSRBatch, round_up_bucket
 
         staged = self._stage(batch_size)
