@@ -85,6 +85,17 @@ class TestDistributedSGDExample:
             losses(multi.stdout + multi.stderr)
         assert ls and ls == lm, (ls, lm)
 
+    def test_shuffle_flag(self, tmp_path):
+        """--shuffle SEED: per-epoch chunk permutations; the epoch losses
+        still compute over every example exactly once (examples= count)."""
+        data = self._write_data(tmp_path)
+        proc = _run([sys.executable,
+                     os.path.join(REPO, "examples/distributed_sgd.py"),
+                     data, "--epochs", "2", "--shuffle", "42"])
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout + proc.stderr
+        assert out.count("examples=400") == 2, out
+
 
 class TestLongContextExample:
     @pytest.mark.parametrize("kv_heads,expect_ulysses", [
