@@ -501,6 +501,7 @@ class NativePipelineParser:
         }[data_format]
         self._open_args = (paths, sizes, part_index, num_parts, nthread)
         self._shuffle_seed = shuffle_seed
+        self._epoch = 0  # advances the shuffle permutation per epoch
         self._remote_fs = remote_fs
         self._remote_uris = remote_uris
         self._csv_param = None
@@ -526,11 +527,16 @@ class NativePipelineParser:
                 # shuffle granularity is the chunk: 1 MB chunks give a
                 # ~100MB file >=100 visit-order permutation slots (the
                 # reference's InputSplitShuffle uses 16 sub-splits per
-                # part) at a small throughput cost vs 8 MB chunks
+                # part) at a small throughput cost vs 8 MB chunks.
+                # seed+epoch: each before_first() visits a FRESH
+                # permutation, regenerated like the reference's per-epoch
+                # reshuffle (indexed_recordio_split.cc BeforeFirst) yet
+                # replayable from the base seed
                 self._pipe = native.IngestPipeline(
                     paths, sizes, self._fmt, part, nparts,
                     nthread=nthread, chunk_bytes=1 << 20,
-                    shuffle_seed=self._shuffle_seed,
+                    shuffle_seed=_mix_epoch_seed(
+                        self._shuffle_seed, self._epoch),
                 )
             else:
                 self._pipe = native.IngestPipeline(
@@ -744,6 +750,7 @@ class NativePipelineParser:
 
     def before_first(self) -> None:
         self._teardown()
+        self._epoch += 1
         self._open()
 
     def close(self) -> None:
@@ -878,13 +885,31 @@ def _native_local_files(spec: URISpec):
     return files
 
 
+def _mix_epoch_seed(seed: int, epoch: int) -> int:
+    """(base seed, epoch) → decorrelated per-epoch seed (splitmix64
+    finalizer, masked non-negative int64). Plain ``seed + epoch`` would
+    make adjacent base seeds share permutation sequences offset by one
+    epoch — correlated "independent" runs."""
+    mask = (1 << 64) - 1
+    x = (seed * 0x9E3779B97F4A7C15 + epoch + 1) & mask
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & mask
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & mask
+    x ^= x >> 31
+    return x & ((1 << 62) - 1)
+
+
 def _shuffle_seed_arg(spec: URISpec) -> int:
     """``?shuffle_chunks=SEED`` URI arg → seed int, or -1 when absent.
     The native mmap reader visits the part's chunks in seeded random
     order (input_split_shuffle.h semantics at chunk granularity); the
-    Python stack maps the same request onto InputSplitShuffle. A fresh
-    seed per epoch (caller's choice) gives fresh visit orders; the same
-    seed replays an epoch exactly."""
+    Python stack maps the same request onto InputSplitShuffle. Both
+    backends regenerate the permutation each epoch (``before_first``
+    advances it, like the reference's per-epoch reshuffle), and the whole
+    epoch sequence is replayable from the one base seed: a fresh parser
+    over the same uri repeats epoch 0, its first ``before_first`` repeats
+    epoch 1, and so on."""
     raw = spec.args.get("shuffle_chunks")
     if raw is None:
         return -1

@@ -603,3 +603,29 @@ def test_shuffle_chunks_empty_parts(tmp_path):
         total += sum(len(b) for b in p)
         p.close()
     assert total == 3
+
+
+def test_shuffle_chunks_reshuffles_per_epoch(tmp_path):
+    """before_first() visits a FRESH permutation (seed+epoch) — the
+    reference regenerates its shuffle every epoch
+    (indexed_recordio_split.cc BeforeFirst); a replayed order would
+    defeat shuffled SGD across epochs. A fresh parser with the same seed
+    still reproduces epoch 0 exactly."""
+    path = tmp_path / "e.svm"
+    with open(path, "w") as fh:
+        for i in range(400000):
+            fh.write(f"{i % 2} 1:{i}.0\n")
+    uri = str(path) + "?shuffle_chunks=7"
+    p = create_parser(uri, 0, 1, nthread=1)
+    e0 = np.concatenate([np.asarray(b.value) for b in p])
+    p.before_first()
+    e1 = np.concatenate([np.asarray(b.value) for b in p])
+    p.close()
+    base = np.arange(400000, dtype=np.float32)
+    assert not np.array_equal(e0, e1)
+    np.testing.assert_array_equal(np.sort(e0), base)
+    np.testing.assert_array_equal(np.sort(e1), base)
+    p2 = create_parser(uri, 0, 1, nthread=1)
+    r0 = np.concatenate([np.asarray(b.value) for b in p2])
+    p2.close()
+    np.testing.assert_array_equal(r0, e0)
