@@ -24,6 +24,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     rc=$?
     echo "[tpu_watch] harvest rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
     if [ $rc -eq 0 ] && [ -s "$OUT/summary.json" ]; then
+      # land the evidence in the repo even if nobody is at the wheel:
+      # copy the harvest into the committed artifacts dir (the location
+      # bench.py's harvest embedding searches last) and commit it
+      mkdir -p "$REPO/artifacts/tpu_sweep"
+      cp "$OUT"/*.json "$REPO/artifacts/tpu_sweep/" 2>> "$LOG" || true
+      ( cd "$REPO" && git add artifacts/tpu_sweep \
+          && git commit -q -m "Add TPU measurement harvest (tpu_measure.py sweep artifacts)" ) \
+          >> "$LOG" 2>&1 || true
       echo "[tpu_watch] DONE" >> "$LOG"
       exit 0
     fi
