@@ -629,3 +629,44 @@ def test_shuffle_chunks_reshuffles_per_epoch(tmp_path):
     r0 = np.concatenate([np.asarray(b.value) for b in p2])
     p2.close()
     np.testing.assert_array_equal(r0, e0)
+
+
+def test_shuffle_chunks_fuzz_cut_discipline(tmp_path):
+    """Property fuzz (fixed rng): ragged rows × adversarial chunk sizes ×
+    seeds — the shuffled emission must preserve the exact multiset of
+    rows the sequential parse yields (a cut-discipline bug would split or
+    duplicate boundary records)."""
+    from dmlc_tpu.native import IngestPipeline
+
+    rng = np.random.RandomState(13)
+    path = tmp_path / "fz.svm"
+    with open(path, "w") as fh:
+        for i in range(30000):
+            nfeat = 1 + int(rng.randint(0, 6))
+            fh.write(f"{i % 2} " + " ".join(
+                f"{int(rng.randint(1, 500))}:{i}.0" for _ in range(nfeat)
+            ) + "\n")
+    size = os.path.getsize(path)
+
+    def collect(seed, chunk_bytes):
+        pipe = IngestPipeline(
+            [str(path)], [size], native.INGEST_LIBSVM, 0, 1,
+            nthread=2, chunk_bytes=chunk_bytes, shuffle_seed=seed,
+        )
+        labels, values = [], []
+        while True:
+            blk = pipe.next_block()
+            if blk is None:
+                break
+            labels.append(np.array(blk["labels"]))
+            values.append(np.array(blk["values"]))
+        pipe.close()
+        return np.concatenate(labels), np.sort(np.concatenate(values))
+
+    base_labels, base_values = collect(-1, 1 << 16)
+    assert len(base_labels) == 30000
+    for seed, chunk in ((3, 1 << 14), (11, 1 << 15), (29, 100_000)):
+        labels, values = collect(seed, chunk)
+        assert len(labels) == 30000, (seed, chunk)
+        np.testing.assert_array_equal(values, base_values)
+        np.testing.assert_array_equal(np.sort(labels), np.sort(base_labels))
