@@ -670,3 +670,30 @@ def test_shuffle_chunks_fuzz_cut_discipline(tmp_path):
         assert len(labels) == 30000, (seed, chunk)
         np.testing.assert_array_equal(values, base_values)
         np.testing.assert_array_equal(np.sort(labels), np.sort(base_labels))
+
+
+def test_device_feed_over_shuffled_uri(tmp_path):
+    """DeviceFeed composes with ?shuffle_chunks: the fixed-shape batch
+    staging consumes shuffled blocks and the epoch still covers every
+    row exactly once (sum of labels is order-invariant)."""
+    import jax
+
+    from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+    path = tmp_path / "f.svm"
+    with open(path, "w") as fh:
+        for i in range(300000):
+            fh.write(f"{i % 2} 1:0.5 2:{i % 7}.0\n")
+    spec = BatchSpec(batch_size=4096, layout="dense", num_features=3)
+    rows = 0
+    label_sum = 0.0
+    feed = DeviceFeed(
+        create_parser(str(path) + "?shuffle_chunks=5", 0, 1, nthread=1),
+        spec,
+    )
+    for batch in feed:
+        rows += batch["num_rows"]
+        label_sum += float(jax.numpy.sum(batch["label"]))
+    feed.close()
+    assert rows == 300000
+    assert label_sum == 150000.0  # every i%2 label seen exactly once
