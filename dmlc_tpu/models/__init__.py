@@ -9,6 +9,9 @@ so this package ships that learner family TPU-natively:
   batches, data-parallel psum gradient sync over a mesh axis
 - ``fm``: factorization machines (the libfm format's model family), embedding
   table sharded or replicated, same segment-sum sparse kernels
+- ``gbdt``: histogram gradient-boosted trees — the xgboost-over-rabit
+  workload the reference backbone was built for, with per-level histogram
+  psum standing in for rabit's allreduce
 """
 
 from dmlc_tpu.models.linear import (
@@ -24,6 +27,14 @@ from dmlc_tpu.models.fm import (
     init_fm_params,
     make_fm_train_step,
 )
+from dmlc_tpu.models.gbdt import (
+    GBDTLearner,
+    GBDTParam,
+    apply_bins,
+    fit_bins,
+    make_tree_builder,
+    predict_trees,
+)
 
 __all__ = [
     "LinearModelParam",
@@ -35,4 +46,10 @@ __all__ = [
     "FMLearner",
     "init_fm_params",
     "make_fm_train_step",
+    "GBDTLearner",
+    "GBDTParam",
+    "apply_bins",
+    "fit_bins",
+    "make_tree_builder",
+    "predict_trees",
 ]

@@ -29,6 +29,18 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       # bench.py's harvest embedding searches last) and commit it
       mkdir -p "$REPO/artifacts/tpu_sweep"
       cp "$OUT"/*.json "$REPO/artifacts/tpu_sweep/" 2>> "$LOG" || true
+      # the harvest's detail_path points into the transient OUT dir; the
+      # committed copy must point at its committed sibling instead
+      python - "$REPO/artifacts/tpu_sweep/bench.json" <<'PYEOF' >> "$LOG" 2>&1 || true
+import json, sys
+path = sys.argv[1]
+with open(path) as fh:
+    doc = json.load(fh)
+if doc.get("extra", {}).get("detail_path"):
+    doc["extra"]["detail_path"] = path.replace("bench.json", "bench_detail.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+PYEOF
       ( cd "$REPO" && git add artifacts/tpu_sweep \
           && git commit -q -m "Add TPU measurement harvest (tpu_measure.py sweep artifacts)" ) \
           >> "$LOG" 2>&1 || true

@@ -194,3 +194,52 @@ class TestCriteoSparseExample:
         )
         assert proc.returncode == 0, proc.stderr[-800:]
         assert "epoch 0" in proc.stdout
+
+
+class TestBoostedTreesExample:
+    def test_synthetic_single_device(self):
+        proc = _run(
+            [sys.executable,
+             os.path.join(REPO, "examples", "boosted_trees.py"),
+             "--synthetic", "--num-trees", "8", "--max-depth", "4"],
+            timeout=280,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "train-acc" in proc.stdout
+
+    def test_mesh_histogram_psum(self):
+        """--dp 8: histograms allreduce across the mesh (rabit's
+        distributed-xgboost pattern) and training still converges."""
+        proc = _run(
+            [sys.executable,
+             os.path.join(REPO, "examples", "boosted_trees.py"),
+             "--synthetic", "--num-trees", "8", "--max-depth", "4",
+             "--dp", "8"],
+            timeout=280,
+            extra_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "histogram psum" in proc.stdout
+
+    def test_libsvm_uri_input(self, tmp_path):
+        """A parser uri feeds the hist-mode materialization path."""
+        svm = tmp_path / "g.svm"
+        rng = np.random.RandomState(9)
+        with open(svm, "w") as fh:
+            for _ in range(2000):
+                vals = rng.rand(6)
+                label = int(vals[0] > 0.5)
+                fh.write("%d %s\n" % (
+                    label,
+                    " ".join(f"{j}:{vals[j]:.4f}" for j in range(6))))
+        proc = _run(
+            [sys.executable,
+             os.path.join(REPO, "examples", "boosted_trees.py"),
+             str(svm), "--num-features", "6",
+             "--num-trees", "10", "--max-depth", "3"],
+            timeout=280,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "train-acc" in proc.stdout

@@ -1,0 +1,232 @@
+"""Histogram GBDT: binning, split finding, boosting, and the mesh
+histogram-psum path (the rabit-for-xgboost allreduce pattern, reference
+tracker/dmlc_tracker/tracker.py:185-252, rebuilt as one psum per level)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dmlc_tpu.models.gbdt import (
+    GBDTLearner,
+    GBDTParam,
+    _find_splits,
+    _level_histogram,
+    apply_bins,
+    fit_bins,
+)
+
+
+def _synthetic(n=4096, f=8, seed=0):
+    """Separable-but-noisy binary problem with axis-aligned structure a
+    depth-limited tree can express."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, f).astype(np.float32)
+    logit = (
+        4.0 * (x[:, 0] > 0.5)
+        + 2.0 * (x[:, 1] > 0.3)
+        - 3.0 * (x[:, 2] > 0.7)
+        - 1.5
+    )
+    y = (rng.rand(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float32)
+    return x, y
+
+
+class TestBinning:
+    def test_apply_matches_searchsorted_and_range(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(500, 5).astype(np.float32)
+        edges = fit_bins(x, num_bins=16)
+        assert edges.shape == (5, 15)
+        assert np.all(np.diff(edges, axis=1) > 0), "edges must increase"
+        got = np.asarray(apply_bins(x, edges))
+        assert got.min() >= 0 and got.max() < 16
+        for f in range(5):
+            want = np.searchsorted(edges[f], x[:, f], side="left")
+            np.testing.assert_array_equal(got[:, f], want)
+
+    def test_constant_feature_is_harmless(self):
+        x = np.ones((100, 2), dtype=np.float32)
+        x[:, 1] = np.arange(100)
+        edges = fit_bins(x, num_bins=8)
+        binned = np.asarray(apply_bins(x, edges))
+        assert binned.shape == (100, 2)
+        # the constant column lands in one bin for every row
+        assert len(np.unique(binned[:, 0])) == 1
+
+
+class TestSplitFinding:
+    def test_known_best_split(self):
+        # one node, 2 features, 4 bins. Feature 1 separates g perfectly at
+        # bin 1 (bins {0,1} have g<0, {2,3} g>0); feature 0 is uniform.
+        ghist = np.zeros((1, 2, 4), dtype=np.float32)
+        hhist = np.ones((1, 2, 4), dtype=np.float32) * 2.0
+        ghist[0, 0] = [1.0, 1.0, 1.0, 1.0]
+        ghist[0, 1] = [-3.0, -3.0, 5.0, 5.0]
+        feature, split_bin, gain, gtot, htot = map(
+            np.asarray,
+            _find_splits(jnp.asarray(ghist), jnp.asarray(hhist),
+                         reg_lambda=1.0, min_child_weight=1.0),
+        )
+        assert feature[0] == 1
+        assert split_bin[0] == 1
+        assert gain[0] > 0
+        assert gtot[0] == pytest.approx(4.0)
+        assert htot[0] == pytest.approx(8.0)
+
+    def test_no_positive_gain_yields_leaf(self):
+        # uniform histograms: no split improves the structure score
+        ghist = jnp.ones((1, 3, 4))
+        hhist = jnp.ones((1, 3, 4))
+        feature, _, gain, _, _ = _find_splits(
+            ghist, hhist, reg_lambda=1.0, min_child_weight=1.0
+        )
+        assert int(feature[0]) == -1
+
+    def test_min_child_weight_masks_thin_children(self):
+        # all hessian mass in bin 3: any cut left of it gives HL == 0
+        ghist = np.zeros((1, 1, 4), dtype=np.float32)
+        hhist = np.zeros((1, 1, 4), dtype=np.float32)
+        ghist[0, 0, 3] = 5.0
+        hhist[0, 0, 3] = 10.0
+        feature, _, _, _, _ = _find_splits(
+            jnp.asarray(ghist), jnp.asarray(hhist),
+            reg_lambda=1.0, min_child_weight=1.0,
+        )
+        assert int(feature[0]) == -1
+
+    def test_histogram_totals_match_inputs(self):
+        rng = np.random.RandomState(2)
+        n, f, bins = 256, 3, 8
+        xb = jnp.asarray(rng.randint(0, bins, size=(n, f)), dtype=jnp.int32)
+        g = jnp.asarray(rng.randn(n).astype(np.float32))
+        h = jnp.asarray(rng.rand(n).astype(np.float32))
+        node = jnp.zeros((n,), dtype=jnp.int32)
+        ghist, hhist = _level_histogram(xb, node, g, h, 1, bins)
+        # every feature's bins partition the same sample set
+        for fi in range(f):
+            assert float(ghist[0, fi].sum()) == pytest.approx(
+                float(g.sum()), rel=1e-5)
+            assert float(hhist[0, fi].sum()) == pytest.approx(
+                float(h.sum()), rel=1e-5)
+
+
+class TestBoosting:
+    def test_loss_decreases_and_fits(self):
+        x, y = _synthetic()
+        learner = GBDTLearner(num_trees=15, max_depth=4, learning_rate=0.5,
+                              num_bins=32)
+        history = learner.fit(x, y)
+        assert len(history) == 15
+        assert history[-1] < history[0] * 0.75, history
+        prob = learner.predict(x)
+        acc = float(np.mean((prob > 0.5) == (y > 0.5)))
+        assert acc > 0.85, acc
+
+    def test_squared_objective(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(2048, 4).astype(np.float32)
+        y = (3.0 * (x[:, 0] > 0.5) + x[:, 1]).astype(np.float32)
+        learner = GBDTLearner(objective="squared", num_trees=20,
+                              max_depth=3, learning_rate=0.4, num_bins=64)
+        history = learner.fit(x, y)
+        assert history[-1] < history[0] * 0.2
+        pred = learner.predict(x)
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        assert rmse < 0.5, rmse
+
+    def test_save_load_round_trip(self, tmp_path):
+        x, y = _synthetic(n=1024)
+        learner = GBDTLearner(num_trees=5, max_depth=3, num_bins=16)
+        learner.fit(x, y)
+        uri = str(tmp_path / "model.bin")
+        learner.save(uri)
+        fresh = GBDTLearner()
+        fresh.load(uri)
+        np.testing.assert_array_equal(fresh.predict(x), learner.predict(x))
+        assert fresh.param.num_trees == 5
+
+    def test_param_validation(self):
+        p = GBDTParam()
+        with pytest.raises(Exception):
+            p.init({"max_depth": 0})
+
+    def test_zero_regularization_stays_finite(self):
+        """reg_lambda=0 + min_child_weight=0: empty children/leaves are
+        0/0 cells — they must select 0, not leak NaN into argmax or
+        predictions (empty leaves are reachable by unseen data)."""
+        x, y = _synthetic(n=512)
+        learner = GBDTLearner(num_trees=5, max_depth=5, learning_rate=0.5,
+                              num_bins=8, reg_lambda=0.0,
+                              min_child_weight=0.0)
+        history = learner.fit(x, y)
+        assert np.all(np.isfinite(history)), history
+        assert np.all(np.isfinite(np.asarray(learner.trees["leaf"])))
+        # trees must actually split (the NaN failure mode collapsed every
+        # node to a leaf-in-place)
+        assert np.any(np.asarray(learner.trees["feature"]) >= 0)
+        probe = np.random.RandomState(99).rand(64, x.shape[1]) \
+            .astype(np.float32)
+        assert np.all(np.isfinite(learner.predict(probe)))
+
+    def test_fit_after_load_rebuilds_for_new_hyperparams(self, tmp_path):
+        """load() restores hyperparameters — a later fit() must not reuse
+        a builder compiled for the previous depth/bins."""
+        x, y = _synthetic(n=512)
+        a = GBDTLearner(num_trees=3, max_depth=6, num_bins=32)
+        a.fit(x, y)
+        uri = str(tmp_path / "shallow.bin")
+        b = GBDTLearner(num_trees=3, max_depth=2, num_bins=8)
+        b.fit(x, y)
+        b.save(uri)
+        a.load(uri)  # a's cached builder is depth-6/32-bin
+        history = a.fit(x, y)
+        assert np.all(np.isfinite(history))
+        # the rebuilt trees obey the RESTORED depth: 2^2-1 internal nodes
+        assert np.asarray(a.trees["feature"]).shape == (3, 3)
+        assert np.all(np.isfinite(a.predict(x)))
+
+
+class TestMeshParity:
+    def test_mesh_matches_single_device(self):
+        """dp=8 histogram-psum build picks the same trees as the
+        single-device build (identical histograms up to summation order →
+        identical argmax splits on well-separated gains → identical
+        predictions up to f32 leaf-value noise)."""
+        from dmlc_tpu.parallel import make_mesh
+
+        x, y = _synthetic(n=2048)
+        single = GBDTLearner(num_trees=8, max_depth=4, learning_rate=0.5,
+                             num_bins=32)
+        h_single = single.fit(x, y)
+
+        mesh = make_mesh({"dp": 8})
+        dist = GBDTLearner(mesh=mesh, num_trees=8, max_depth=4,
+                           learning_rate=0.5, num_bins=32)
+        h_dist = dist.fit(x, y)
+
+        np.testing.assert_array_equal(
+            np.asarray(dist.trees["feature"]),
+            np.asarray(single.trees["feature"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dist.trees["bin"]), np.asarray(single.trees["bin"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist.trees["leaf"]),
+            np.asarray(single.trees["leaf"]), rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(h_dist, h_single, rtol=1e-4)
+        np.testing.assert_allclose(
+            dist.predict(x), single.predict(x), rtol=1e-4, atol=1e-5
+        )
+
+    def test_mesh_requires_divisible_rows(self):
+        from dmlc_tpu.parallel import make_mesh
+        from dmlc_tpu.utils.logging import DMLCError
+
+        mesh = make_mesh({"dp": 8})
+        learner = GBDTLearner(mesh=mesh, num_trees=1)
+        x, y = _synthetic(n=1001)
+        with pytest.raises(DMLCError):
+            learner.fit(x[:1001], y[:1001])
