@@ -710,6 +710,7 @@ _COMPACT_KEYS = (
     "feed_dense_mbps", "sgd_e2e_mbps", "sgd_e2e_cached_mbps",
     "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
     "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",
+    "device_tier_probes_gbps",
     "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
     "socket_note", "psum_single_device_gbps", "psum_step_ms",
     "psum_devices", "psum_platform", "psum_algo_gbps",
@@ -946,15 +947,26 @@ def main() -> None:
     # regression)
     extra["device_feed_probe_gbps"] = _host_probe()
     def _run_device_tiers():
+        # each tier carries the host probe read just before it ran: the
+        # device tiers share this host's core(s) with jax's runtime
+        # threads, and trial spreads of 3-5x (r05 harvests: feed 67.9 vs
+        # 241.2 in ONE tier) are host/tunnel-window noise — the per-tier
+        # probe lets a reader attribute a slow tier to a slow window
+        # instead of a regression
+        tier_probes = {}
         for tier_fn, err_key in (
             (lambda: _bench_device_feed(path), "device_feed_error"),
             (lambda: _bench_recordio_sgd(path), "recordio_sgd_error"),
             (_bench_criteo_sgd, "criteo_sgd_error"),
         ):
+            tier_probes[err_key.replace("_error", "_probe_gbps")] = (
+                _host_probe()
+            )
             try:
                 extra.update(tier_fn())
             except Exception as err:
                 extra[err_key] = str(err)
+        extra["device_tier_probes_gbps"] = tier_probes
         try:
             # chip-vs-CPU-world parity artifact (north star: bit-exact
             # loss parity vs the CPU/MPI path; tools/parity.py documents
