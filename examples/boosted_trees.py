@@ -88,20 +88,11 @@ def main() -> int:
 
     from dmlc_tpu.models.gbdt import GBDTLearner
 
-    if args.synthetic or not args.uri:
-        x, y = _synthetic()
-    else:
-        if args.num_features <= 0:
-            ap.error("--num-features is required with a data uri")
-        x, y = _load_dense(args.uri, args.num_features, 0, 1)
-
     mesh = None
     if args.dp:
         from dmlc_tpu.parallel import make_mesh
 
         mesh = make_mesh({"dp": args.dp})
-        n = (x.shape[0] // args.dp) * args.dp
-        x, y = x[:n], y[:n]
 
     learner = GBDTLearner(
         mesh=mesh,
@@ -110,9 +101,27 @@ def main() -> int:
         learning_rate=args.learning_rate,
         num_bins=args.num_bins,
     )
+    log_every = max(1, args.num_trees // 5)
     t0 = time.time()
-    history = learner.fit(x, y, log_every=max(1, args.num_trees // 5))
-    dt = time.time() - t0
+    if args.synthetic or not args.uri:
+        x, y = _synthetic()
+        if mesh:
+            n = (x.shape[0] // args.dp) * args.dp
+            x, y = x[:n], y[:n]
+        history = learner.fit(x, y, log_every=log_every)
+        dt = time.time() - t0
+    else:
+        if args.num_features <= 0:
+            ap.error("--num-features is required with a data uri")
+        # the streaming path: reservoir-sketch edges, bin block by block —
+        # the dense float matrix never materializes during training
+        # (hist external-memory); under --dp the tail rows that don't
+        # divide the mesh are trimmed, matching the synthetic branch
+        history = learner.fit_uri(args.uri, args.num_features,
+                                  log_every=log_every,
+                                  drop_remainder=bool(mesh))
+        dt = time.time() - t0  # fit only — the eval reload isn't training
+        x, y = _load_dense(args.uri, args.num_features, 0, 1)
     prob = learner.predict(x)
     acc = float(np.mean((prob > 0.5) == (y > 0.5)))
     print(

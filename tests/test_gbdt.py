@@ -187,6 +187,95 @@ class TestBoosting:
         assert np.all(np.isfinite(a.predict(x)))
 
 
+class TestFitUri:
+    def _write_svm(self, path, x, y):
+        with open(path, "w") as fh:
+            for row, label in zip(x, y):
+                fh.write("%d %s\n" % (
+                    int(label),
+                    " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))))
+
+    def test_matches_in_memory_fit_when_sample_covers_all(self, tmp_path):
+        """sample_rows >= N keeps every row in the sketch, so the edges —
+        and therefore every tree — match the in-memory fit exactly."""
+        x, y = _synthetic(n=1024, f=5)
+        svm = tmp_path / "train.svm"
+        self._write_svm(svm, x, y)
+        mem = GBDTLearner(num_trees=6, max_depth=3, num_bins=16)
+        mem.fit(x, y)
+        uri = GBDTLearner(num_trees=6, max_depth=3, num_bins=16)
+        history = uri.fit_uri(str(svm), num_features=5, sample_rows=4096)
+        np.testing.assert_array_equal(
+            np.asarray(uri.trees["feature"]),
+            np.asarray(mem.trees["feature"]))
+        np.testing.assert_array_equal(
+            np.asarray(uri.trees["bin"]), np.asarray(mem.trees["bin"]))
+        np.testing.assert_allclose(
+            np.asarray(uri.trees["leaf"]), np.asarray(mem.trees["leaf"]),
+            rtol=1e-5, atol=1e-6)
+        assert history[-1] < history[0]
+
+    def test_small_reservoir_still_converges(self, tmp_path):
+        """A sketch much smaller than N gives approximate edges but the
+        boosting loop must still fit the signal."""
+        x, y = _synthetic(n=4096, f=6)
+        svm = tmp_path / "big.svm"
+        self._write_svm(svm, x, y)
+        learner = GBDTLearner(num_trees=10, max_depth=4,
+                              learning_rate=0.5, num_bins=16)
+        history = learner.fit_uri(str(svm), num_features=6,
+                                  sample_rows=256)
+        assert history[-1] < history[0] * 0.8
+        prob = learner.predict(x)
+        assert float(np.mean((prob > 0.5) == (y > 0.5))) > 0.8
+
+    def test_mesh_drop_remainder_trims_tail(self, tmp_path):
+        """A uri whose row count doesn't divide the mesh raises by
+        default and trains with drop_remainder=True (tail trimmed)."""
+        from dmlc_tpu.parallel import make_mesh
+        from dmlc_tpu.utils.logging import DMLCError
+
+        x, y = _synthetic(n=1001, f=4)
+        svm = tmp_path / "odd.svm"
+        self._write_svm(svm, x, y)
+        mesh = make_mesh({"dp": 8})
+        strict = GBDTLearner(mesh=mesh, num_trees=2, max_depth=3,
+                             num_bins=8)
+        with pytest.raises(DMLCError):
+            strict.fit_uri(str(svm), num_features=4)
+        lenient = GBDTLearner(mesh=mesh, num_trees=2, max_depth=3,
+                              num_bins=8)
+        history = lenient.fit_uri(str(svm), num_features=4,
+                                  drop_remainder=True)
+        assert np.all(np.isfinite(history))
+
+    def test_binned_matrix_keeps_compact_dtype(self, tmp_path, monkeypatch):
+        """fit_uri must hand the uint8 binned matrix straight to the
+        build (the external-memory saving) — no int32 upcast."""
+        x, y = _synthetic(n=256, f=3)
+        svm = tmp_path / "c.svm"
+        self._write_svm(svm, x, y)
+        learner = GBDTLearner(num_trees=1, max_depth=2, num_bins=16)
+        seen = {}
+        orig = GBDTLearner._fit_binned
+
+        def spy(self, xb, yy, log_every):
+            seen["dtype"] = xb.dtype
+            return orig(self, xb, yy, log_every)
+
+        monkeypatch.setattr(GBDTLearner, "_fit_binned", spy)
+        learner.fit_uri(str(svm), num_features=3)
+        assert seen["dtype"] == np.uint8
+
+    def test_empty_uri_raises(self, tmp_path):
+        from dmlc_tpu.utils.logging import DMLCError
+
+        empty = tmp_path / "empty.svm"
+        empty.write_text("")
+        with pytest.raises(DMLCError):
+            GBDTLearner(num_trees=1).fit_uri(str(empty), num_features=3)
+
+
 class TestMeshParity:
     def test_mesh_matches_single_device(self):
         """dp=8 histogram-psum build picks the same trees as the
