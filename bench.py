@@ -468,6 +468,52 @@ def _bench_criteo_sgd() -> dict:
     }
 
 
+def _bench_gbdt(path: str) -> dict:
+    """Histogram-GBDT boosting rate on the attached device — the
+    xgboost-over-rabit workload (models/gbdt.py) measured per the
+    harness-or-it-didn't-happen bar. Metric = boosted row-visits per
+    second (rows × trees / fit wall; each fit re-bins, a few percent of
+    the wall on this shape): the histogram build (segment-sum + cumsum
+    split finding) dominates, the same profile distributed xgboost
+    allreduces. One learner serves every trial so the warmup fit
+    genuinely absorbs the tree-builder jit compile (fresh learners would
+    recompile per trial and score compile time as throughput)."""
+    import numpy as np
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.models.gbdt import GBDTLearner
+
+    rows_cap = 131_072
+    parser = create_parser(path, 0, 1, nthread=1)
+    xs, ys, seen = [], [], 0
+    try:
+        for block in parser:
+            xs.append(block.to_dense(FEATURES + 1))  # 1-based ids
+            ys.append(np.asarray(block.label, dtype=np.float32))
+            seen += len(block)
+            if seen >= rows_cap:
+                break
+    finally:
+        parser.close()
+    x = np.concatenate(xs)[:rows_cap]
+    y = np.concatenate(ys)[:rows_cap]
+    trees, depth = 8, 6
+    runs = []
+    learner = GBDTLearner(num_trees=trees, max_depth=depth,
+                          learning_rate=0.3, num_bins=64)
+    for _ in range(TRIALS + 1):  # first = jit compile warmup
+        t0 = time.time()
+        history = learner.fit(x, y)
+        dt = time.time() - t0
+        assert np.all(np.isfinite(history)), history
+        runs.append(round(x.shape[0] * trees / dt / 1e6, 2))
+    return {
+        "gbdt_fit_mrows_s": statistics.median(runs[1:]),
+        "gbdt_fit_trials_mrows_s": runs[1:],
+        "gbdt_shape": f"{x.shape[0]}x{x.shape[1]} t{trees} d{depth} b64",
+    }
+
+
 def _bench_recordio_sgd(path: str) -> dict:
     """Recordio row-group → native StageBatch → dense SGD on the attached
     device: the scan-free binary ingest path driven all the way to the
@@ -709,6 +755,7 @@ _COMPACT_KEYS = (
     "criteo_recordio_ingest_mbps", "remote_ingest_mbps",
     "feed_dense_mbps", "sgd_e2e_mbps", "sgd_e2e_cached_mbps",
     "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
+    "gbdt_fit_mrows_s",
     "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",
     "device_tier_probes_gbps",
     "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
@@ -958,6 +1005,7 @@ def main() -> None:
             (lambda: _bench_device_feed(path), "device_feed_error"),
             (lambda: _bench_recordio_sgd(path), "recordio_sgd_error"),
             (_bench_criteo_sgd, "criteo_sgd_error"),
+            (lambda: _bench_gbdt(path), "gbdt_error"),
         ):
             tier_probes[err_key.replace("_error", "_probe_gbps")] = (
                 _host_probe()
