@@ -171,31 +171,15 @@ class DeviceFeed:
 
     def _axis_shards(self) -> int:
         """How many shard sections THIS process builds along the batch
-        axis. Single-process: the full axis extent. Multi-process: only
-        the axis positions this process's devices occupy — each host
-        packs its local batch into its LOCAL shards and
-        ``make_array_from_process_local_data`` concatenates hosts into
-        the global array (packing by the GLOBAL extent instead would
-        interleave half of one host's shard with half of another's on
-        every device — garbage row offsets)."""
+        axis (mesh-geometry logic shared with the GBDT learner —
+        ``parallel.local_axis_shards`` carries the multi-process
+        rationale; getting it wrong interleaves hosts' shards and feeds
+        every device garbage row offsets)."""
         if self._mesh is None:
             return 1
-        if jax.process_count() > 1:
-            axis_idx = self._mesh.axis_names.index(self._axis)
-            local_ids = {d.id for d in jax.local_devices()}
-            arr = self._mesh.devices
-            mask = np.frompyfunc(lambda d: d.id in local_ids, 1, 1)(
-                arr).astype(bool)
-            other = tuple(i for i in range(arr.ndim) if i != axis_idx)
-            shards = int(mask.any(axis=other).sum())
-            check(
-                shards > 0,
-                "mesh holds none of process %d's devices — a feed on "
-                "this process cannot contribute shards",
-                jax.process_index(),
-            )
-            return shards
-        return self._mesh.shape[self._axis]
+        from dmlc_tpu.parallel import local_axis_shards
+
+        return local_axis_shards(self._mesh, self._axis)
 
     # ---- host side: re-batch parser blocks into fixed-size slices ------
     def _use_native_batches(self) -> bool:

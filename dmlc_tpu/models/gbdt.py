@@ -104,6 +104,20 @@ def apply_bins(x, edges):
     return binned.astype(jnp.int32)
 
 
+def _apply_bins_np(x: np.ndarray, edges: np.ndarray,
+                   num_bins: int) -> np.ndarray:
+    """Host-side twin of :func:`apply_bins` in the smallest dtype that
+    holds the ids — for streaming/multi-process paths where the binned
+    matrix is assembled on the host anyway (a device round trip would
+    D2H the matrix right back)."""
+    dt = (np.uint8 if num_bins <= 256
+          else np.uint16 if num_bins <= 65536 else np.int32)
+    out = np.empty(x.shape, dtype=dt)
+    for f in range(x.shape[1]):
+        out[:, f] = np.searchsorted(edges[f], x[:, f], side="left")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # gradients
 # ---------------------------------------------------------------------------
@@ -326,29 +340,97 @@ class GBDTLearner:
         self.edges: Optional[np.ndarray] = None
         self.trees: Optional[Dict] = None
         self._builder = None
+        self._engine = None  # multi-process row-count sync, lazy
 
     # ---- fit -----------------------------------------------------------
+    def _local_shards(self) -> int:
+        """Shard sections THIS process's rows divide over along the axis
+        (one shared implementation: ``parallel.local_axis_shards``)."""
+        from dmlc_tpu.parallel import local_axis_shards
+
+        return local_axis_shards(self.mesh, self.axis)
+
     def _check_divisible(self, n: int) -> None:
         if self.mesh is None:
             return
-        world = int(np.prod([self.mesh.shape[a] for a in
-                             ([self.axis] if isinstance(self.axis, str)
-                              else self.axis)]))
-        check(n % world == 0,
-              "N %d must divide the mesh axis extent %d "
-              "(pad or trim the training set)", n, world)
+        shards = self._local_shards()
+        check(n % shards == 0,
+              "N %d (this process's rows) must divide its %d mesh shards "
+              "(pad or trim the training set)", n, shards)
 
-    def fit(self, x: np.ndarray, y: np.ndarray, log_every: int = 0):
+    def _check_edges(self, num_features: int) -> None:
+        """User-supplied edges must match (F, num_bins-1): oversize bin
+        ids would walk off the end of the segment key space and
+        segment_sum SILENTLY drops out-of-range updates — wrong splits
+        with no error (the failure mode this check converts into one)."""
+        want = (num_features, self.param.num_bins - 1)
+        check(self.edges.shape == want,
+              "edges shape %s does not match (num_features, num_bins-1) "
+              "= %s", self.edges.shape, want)
+
+    def _sync_row_count(self, n_local: int, trim: bool) -> int:
+        """Multi-process row-count agreement: ``make_array_from_process_
+        local_data`` infers the global shape ASSUMING every process
+        contributes equally — ragged counts produce divergent global
+        shapes across processes and the level-psum hangs or crashes
+        instead of erroring. One tiny allreduce makes ragged input either
+        a clean trim (``trim=True``: everyone cuts to the global-min
+        multiple of their shards) or a clean error."""
+        if self.mesh is None or jax.process_count() <= 1:
+            return n_local
+        if self._engine is None:
+            from dmlc_tpu.collective.device import DeviceEngine
+
+            self._engine = DeviceEngine(self.mesh)  # cached: keeps the
+            # engine's jitted reduction across fits
+        shards = self._local_shards()
+        usable = (n_local // shards) * shards if trim else n_local
+        # one allreduce carries both bounds: min(x) and min(-x) = -max(x)
+        lo, neg_hi = (int(v) for v in self._engine.allreduce(
+            np.array([usable, -usable]), op="min"))
+        if trim:
+            return lo
+        check(lo == -neg_hi,
+              "processes hold unequal row counts (%d..%d); global "
+              "assembly requires equal local N — trim (fit_uri: "
+              "drop_remainder=True) or pad", lo, -neg_hi)
+        return n_local
+
+    def fit(self, x: np.ndarray, y: np.ndarray, log_every: int = 0,
+            edges: Optional[np.ndarray] = None):
         """Train on an in-memory dense [N, F] float matrix. Returns the
         per-tree mean training loss history (evaluated pre-update, so
-        entry 0 is the base-margin loss)."""
+        entry 0 is the base-margin loss).
+
+        Multi-process meshes: ``x``/``y`` are this process's LOCAL rows,
+        and every process must pass IDENTICAL ``edges`` (bin boundaries
+        are the one piece of global state the histogram psum assumes —
+        the reference stack's analog is rabit allreducing xgboost's
+        quantile sketches; compute them from a shared sample, or on rank
+        0 and broadcast via the collective engine).
+        """
         p = self.param
         x = np.asarray(x, dtype=np.float32)
         y = np.asarray(y, dtype=np.float32)
         check(x.ndim == 2 and y.shape == (x.shape[0],),
               "fit expects x [N, F], y [N]")
+        multiprocess = self.mesh is not None and jax.process_count() > 1
+        if multiprocess:
+            check(edges is not None,
+                  "multi-process fit requires shared edges= (per-host "
+                  "quantiles would bin the same value differently)")
+            self._sync_row_count(x.shape[0], trim=False)
         self._check_divisible(x.shape[0])
-        self.edges = fit_bins(x, p.num_bins)
+        if edges is not None:
+            self.edges = np.asarray(edges, dtype=np.float32)
+            self._check_edges(x.shape[1])
+        else:
+            self.edges = fit_bins(x, p.num_bins)
+        if multiprocess:
+            # bin on host: the global assembly consumes host arrays, so
+            # device apply_bins would D2H the matrix straight back
+            return self._fit_binned(
+                _apply_bins_np(x, self.edges, p.num_bins), y, log_every)
         # apply_bins already lives on device; _fit_binned's jnp.asarray
         # is a no-op there (a np.asarray round trip would D2H+H2D the
         # whole matrix for nothing)
@@ -363,6 +445,7 @@ class GBDTLearner:
         sample_rows: int = 1 << 16,
         log_every: int = 0,
         drop_remainder: bool = False,
+        edges: Optional[np.ndarray] = None,
     ):
         """Train from any parser uri (LibSVM text, RecordIO row groups,
         ``#cachefile``, object store) without materializing the dense
@@ -382,50 +465,62 @@ class GBDTLearner:
         Under a mesh, ``drop_remainder=True`` trims the tail rows that
         don't divide the axis extent (a uri's row count is unknown up
         front); the default raises instead of silently dropping data.
+        Multi-process: each process parses its own part AND must receive
+        identical ``edges=`` (see ``fit``) — passing them also skips the
+        sketch pass entirely.
         """
         from dmlc_tpu.data import create_parser
 
         p = self.param
         check(num_features > 0, "fit_uri requires num_features")
+        if self.mesh is not None and jax.process_count() > 1:
+            check(edges is not None,
+                  "multi-process fit_uri requires shared edges= (per-host "
+                  "sketches would bin the same value differently)")
         parser = create_parser(uri, part_index, num_parts)
         try:
-            # pass 1: reservoir sample for edges
-            rng = np.random.RandomState(p.num_bins * 7919 + 13)
-            reservoir = np.empty((sample_rows, num_features),
-                                 dtype=np.float32)
-            seen = 0
-            for block in parser:
-                dense = block.to_dense(num_features)
-                n = len(dense)
-                gidx = np.arange(seen, seen + n)
-                take_direct = gidx < sample_rows
-                reservoir[gidx[take_direct]] = dense[take_direct]
-                rest = ~take_direct
-                if rest.any():
-                    draws = (rng.random_sample(int(rest.sum()))
-                             * (gidx[rest] + 1)).astype(np.int64)
-                    hit = draws < sample_rows
-                    reservoir[draws[hit]] = dense[rest][hit]
-                seen += n
-            check(seen > 0, "uri produced no rows: %s", uri)
-            self.edges = fit_bins(reservoir[:min(seen, sample_rows)],
-                                  p.num_bins)
+            if edges is not None:
+                self.edges = np.asarray(edges, dtype=np.float32)
+                self._check_edges(num_features)
+            else:
+                # pass 1: reservoir sample for edges
+                rng = np.random.RandomState(p.num_bins * 7919 + 13)
+                reservoir = np.empty((sample_rows, num_features),
+                                     dtype=np.float32)
+                seen = 0
+                for block in parser:
+                    dense = block.to_dense(num_features)
+                    n = len(dense)
+                    gidx = np.arange(seen, seen + n)
+                    take_direct = gidx < sample_rows
+                    reservoir[gidx[take_direct]] = dense[take_direct]
+                    rest = ~take_direct
+                    if rest.any():
+                        draws = (rng.random_sample(int(rest.sum()))
+                                 * (gidx[rest] + 1)).astype(np.int64)
+                        hit = draws < sample_rows
+                        reservoir[draws[hit]] = dense[rest][hit]
+                    seen += n
+                check(seen > 0, "uri produced no rows: %s", uri)
+                self.edges = fit_bins(reservoir[:min(seen, sample_rows)],
+                                      p.num_bins)
             # pass 2: stream + bin on the host (no device chatter per
-            # block); smallest dtype that holds num_bins ids
-            dt = (np.uint8 if p.num_bins <= 256
-                  else np.uint16 if p.num_bins <= 65536 else np.int32)
+            # block)
             parser.before_first()
             xb_parts, y_parts = [], []
             for block in parser:
                 dense = block.to_dense(num_features)
-                binned = np.empty(dense.shape, dtype=dt)
-                for f in range(num_features):
-                    binned[:, f] = np.searchsorted(
-                        self.edges[f], dense[:, f], side="left")
-                xb_parts.append(binned)
+                xb_parts.append(
+                    _apply_bins_np(dense, self.edges, p.num_bins))
                 y_parts.append(np.asarray(block.label, dtype=np.float32))
         finally:
             parser.close()
+        # both branches must fail cleanly on a rowless uri/part (a
+        # byte-split part of a small file can legitimately be empty; on a
+        # mesh, dying in np.concatenate would strand the other processes
+        # in the row-count collective)
+        check(xb_parts, "uri produced no rows: %s (part %d/%d)",
+              uri, part_index, num_parts)
         # keep the compact dtype — _level_histogram widens bin ids into
         # the (int32/int64) segment key itself, so upcasting here would
         # re-materialize the float-matrix-sized array fit_uri exists to
@@ -433,11 +528,15 @@ class GBDTLearner:
         xb = np.concatenate(xb_parts)
         y = np.concatenate(y_parts)
         if drop_remainder and self.mesh is not None:
-            world = int(np.prod([self.mesh.shape[a] for a in
-                                 ([self.axis] if isinstance(self.axis, str)
-                                  else self.axis)]))
-            n = (xb.shape[0] // world) * world
+            shards = self._local_shards()
+            # equalize ACROSS processes too: global assembly assumes every
+            # process contributes the same local N (ragged InputSplit
+            # parts are the norm, not the exception)
+            n = self._sync_row_count((xb.shape[0] // shards) * shards,
+                                     trim=True)
             xb, y = xb[:n], y[:n]
+        else:
+            self._sync_row_count(xb.shape[0], trim=False)
         self._check_divisible(xb.shape[0])
         return self._fit_binned(xb, y, log_every)
 
@@ -445,13 +544,24 @@ class GBDTLearner:
         from dmlc_tpu.utils.logging import log_info
 
         p = self.param
-        xb = jnp.asarray(xb)
-        yd = jnp.asarray(y)
-        if self.mesh is not None:
+        if self.mesh is not None and jax.process_count() > 1:
+            # each process contributes its local rows; the global array
+            # spans the world (DeviceFeed._put_tree's multi-host shape)
             shard = NamedSharding(self.mesh, P(self.axis))
-            xb = jax.device_put(xb, shard)
-            yd = jax.device_put(yd, shard)
-        margin = jnp.zeros_like(yd)
+            xb_np = np.asarray(xb)
+            y_np = np.asarray(y, dtype=np.float32)
+            xb = jax.make_array_from_process_local_data(shard, xb_np)
+            yd = jax.make_array_from_process_local_data(shard, y_np)
+            margin = jax.make_array_from_process_local_data(
+                shard, np.zeros(len(y_np), dtype=np.float32))
+        else:
+            xb = jnp.asarray(xb)
+            yd = jnp.asarray(y)
+            if self.mesh is not None:
+                shard = NamedSharding(self.mesh, P(self.axis))
+                xb = jax.device_put(xb, shard)
+                yd = jax.device_put(yd, shard)
+            margin = jnp.zeros_like(yd)
         if self._builder is None:
             self._builder = make_tree_builder(
                 p.max_depth, p.num_bins, p.reg_lambda,

@@ -15,6 +15,7 @@ from dmlc_tpu.parallel.mesh import (
     batch_sharding,
     replicated_sharding,
     mesh_rank_info,
+    local_axis_shards,
 )
 
 __all__ = [
@@ -25,4 +26,5 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "mesh_rank_info",
+    "local_axis_shards",
 ]

@@ -163,6 +163,41 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def local_axis_shards(mesh: Mesh, axes) -> int:
+    """How many shard sections THIS process's data divides over along
+    ``axes`` (a name or list of names) when the leading dimension is
+    sharded ``P(axes)``.
+
+    Single-process: the full axis extent (product over ``axes``).
+    Multi-process: only the axis positions this process's devices occupy —
+    each host packs its local rows into its LOCAL shards and
+    ``make_array_from_process_local_data`` concatenates hosts into the
+    global array (packing by the GLOBAL extent instead would interleave
+    half of one host's shard with half of another's on every device).
+    Shared by DeviceFeed and the GBDT learner — one copy of the
+    mesh-geometry subtlety.
+    """
+    from dmlc_tpu.utils.logging import check
+
+    axes = [axes] if isinstance(axes, str) else list(axes)
+    if jax.process_count() <= 1:
+        return int(np.prod([mesh.shape[a] for a in axes]))
+    arr = mesh.devices
+    local_ids = {d.id for d in jax.local_devices()}
+    mask = np.frompyfunc(lambda d: d.id in local_ids, 1, 1)(
+        arr).astype(bool)
+    axis_idxs = [mesh.axis_names.index(a) for a in axes]
+    other = tuple(i for i in range(arr.ndim) if i not in axis_idxs)
+    shards = int(mask.any(axis=other).sum()) if other else int(mask.sum())
+    check(
+        shards > 0,
+        "mesh holds none of process %d's devices — this process cannot "
+        "contribute shards",
+        jax.process_index(),
+    )
+    return shards
+
+
 def mesh_rank_info() -> Dict[str, int]:
     """The DMLC_* style rank/world bookkeeping, sourced from JAX.
 

@@ -274,6 +274,39 @@ class TestFitUri:
         empty.write_text("")
         with pytest.raises(DMLCError):
             GBDTLearner(num_trees=1).fit_uri(str(empty), num_features=3)
+        # the edges-given branch skips the sketch pass but must fail the
+        # same way (not an opaque np.concatenate ValueError)
+        edges = fit_bins(np.random.RandomState(0).rand(64, 3), 8)
+        with pytest.raises(DMLCError):
+            GBDTLearner(num_trees=1, num_bins=8).fit_uri(
+                str(empty), num_features=3, edges=edges)
+
+    def test_mismatched_edges_shape_raises(self, tmp_path):
+        """edges from a different (F, num_bins) must error loudly —
+        oversize bin ids would silently fall out of the segment key
+        space and corrupt every histogram."""
+        from dmlc_tpu.utils.logging import DMLCError
+
+        x, y = _synthetic(n=256, f=4)
+        wrong_bins = fit_bins(x, 32)  # learner expects 8
+        with pytest.raises(DMLCError):
+            GBDTLearner(num_trees=1, num_bins=8).fit(x, y,
+                                                     edges=wrong_bins)
+        wrong_feats = fit_bins(x[:, :3], 8)
+        with pytest.raises(DMLCError):
+            GBDTLearner(num_trees=1, num_bins=8).fit(x, y,
+                                                     edges=wrong_feats)
+        svm = tmp_path / "e.svm"
+        self._write_svm(svm, x, y)
+        with pytest.raises(DMLCError):
+            GBDTLearner(num_trees=1, num_bins=8).fit_uri(
+                str(svm), num_features=4, edges=wrong_bins)
+
+    def test_matching_edges_accepted(self):
+        x, y = _synthetic(n=256, f=4)
+        learner = GBDTLearner(num_trees=2, max_depth=2, num_bins=8)
+        history = learner.fit(x, y, edges=fit_bins(x, 8))
+        assert np.all(np.isfinite(history))
 
 
 class TestMeshParity:

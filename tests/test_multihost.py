@@ -136,6 +136,61 @@ print("RESULT rank=%d losses=%s" % (
 '''
 
 
+GBDT_BODY = r'''
+import numpy as np
+
+from dmlc_tpu.models.gbdt import GBDTLearner, fit_bins
+from dmlc_tpu.parallel import data_parallel_mesh
+
+mesh = data_parallel_mesh()  # GLOBAL: 4 devices across 2 processes
+assert jax.process_count() == world
+
+# both ranks generate the FULL dataset from one seed; each fits on its
+# own half — shared edges from the full matrix stand in for the
+# rabit-synced quantile sketch (models/gbdt.fit docstring)
+rng = np.random.RandomState(17)
+N, F = 1024, 6
+x = rng.rand(N, F).astype(np.float32)
+y = ((x[:, 0] > 0.5) | (x[:, 1] > 0.8)).astype(np.float32)
+edges = fit_bins(x, 16)
+half = N // world
+lo, hi = rank * half, (rank + 1) * half
+
+learner = GBDTLearner(mesh=mesh, num_trees=4, max_depth=3,
+                      learning_rate=0.5, num_bins=16)
+history = learner.fit(x[lo:hi], y[lo:hi], edges=edges)
+feat = ",".join(str(int(v)) for v in
+                np.asarray(learner.trees["feature"]).ravel())
+bins = ",".join(str(int(v)) for v in
+                np.asarray(learner.trees["bin"]).ravel())
+leaf_sum = float(np.abs(np.asarray(learner.trees["leaf"])).sum())
+
+# ragged InputSplit parts (byte-split text -> unequal rows per part):
+# fit_uri with drop_remainder must equalize local counts ACROSS processes
+# (the _sync_row_count min-allreduce) — divergent inferred global shapes
+# would hang the level psum. Shared edges from the full file on each rank.
+uri = sys.argv[4]
+r2 = GBDTLearner(mesh=mesh, num_trees=3, max_depth=3,
+                 learning_rate=0.5, num_bins=16)
+# rank-identical edges: sketch over the WHOLE file (part 0/1)
+from dmlc_tpu.data import create_parser
+blocks = []
+parser = create_parser(uri, 0, 1)
+for blk in parser:
+    blocks.append(blk.to_dense(6))
+parser.close()
+full_edges = fit_bins(np.concatenate(blocks), 16)
+h2 = r2.fit_uri(uri, num_features=6, part_index=rank, num_parts=world,
+                edges=full_edges, drop_remainder=True)
+feat2 = ",".join(str(int(v)) for v in
+                 np.asarray(r2.trees["feature"]).ravel())
+assert all(np.isfinite(h2)), h2
+print("RESULT rank=%d losses=%s feat=%s bins=%s leafsum=%.8f ragged=%s"
+      % (rank, ",".join("%.8f" % v for v in history), feat, bins,
+         leaf_sum, feat2), flush=True)
+'''
+
+
 def _launch_workers(tmp_path, body: str, port: str, extra_args=(),
                     world: int = 2, timeout: int = 300):
     """Run the PREAMBLE+body worker in ``world`` processes → list of
@@ -454,6 +509,54 @@ def test_two_process_mesh_trains_and_agrees(tmp_path, layout, port):
     # alone is not correctness)
     oracle = _oracle_losses(uri, world, layout, feats)
     np.testing.assert_allclose(losses, oracle, rtol=2e-5)
+
+
+@pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
+                    reason="multihost tier disabled")
+def test_gbdt_histogram_psum_across_processes(tmp_path):
+    """The distributed-xgboost shape: each process holds a row shard,
+    per-level (grad, hess) histograms cross processes in one psum, and
+    every process must end with the single-process oracle's trees."""
+    rng = np.random.RandomState(31)
+    uri = tmp_path / "ragged.svm"
+    with open(uri, "w") as fh:
+        for _ in range(1003):  # odd count -> byte-ragged parts
+            vals = rng.rand(6)
+            fh.write("%d %s\n" % (int(vals[0] > 0.5), " ".join(
+                f"{j}:{vals[j]:.5f}" for j in range(6))))
+    outs = _launch_workers(tmp_path, GBDT_BODY, _free_port(),
+                           extra_args=(uri,))
+    results = {}
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if "RESULT" in ln)
+        kv = dict(item.split("=", 1) for item in line.split()[1:])
+        results[int(kv["rank"])] = kv
+    # replicated model state: both processes hold identical trees —
+    # including the ragged-parts fit_uri run (unequal local rows
+    # min-allreduce-trimmed before global assembly)
+    for key in ("losses", "feat", "bins", "leafsum", "ragged"):
+        assert results[0][key] == results[1][key], (key, results)
+    # oracle: the same full dataset fit single-process with the same edges
+    from dmlc_tpu.models.gbdt import GBDTLearner, fit_bins
+
+    rng = np.random.RandomState(17)
+    N, F = 1024, 6
+    x = rng.rand(N, F).astype(np.float32)
+    y = ((x[:, 0] > 0.5) | (x[:, 1] > 0.8)).astype(np.float32)
+    oracle = GBDTLearner(num_trees=4, max_depth=3, learning_rate=0.5,
+                         num_bins=16)
+    oracle_hist = oracle.fit(x, y, edges=fit_bins(x, 16))
+    want_feat = ",".join(str(int(v)) for v in
+                         np.asarray(oracle.trees["feature"]).ravel())
+    want_bins = ",".join(str(int(v)) for v in
+                         np.asarray(oracle.trees["bin"]).ravel())
+    assert results[0]["feat"] == want_feat
+    assert results[0]["bins"] == want_bins
+    got_losses = [float(v) for v in results[0]["losses"].split(",")]
+    np.testing.assert_allclose(got_losses, oracle_hist, rtol=2e-5)
+    np.testing.assert_allclose(
+        float(results[0]["leafsum"]),
+        float(np.abs(np.asarray(oracle.trees["leaf"])).sum()), rtol=2e-5)
 
 
 RECOVERY_WORKER = r'''
