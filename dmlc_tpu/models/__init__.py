@@ -32,6 +32,7 @@ from dmlc_tpu.models.gbdt import (
     GBDTParam,
     apply_bins,
     fit_bins,
+    make_forest_builder,
     make_tree_builder,
     predict_trees,
 )
@@ -50,6 +51,7 @@ __all__ = [
     "GBDTParam",
     "apply_bins",
     "fit_bins",
+    "make_forest_builder",
     "make_tree_builder",
     "predict_trees",
 ]

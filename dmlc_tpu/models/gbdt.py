@@ -141,6 +141,21 @@ def _loss(objective: str, margin, label):
     return 0.5 * (margin - label) ** 2
 
 
+def _grad_loss_core(objective: str, margin, y, psum_axis):
+    """(g, h, mean loss) for one boosting round — the ONE definition both
+    the fused-scan and per-tree-loop paths trace (like _build_tree_core:
+    a change here cannot diverge the two paths' models)."""
+    g, h = _grad_hess(objective, margin, y)
+    loss = jnp.mean(_loss(objective, margin, y))
+    if psum_axis is not None:
+        loss = jax.lax.pmean(loss, psum_axis)
+    return g, h, loss
+
+
+def _margin_update_core(margin, leaf, node, learning_rate):
+    return margin + learning_rate * jnp.take(leaf, node)
+
+
 # ---------------------------------------------------------------------------
 # one tree, level by level (all static shapes)
 # ---------------------------------------------------------------------------
@@ -213,6 +228,62 @@ def _find_splits(ghist, hhist, reg_lambda, min_child_weight):
     return feature, split_bin, best_gain, gtot, htot
 
 
+def _build_tree_core(xb, g, h, max_depth, num_bins, reg_lambda,
+                     min_child_weight, psum_axis=None):
+    """One tree, level by level, all static shapes; traceable inside jit,
+    shard_map, AND lax.scan (no Python-level data dependence).
+
+    Tree encoding (complete binary tree, n_internal = 2^D − 1 internal
+    nodes then 2^D leaves): ``feature``/``bin`` [n_internal] (−1 = the
+    node is a leaf-in-place: descent keeps every sample left so the
+    subtree collapses to its leftmost leaf), ``leaf`` [2^D] f32 leaf
+    values (−G/(H+λ), already learning-rate-free).
+
+    With ``psum_axis``: xb/g/h are per-shard local; each level does local
+    segment-sums and ONE psum of the stacked (g, h) histogram — the rabit
+    allreduce. Everything after the psum is shard-invariant.
+    """
+    n_leaves = 1 << max_depth
+    n = xb.shape[0]
+    node = jnp.zeros((n,), dtype=jnp.int32)  # id within current level
+    feats, bins = [], []
+    for depth in range(max_depth):
+        n_nodes = 1 << depth
+        ghist, hhist = _level_histogram(xb, node, g, h, n_nodes, num_bins)
+        if psum_axis is not None:
+            ghist, hhist = jax.lax.psum((ghist, hhist),
+                                        axis_name=psum_axis)
+        feature, split_bin, _gain, _gt, _ht = _find_splits(
+            ghist, hhist, reg_lambda, min_child_weight
+        )
+        feats.append(feature)
+        bins.append(split_bin)
+        # descend: right iff this sample's bin at the split feature
+        # exceeds the threshold; leaf-in-place nodes send all left
+        nfeat = jnp.take(feature, node)  # [N]
+        nbin = jnp.take(split_bin, node)
+        fval = jnp.take_along_axis(
+            xb, jnp.maximum(nfeat, 0)[:, None], axis=1
+        )[:, 0]
+        go_right = (nfeat >= 0) & (fval > nbin)
+        node = node * 2 + go_right.astype(jnp.int32)
+    # leaf values from the last level's (G, H) per leaf
+    gleaf = jax.ops.segment_sum(g, node, num_segments=n_leaves)
+    hleaf = jax.ops.segment_sum(h, node, num_segments=n_leaves)
+    if psum_axis is not None:
+        gleaf, hleaf = jax.lax.psum((gleaf, hleaf), axis_name=psum_axis)
+    # empty leaves at reg_lambda=0 are 0/0: emit 0 — unseen data can
+    # route there at predict time and must not read NaN
+    denom = hleaf + reg_lambda
+    leaf = jnp.where(denom > 0.0, -gleaf / denom, 0.0)
+    return (
+        jnp.concatenate(feats),
+        jnp.concatenate(bins),
+        leaf,
+        node,
+    )
+
+
 def make_tree_builder(
     max_depth: int,
     num_bins: int,
@@ -223,58 +294,12 @@ def make_tree_builder(
 ):
     """Jitted (xb, g, h) → tree arrays; the level loop is unrolled (depth
     is a compile-time constant, ≤ 12), so one jit covers the whole build.
-
-    Tree encoding (complete binary tree, n_internal = 2^D − 1 internal
-    nodes then 2^D leaves): ``feature``/``bin`` [n_internal] (−1 = the
-    node is a leaf-in-place: descent keeps every sample left so the
-    subtree collapses to its leftmost leaf), ``leaf`` [2^D] f32 leaf
-    values (−G/(H+λ), already learning-rate-free).
-
-    Under a mesh: xb/g/h are consumed sharded over ``axis``; each level
-    does local segment-sums and ONE psum of the stacked (g, h) histogram —
-    the rabit allreduce. Everything after the psum is shard-invariant.
-    """
-    n_leaves = 1 << max_depth
+    See :func:`_build_tree_core` for the encoding and mesh semantics."""
 
     def _build(xb, g, h):
-        n = xb.shape[0]
-        node = jnp.zeros((n,), dtype=jnp.int32)  # id within current level
-        feats, bins = [], []
-        for depth in range(max_depth):
-            n_nodes = 1 << depth
-            ghist, hhist = _level_histogram(
-                xb, node, g, h, n_nodes, num_bins
-            )
-            if mesh is not None:
-                ghist, hhist = jax.lax.psum((ghist, hhist), axis_name=axis)
-            feature, split_bin, _gain, _gt, _ht = _find_splits(
-                ghist, hhist, reg_lambda, min_child_weight
-            )
-            feats.append(feature)
-            bins.append(split_bin)
-            # descend: right iff this sample's bin at the split feature
-            # exceeds the threshold; leaf-in-place nodes send all left
-            nfeat = jnp.take(feature, node)  # [N]
-            nbin = jnp.take(split_bin, node)
-            fval = jnp.take_along_axis(
-                xb, jnp.maximum(nfeat, 0)[:, None], axis=1
-            )[:, 0]
-            go_right = (nfeat >= 0) & (fval > nbin)
-            node = node * 2 + go_right.astype(jnp.int32)
-        # leaf values from the last level's (G, H) per leaf
-        gleaf = jax.ops.segment_sum(g, node, num_segments=n_leaves)
-        hleaf = jax.ops.segment_sum(h, node, num_segments=n_leaves)
-        if mesh is not None:
-            gleaf, hleaf = jax.lax.psum((gleaf, hleaf), axis_name=axis)
-        # empty leaves at reg_lambda=0 are 0/0: emit 0 — unseen data can
-        # route there at predict time and must not read NaN
-        denom = hleaf + reg_lambda
-        leaf = jnp.where(denom > 0.0, -gleaf / denom, 0.0)
-        return (
-            jnp.concatenate(feats),
-            jnp.concatenate(bins),
-            leaf,
-            node,
+        return _build_tree_core(
+            xb, g, h, max_depth, num_bins, reg_lambda, min_child_weight,
+            psum_axis=axis if mesh is not None else None,
         )
 
     if mesh is None:
@@ -284,6 +309,59 @@ def make_tree_builder(
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),
         out_specs=(P(), P(), P(), P(axis)),
+    )
+    return jax.jit(sharded)
+
+
+def make_forest_builder(
+    num_trees: int,
+    max_depth: int,
+    num_bins: int,
+    reg_lambda: float,
+    min_child_weight: float,
+    learning_rate: float,
+    objective: str,
+    mesh: Optional[Mesh] = None,
+    axis: str = "dp",
+):
+    """The whole boosting loop as ONE jitted ``lax.scan`` over trees.
+
+    Per-tree Python loops pay (grad + build + margin-update) dispatches
+    per tree — dozens of host→device round trips per fit, the dominant
+    cost in dispatch-latency-bound settings (a tunneled chip most of all,
+    but real dispatch overhead everywhere). Trees have identical static
+    shapes, which is exactly the shape contract ``lax.scan`` wants: the
+    carry is the margin, each step emits (feature, bin, leaf, loss), and
+    the stacked ys ARE the ``{feature: [T, ...], ...}`` layout
+    ``predict_trees`` consumes. One dispatch per fit; XLA sees the whole
+    forest and schedules/fuses across the per-tree stages.
+
+    Returns jitted ``(xb, y) → (trees_dict, history [T])``.
+    """
+    psum_axis = axis if mesh is not None else None
+
+    def _forest(xb, y):
+        def body(margin, _):
+            g, h, loss = _grad_loss_core(objective, margin, y, psum_axis)
+            feature, split_bin, leaf, node = _build_tree_core(
+                xb, g, h, max_depth, num_bins, reg_lambda,
+                min_child_weight, psum_axis,
+            )
+            margin = _margin_update_core(margin, leaf, node, learning_rate)
+            return margin, (feature, split_bin, leaf, loss)
+
+        _, (feats, bins, leaves, losses) = jax.lax.scan(
+            body, jnp.zeros_like(y), None, length=num_trees
+        )
+        return {"feature": feats, "bin": bins, "leaf": leaves}, losses
+
+    if mesh is None:
+        return jax.jit(_forest)
+    sharded = jax.shard_map(
+        _forest,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P()),
     )
     return jax.jit(sharded)
 
@@ -340,6 +418,7 @@ class GBDTLearner:
         self.edges: Optional[np.ndarray] = None
         self.trees: Optional[Dict] = None
         self._builder = None
+        self._forest = None  # fused lax.scan boosting loop (default path)
         self._engine = None  # multi-process row-count sync, lazy
 
     # ---- fit -----------------------------------------------------------
@@ -544,16 +623,15 @@ class GBDTLearner:
         from dmlc_tpu.utils.logging import log_info
 
         p = self.param
-        if self.mesh is not None and jax.process_count() > 1:
+        multiprocess = self.mesh is not None and jax.process_count() > 1
+        if multiprocess:
             # each process contributes its local rows; the global array
             # spans the world (DeviceFeed._put_tree's multi-host shape)
             shard = NamedSharding(self.mesh, P(self.axis))
-            xb_np = np.asarray(xb)
             y_np = np.asarray(y, dtype=np.float32)
-            xb = jax.make_array_from_process_local_data(shard, xb_np)
+            xb = jax.make_array_from_process_local_data(
+                shard, np.asarray(xb))
             yd = jax.make_array_from_process_local_data(shard, y_np)
-            margin = jax.make_array_from_process_local_data(
-                shard, np.zeros(len(y_np), dtype=np.float32))
         else:
             xb = jnp.asarray(xb)
             yd = jnp.asarray(y)
@@ -561,6 +639,25 @@ class GBDTLearner:
                 shard = NamedSharding(self.mesh, P(self.axis))
                 xb = jax.device_put(xb, shard)
                 yd = jax.device_put(yd, shard)
+        if not log_every:
+            # the default path: the WHOLE boosting loop is one lax.scan
+            # dispatch (make_forest_builder) — per-tree dispatch overhead
+            # retired, XLA schedules across tree stages
+            if self._forest is None:
+                self._forest = make_forest_builder(
+                    p.num_trees, p.max_depth, p.num_bins, p.reg_lambda,
+                    p.min_child_weight, p.learning_rate, p.objective,
+                    self.mesh, self.axis,
+                )
+            self.trees, losses = self._forest(xb, yd)
+            return [float(v) for v in np.asarray(losses)]
+        # live-logging path: one dispatch per tree so losses stream out
+        # while training runs (the scan only reports at the end). Only
+        # this path carries a margin across dispatches.
+        if multiprocess:
+            margin = jax.make_array_from_process_local_data(
+                shard, np.zeros(len(y_np), dtype=np.float32))
+        else:
             margin = jnp.zeros_like(yd)
         if self._builder is None:
             self._builder = make_tree_builder(
@@ -579,7 +676,7 @@ class GBDTLearner:
             leaves.append(leaf)
             margin = update_fn(margin, leaf, node)
             history.append(float(mean_loss))
-            if log_every and (t + 1) % log_every == 0:
+            if (t + 1) % log_every == 0:
                 log_info("tree %d loss %.6f", t + 1, history[-1])
         self.trees = {
             "feature": jnp.stack(feats),
@@ -591,20 +688,14 @@ class GBDTLearner:
     def _make_grad_fn(self):
         objective = self.param.objective
 
-        def _fn(margin, y):
-            g, h = _grad_hess(objective, margin, y)
-            loss = jnp.mean(_loss(objective, margin, y))
-            return g, h, loss
-
         if self.mesh is None:
-            return jax.jit(_fn)
-
-        def _sharded(margin, y):
-            g, h, loss = _fn(margin, y)
-            return g, h, jax.lax.pmean(loss, self.axis)
-
+            return jax.jit(
+                lambda margin, y: _grad_loss_core(objective, margin, y,
+                                                  None))
         return jax.jit(jax.shard_map(
-            _sharded, mesh=self.mesh,
+            lambda margin, y: _grad_loss_core(objective, margin, y,
+                                              self.axis),
+            mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis)),
             out_specs=(P(self.axis), P(self.axis), P()),
         ))
@@ -613,7 +704,7 @@ class GBDTLearner:
         lr = self.param.learning_rate
 
         def _fn(margin, leaf, node):
-            return margin + lr * jnp.take(leaf, node)
+            return _margin_update_core(margin, leaf, node, lr)
 
         if self.mesh is None:
             return jax.jit(_fn)
@@ -661,9 +752,10 @@ class GBDTLearner:
         with create_stream(uri, "r") as stream:
             payload = load_obj(stream)
         self.param.init(payload["param"], allow_unknown=True)
-        # the cached builder bakes in the PREVIOUS hyperparameters; a
-        # fit() after load() must rebuild it against the restored ones
+        # the cached builders bake in the PREVIOUS hyperparameters; a
+        # fit() after load() must rebuild them against the restored ones
         self._builder = None
+        self._forest = None
         self.edges = payload["edges"]
         self.trees = {
             "feature": jnp.asarray(payload["feature"]),

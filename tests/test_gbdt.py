@@ -135,6 +135,27 @@ class TestBoosting:
         rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
         assert rmse < 0.5, rmse
 
+    def test_scan_and_loop_paths_build_identical_forests(self):
+        """fit() without log_every runs the fused lax.scan boosting loop
+        (one dispatch); with log_every it runs the per-tree loop. Both
+        must produce the same model — identical structure, same losses."""
+        x, y = _synthetic(n=1024)
+        scan = GBDTLearner(num_trees=6, max_depth=3, learning_rate=0.5,
+                           num_bins=16)
+        h_scan = scan.fit(x, y)  # log_every=0 -> scan path
+        loop = GBDTLearner(num_trees=6, max_depth=3, learning_rate=0.5,
+                           num_bins=16)
+        h_loop = loop.fit(x, y, log_every=100)  # loop path, no output
+        np.testing.assert_array_equal(
+            np.asarray(scan.trees["feature"]),
+            np.asarray(loop.trees["feature"]))
+        np.testing.assert_array_equal(
+            np.asarray(scan.trees["bin"]), np.asarray(loop.trees["bin"]))
+        np.testing.assert_allclose(
+            np.asarray(scan.trees["leaf"]),
+            np.asarray(loop.trees["leaf"]), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h_scan, h_loop, rtol=1e-5)
+
     def test_save_load_round_trip(self, tmp_path):
         x, y = _synthetic(n=1024)
         learner = GBDTLearner(num_trees=5, max_depth=3, num_bins=16)
