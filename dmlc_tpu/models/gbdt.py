@@ -71,18 +71,34 @@ class GBDTParam(Parameter):
 # ---------------------------------------------------------------------------
 
 
-def fit_bins(x: np.ndarray, num_bins: int = 256) -> np.ndarray:
+def fit_bins(x, num_bins: int = 256) -> np.ndarray:
     """Per-feature quantile cut points → edges [F, num_bins-1] (f32).
 
     Bin b holds values in (edges[b-1], edges[b]]; ids are produced by
     ``searchsorted(edges, x)`` so they always land in [0, num_bins).
     Mirrors xgboost's sketch → cut conversion at demo fidelity (exact
     quantiles of the supplied sample rather than a streaming sketch).
+
+    On an accelerator backend the [N, F] quantile computes on device
+    (the sort is the expensive part; on-chip it's ~free while the host
+    quantile was the single biggest stage of a TPU fit) and only the
+    tiny [F, num_bins-1] cut matrix comes back for the monotonic fixup.
+    On the cpu backend numpy's introselect-based quantile beats an XLA
+    full sort, so it stays host-side. ``x`` may already be a device
+    array — the accelerator path then skips the H2D.
     """
-    x = np.asarray(x, dtype=np.float32)
+    if not isinstance(x, jax.Array):
+        x = np.asarray(x, dtype=np.float32)
     check(x.ndim == 2, "fit_bins expects [N, F]")
     qs = np.linspace(0.0, 1.0, num_bins + 1)[1:-1]
-    edges = np.quantile(x, qs, axis=0).T.astype(np.float32)  # [F, B-1]
+    if jax.default_backend() != "cpu":
+        q = jnp.quantile(jnp.asarray(x, dtype=jnp.float32),
+                         jnp.asarray(qs, dtype=jnp.float32), axis=0)
+        edges = np.asarray(q).T.astype(np.float32)  # tiny D2H
+    else:
+        edges = np.quantile(
+            np.asarray(x, dtype=np.float32), qs, axis=0
+        ).T.astype(np.float32)  # [F, B-1]
     # strictly increasing edges keep searchsorted stable when a feature has
     # few distinct values (ties collapse quantiles to equal cut points)
     eps = np.finfo(np.float32).eps
@@ -500,6 +516,10 @@ class GBDTLearner:
                   "quantiles would bin the same value differently)")
             self._sync_row_count(x.shape[0], trim=False)
         self._check_divisible(x.shape[0])
+        if not multiprocess and jax.default_backend() != "cpu":
+            # ONE H2D of the float matrix feeds both the device quantile
+            # (fit_bins accelerator path) and the device searchsorted
+            x = jnp.asarray(x)
         if edges is not None:
             self.edges = np.asarray(edges, dtype=np.float32)
             self._check_edges(x.shape[1])
