@@ -157,11 +157,22 @@ def _loss(objective: str, margin, label):
     return 0.5 * (margin - label) ** 2
 
 
-def _grad_loss_core(objective: str, margin, y, psum_axis):
-    """(g, h, mean loss) for one boosting round — the ONE definition both
-    the fused-scan and per-tree-loop paths trace (like _build_tree_core:
-    a change here cannot diverge the two paths' models)."""
+def _grad_loss_core(objective: str, margin, y, w, psum_axis):
+    """(g, h, weighted mean loss) for one boosting round — the ONE
+    definition both the fused-scan and per-tree-loop paths trace (like
+    _build_tree_core: a change here cannot diverge the two paths'
+    models). Instance weights scale (g, h) — xgboost's semantics: a
+    weight-2 row contributes exactly like two copies of itself to every
+    histogram, split gain, and leaf value."""
     g, h = _grad_hess(objective, margin, y)
+    if w is not None:
+        g = g * w
+        h = h * w
+        lsum = jnp.sum(w * _loss(objective, margin, y))
+        wsum = jnp.sum(w)
+        if psum_axis is not None:
+            lsum, wsum = jax.lax.psum((lsum, wsum), psum_axis)
+        return g, h, lsum / jnp.maximum(wsum, 1e-12)
     loss = jnp.mean(_loss(objective, margin, y))
     if psum_axis is not None:
         loss = jax.lax.pmean(loss, psum_axis)
@@ -339,6 +350,7 @@ def make_forest_builder(
     objective: str,
     mesh: Optional[Mesh] = None,
     axis: str = "dp",
+    weighted: bool = False,
 ):
     """The whole boosting loop as ONE jitted ``lax.scan`` over trees.
 
@@ -352,13 +364,17 @@ def make_forest_builder(
     ``predict_trees`` consumes. One dispatch per fit; XLA sees the whole
     forest and schedules/fuses across the per-tree stages.
 
-    Returns jitted ``(xb, y) → (trees_dict, history [T])``.
+    Returns jitted ``(xb, y[, w]) → (trees_dict, history [T])`` — the
+    trailing instance-weight array only when ``weighted``.
     """
     psum_axis = axis if mesh is not None else None
 
-    def _forest(xb, y):
+    def _forest(xb, y, *maybe_w):
+        w = maybe_w[0] if weighted else None
+
         def body(margin, _):
-            g, h, loss = _grad_loss_core(objective, margin, y, psum_axis)
+            g, h, loss = _grad_loss_core(objective, margin, y, w,
+                                         psum_axis)
             feature, split_bin, leaf, node = _build_tree_core(
                 xb, g, h, max_depth, num_bins, reg_lambda,
                 min_child_weight, psum_axis,
@@ -373,10 +389,11 @@ def make_forest_builder(
 
     if mesh is None:
         return jax.jit(_forest)
+    data_specs = (P(axis), P(axis)) + ((P(axis),) if weighted else ())
     sharded = jax.shard_map(
         _forest,
         mesh=mesh,
-        in_specs=(P(axis), P(axis)),
+        in_specs=data_specs,
         out_specs=(P(), P()),
     )
     return jax.jit(sharded)
@@ -453,6 +470,16 @@ class GBDTLearner:
               "N %d (this process's rows) must divide its %d mesh shards "
               "(pad or trim the training set)", n, shards)
 
+    def _get_engine(self):
+        """Cached DeviceEngine for tiny cross-process agreement
+        collectives (row counts, weighted-ness) — cached so its jitted
+        reduction survives across fits."""
+        if self._engine is None:
+            from dmlc_tpu.collective.device import DeviceEngine
+
+            self._engine = DeviceEngine(self.mesh)
+        return self._engine
+
     def _check_edges(self, num_features: int) -> None:
         """User-supplied edges must match (F, num_bins-1): oversize bin
         ids would walk off the end of the segment key space and
@@ -473,15 +500,10 @@ class GBDTLearner:
         multiple of their shards) or a clean error."""
         if self.mesh is None or jax.process_count() <= 1:
             return n_local
-        if self._engine is None:
-            from dmlc_tpu.collective.device import DeviceEngine
-
-            self._engine = DeviceEngine(self.mesh)  # cached: keeps the
-            # engine's jitted reduction across fits
         shards = self._local_shards()
         usable = (n_local // shards) * shards if trim else n_local
         # one allreduce carries both bounds: min(x) and min(-x) = -max(x)
-        lo, neg_hi = (int(v) for v in self._engine.allreduce(
+        lo, neg_hi = (int(v) for v in self._get_engine().allreduce(
             np.array([usable, -usable]), op="min"))
         if trim:
             return lo
@@ -492,10 +514,15 @@ class GBDTLearner:
         return n_local
 
     def fit(self, x: np.ndarray, y: np.ndarray, log_every: int = 0,
-            edges: Optional[np.ndarray] = None):
+            edges: Optional[np.ndarray] = None,
+            weight: Optional[np.ndarray] = None):
         """Train on an in-memory dense [N, F] float matrix. Returns the
-        per-tree mean training loss history (evaluated pre-update, so
+        per-tree weighted mean loss history (evaluated pre-update, so
         entry 0 is the base-margin loss).
+
+        ``weight`` [N] scales each row's (g, h) — xgboost's instance
+        weights: a weight-2 row trains exactly like two copies of it
+        (histograms, split gains, leaf values; proven by test).
 
         Multi-process meshes: ``x``/``y`` are this process's LOCAL rows,
         and every process must pass IDENTICAL ``edges`` (bin boundaries
@@ -509,6 +536,9 @@ class GBDTLearner:
         y = np.asarray(y, dtype=np.float32)
         check(x.ndim == 2 and y.shape == (x.shape[0],),
               "fit expects x [N, F], y [N]")
+        if weight is not None:
+            weight = np.asarray(weight, dtype=np.float32)
+            check(weight.shape == y.shape, "weight must be [N]")
         multiprocess = self.mesh is not None and jax.process_count() > 1
         if multiprocess:
             check(edges is not None,
@@ -529,11 +559,13 @@ class GBDTLearner:
             # bin on host: the global assembly consumes host arrays, so
             # device apply_bins would D2H the matrix straight back
             return self._fit_binned(
-                _apply_bins_np(x, self.edges, p.num_bins), y, log_every)
+                _apply_bins_np(x, self.edges, p.num_bins), y, log_every,
+                weight)
         # apply_bins already lives on device; _fit_binned's jnp.asarray
         # is a no-op there (a np.asarray round trip would D2H+H2D the
         # whole matrix for nothing)
-        return self._fit_binned(apply_bins(x, self.edges), y, log_every)
+        return self._fit_binned(apply_bins(x, self.edges), y, log_every,
+                                weight)
 
     def fit_uri(
         self,
@@ -606,12 +638,23 @@ class GBDTLearner:
             # pass 2: stream + bin on the host (no device chatter per
             # block)
             parser.before_first()
-            xb_parts, y_parts = [], []
+            xb_parts, y_parts, w_parts = [], [], []
+            any_weight = False
             for block in parser:
                 dense = block.to_dense(num_features)
                 xb_parts.append(
                     _apply_bins_np(dense, self.edges, p.num_bins))
                 y_parts.append(np.asarray(block.label, dtype=np.float32))
+                # instance weights ride the format when present (libsvm
+                # label:weight — data.h Row semantics); all-absent stays
+                # the unweighted fast path
+                if block.weight is not None:
+                    any_weight = True
+                    w_parts.append(
+                        np.asarray(block.weight, dtype=np.float32))
+                else:
+                    w_parts.append(
+                        np.ones(len(block), dtype=np.float32))
         finally:
             parser.close()
         # both branches must fail cleanly on a rowless uri/part (a
@@ -626,6 +669,16 @@ class GBDTLearner:
         # avoid
         xb = np.concatenate(xb_parts)
         y = np.concatenate(y_parts)
+        if self.mesh is not None and jax.process_count() > 1:
+            # weighted-ness must agree across the world: a process whose
+            # part happens to carry no label:weight rows would otherwise
+            # build the 2-input SPMD program while its peers build the
+            # 3-input one — mismatched executables against the same
+            # collectives. Any process's weights make the fit weighted
+            # (the ones-fill above already covers the absent rows).
+            any_weight = bool(self._get_engine().allreduce(
+                np.array([int(any_weight)]), op="max")[0])
+        weight = np.concatenate(w_parts) if any_weight else None
         if drop_remainder and self.mesh is not None:
             shards = self._local_shards()
             # equalize ACROSS processes too: global assembly assumes every
@@ -634,15 +687,19 @@ class GBDTLearner:
             n = self._sync_row_count((xb.shape[0] // shards) * shards,
                                      trim=True)
             xb, y = xb[:n], y[:n]
+            if weight is not None:
+                weight = weight[:n]
         else:
             self._sync_row_count(xb.shape[0], trim=False)
         self._check_divisible(xb.shape[0])
-        return self._fit_binned(xb, y, log_every)
+        return self._fit_binned(xb, y, log_every, weight)
 
-    def _fit_binned(self, xb: np.ndarray, y: np.ndarray, log_every: int):
+    def _fit_binned(self, xb: np.ndarray, y: np.ndarray, log_every: int,
+                    weight: Optional[np.ndarray] = None):
         from dmlc_tpu.utils.logging import log_info
 
         p = self.param
+        weighted = weight is not None
         multiprocess = self.mesh is not None and jax.process_count() > 1
         if multiprocess:
             # each process contributes its local rows; the global array
@@ -652,24 +709,32 @@ class GBDTLearner:
             xb = jax.make_array_from_process_local_data(
                 shard, np.asarray(xb))
             yd = jax.make_array_from_process_local_data(shard, y_np)
+            if weighted:
+                weight = jax.make_array_from_process_local_data(
+                    shard, np.asarray(weight, dtype=np.float32))
         else:
             xb = jnp.asarray(xb)
             yd = jnp.asarray(y)
+            if weighted:
+                weight = jnp.asarray(weight, dtype=jnp.float32)
             if self.mesh is not None:
                 shard = NamedSharding(self.mesh, P(self.axis))
                 xb = jax.device_put(xb, shard)
                 yd = jax.device_put(yd, shard)
+                if weighted:
+                    weight = jax.device_put(weight, shard)
+        wargs = (weight,) if weighted else ()
         if not log_every:
             # the default path: the WHOLE boosting loop is one lax.scan
             # dispatch (make_forest_builder) — per-tree dispatch overhead
             # retired, XLA schedules across tree stages
-            if self._forest is None:
-                self._forest = make_forest_builder(
+            if self._forest is None or self._forest[0] != weighted:
+                self._forest = (weighted, make_forest_builder(
                     p.num_trees, p.max_depth, p.num_bins, p.reg_lambda,
                     p.min_child_weight, p.learning_rate, p.objective,
-                    self.mesh, self.axis,
-                )
-            self.trees, losses = self._forest(xb, yd)
+                    self.mesh, self.axis, weighted=weighted,
+                ))
+            self.trees, losses = self._forest[1](xb, yd, *wargs)
             return [float(v) for v in np.asarray(losses)]
         # live-logging path: one dispatch per tree so losses stream out
         # while training runs (the scan only reports at the end). Only
@@ -684,12 +749,12 @@ class GBDTLearner:
                 p.max_depth, p.num_bins, p.reg_lambda,
                 p.min_child_weight, self.mesh, self.axis,
             )
-        grad_fn = self._make_grad_fn()
+        grad_fn = self._make_grad_fn(weighted)
         update_fn = self._make_margin_update()
         feats, bins, leaves = [], [], []
         history = []
         for t in range(p.num_trees):
-            g, h, mean_loss = grad_fn(margin, yd)
+            g, h, mean_loss = grad_fn(margin, yd, *wargs)
             feature, split_bin, leaf, node = self._builder(xb, g, h)
             feats.append(feature)
             bins.append(split_bin)
@@ -705,18 +770,21 @@ class GBDTLearner:
         }
         return history
 
-    def _make_grad_fn(self):
+    def _make_grad_fn(self, weighted: bool = False):
         objective = self.param.objective
 
+        def _fn(margin, y, *maybe_w, axis=None):
+            return _grad_loss_core(
+                objective, margin, y,
+                maybe_w[0] if weighted else None, axis)
+
         if self.mesh is None:
-            return jax.jit(
-                lambda margin, y: _grad_loss_core(objective, margin, y,
-                                                  None))
+            return jax.jit(_fn)
+        data = (P(self.axis),) * (3 if weighted else 2)
         return jax.jit(jax.shard_map(
-            lambda margin, y: _grad_loss_core(objective, margin, y,
-                                              self.axis),
+            lambda *args: _fn(*args, axis=self.axis),
             mesh=self.mesh,
-            in_specs=(P(self.axis), P(self.axis)),
+            in_specs=data,
             out_specs=(P(self.axis), P(self.axis), P()),
         ))
 

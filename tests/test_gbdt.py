@@ -135,6 +135,48 @@ class TestBoosting:
         rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
         assert rmse < 0.5, rmse
 
+    def test_weight_two_equals_duplicated_row(self):
+        """xgboost instance-weight semantics: weight-2 rows must train
+        exactly like duplicated rows — same histograms, same splits,
+        same leaf values (weights scale g and h, nothing else)."""
+        x, y = _synthetic(n=512, f=5)
+        dup_idx = np.arange(0, 512, 3)  # every 3rd row twice
+        x_dup = np.concatenate([x, x[dup_idx]])
+        y_dup = np.concatenate([y, y[dup_idx]])
+        w = np.ones(512, dtype=np.float32)
+        w[dup_idx] = 2.0
+        # identical edges: duplication changes the quantiles, weights
+        # don't — so feed the weighted run the duplicated-set edges
+        from dmlc_tpu.models.gbdt import fit_bins
+
+        edges = fit_bins(x_dup, 16)
+        a = GBDTLearner(num_trees=6, max_depth=3, learning_rate=0.5,
+                        num_bins=16)
+        ha = a.fit(x_dup, y_dup, edges=edges)
+        b = GBDTLearner(num_trees=6, max_depth=3, learning_rate=0.5,
+                        num_bins=16)
+        hb = b.fit(x, y, edges=edges, weight=w)
+        np.testing.assert_array_equal(
+            np.asarray(a.trees["feature"]), np.asarray(b.trees["feature"]))
+        np.testing.assert_array_equal(
+            np.asarray(a.trees["bin"]), np.asarray(b.trees["bin"]))
+        np.testing.assert_allclose(
+            np.asarray(a.trees["leaf"]), np.asarray(b.trees["leaf"]),
+            rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(ha, hb, rtol=1e-4)
+
+    def test_weighted_scan_and_loop_agree(self):
+        x, y = _synthetic(n=512, f=4)
+        w = np.random.RandomState(3).rand(512).astype(np.float32) + 0.5
+        scan = GBDTLearner(num_trees=4, max_depth=3, num_bins=8)
+        hs = scan.fit(x, y, weight=w)
+        loop = GBDTLearner(num_trees=4, max_depth=3, num_bins=8)
+        hl = loop.fit(x, y, weight=w, log_every=99)
+        np.testing.assert_array_equal(
+            np.asarray(scan.trees["feature"]),
+            np.asarray(loop.trees["feature"]))
+        np.testing.assert_allclose(hs, hl, rtol=1e-5)
+
     def test_scan_and_loop_paths_build_identical_forests(self):
         """fit() without log_every runs the fused lax.scan boosting loop
         (one dispatch); with log_every it runs the per-tree loop. Both
@@ -280,13 +322,38 @@ class TestFitUri:
         seen = {}
         orig = GBDTLearner._fit_binned
 
-        def spy(self, xb, yy, log_every):
+        def spy(self, xb, yy, log_every, weight=None):
             seen["dtype"] = xb.dtype
-            return orig(self, xb, yy, log_every)
+            return orig(self, xb, yy, log_every, weight)
 
         monkeypatch.setattr(GBDTLearner, "_fit_binned", spy)
         learner.fit_uri(str(svm), num_features=3)
         assert seen["dtype"] == np.uint8
+
+    def test_libsvm_weights_flow_through(self, tmp_path):
+        """label:weight rows (data.h Row weight semantics) reach the
+        boosting loop: a weighted file must train like the in-memory
+        weighted fit, and differently from ignoring the weights."""
+        x, y = _synthetic(n=512, f=4)
+        w = np.where(np.arange(512) % 4 == 0, 3.0, 1.0).astype(np.float32)
+        svm = tmp_path / "w.svm"
+        with open(svm, "w") as fh:
+            for row, lab, wt in zip(x, y, w):
+                fh.write("%d:%.1f %s\n" % (
+                    int(lab), wt,
+                    " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))))
+        uri = GBDTLearner(num_trees=4, max_depth=3, num_bins=16)
+        h_uri = uri.fit_uri(str(svm), num_features=4, sample_rows=4096)
+        mem = GBDTLearner(num_trees=4, max_depth=3, num_bins=16)
+        mem.fit(x, y, edges=np.asarray(uri.edges), weight=w)
+        np.testing.assert_array_equal(
+            np.asarray(uri.trees["feature"]),
+            np.asarray(mem.trees["feature"]))
+        unw = GBDTLearner(num_trees=4, max_depth=3, num_bins=16)
+        unw.fit(x, y, edges=np.asarray(uri.edges))
+        assert not np.allclose(np.asarray(uri.trees["leaf"]),
+                               np.asarray(unw.trees["leaf"]))
+        assert h_uri[-1] < h_uri[0]
 
     def test_empty_uri_raises(self, tmp_path):
         from dmlc_tpu.utils.logging import DMLCError

@@ -520,9 +520,15 @@ def test_gbdt_histogram_psum_across_processes(tmp_path):
     rng = np.random.RandomState(31)
     uri = tmp_path / "ragged.svm"
     with open(uri, "w") as fh:
-        for _ in range(1003):  # odd count -> byte-ragged parts
+        for i in range(1003):  # odd count -> byte-ragged parts
             vals = rng.rand(6)
-            fh.write("%d %s\n" % (int(vals[0] > 0.5), " ".join(
+            label = int(vals[0] > 0.5)
+            # label:weight on the FIRST half only: the byte-split gives
+            # rank 0 weighted rows and rank 1 none, so the processes'
+            # local any_weight flags DISAGREE — the cross-process flag
+            # allreduce must still build matching SPMD programs
+            head = f"{label}:2.0" if i < 500 else str(label)
+            fh.write("%s %s\n" % (head, " ".join(
                 f"{j}:{vals[j]:.5f}" for j in range(6))))
     outs = _launch_workers(tmp_path, GBDT_BODY, _free_port(),
                            extra_args=(uri,))
