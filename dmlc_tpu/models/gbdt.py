@@ -47,7 +47,13 @@ class GBDTParam(Parameter):
 
     objective = field(
         str, "logistic",
-        description="Loss: logistic (labels 0/1) or squared.",
+        description="Loss: logistic (labels 0/1), squared, or softmax "
+                    "(labels are class ids; set num_class).",
+    )
+    num_class = field(
+        int, 0, lower_bound=0,
+        description="Class count for objective=softmax (>= 2); 0 for "
+                    "scalar objectives.",
     )
     num_trees = field(int, 20, lower_bound=1)
     max_depth = field(int, 6, lower_bound=1, upper_bound=12)
@@ -140,12 +146,22 @@ def _apply_bins_np(x: np.ndarray, edges: np.ndarray,
 
 
 def _grad_hess(objective: str, margin, label):
-    """Per-row (g, h) for the second-order boosting objective."""
+    """Per-row (g, h) for the second-order boosting objective.
+
+    softmax: margin is [N, K], label holds class ids; (g, h) are [N, K]
+    with the diagonal-hessian approximation p(1−p) — xgboost's
+    multi:softprob formulation (K channels share one tree structure)."""
     if objective == "logistic":
         p = jax.nn.sigmoid(margin)
         return p - label, jnp.maximum(p * (1.0 - p), 1e-16)
     if objective == "squared":
         return margin - label, jnp.ones_like(margin)
+    if objective == "softmax":
+        p = jax.nn.softmax(margin, axis=-1)
+        onehot = jax.nn.one_hot(
+            label.astype(jnp.int32), margin.shape[-1], dtype=margin.dtype
+        )
+        return p - onehot, jnp.maximum(p * (1.0 - p), 1e-16)
     raise ValueError(f"unknown objective {objective!r}")
 
 
@@ -154,6 +170,11 @@ def _loss(objective: str, margin, label):
         return jnp.maximum(margin, 0.0) - margin * label + jnp.log1p(
             jnp.exp(-jnp.abs(margin))
         )
+    if objective == "softmax":
+        logp = jax.nn.log_softmax(margin, axis=-1)
+        return -jnp.take_along_axis(
+            logp, label.astype(jnp.int32)[:, None], axis=1
+        )[:, 0]
     return 0.5 * (margin - label) ** 2
 
 
@@ -166,8 +187,9 @@ def _grad_loss_core(objective: str, margin, y, w, psum_axis):
     histogram, split gain, and leaf value."""
     g, h = _grad_hess(objective, margin, y)
     if w is not None:
-        g = g * w
-        h = h * w
+        wexp = w if g.ndim == 1 else w[:, None]
+        g = g * wexp
+        h = h * wexp
         lsum = jnp.sum(w * _loss(objective, margin, y))
         wsum = jnp.sum(w)
         if psum_axis is not None:
@@ -180,7 +202,9 @@ def _grad_loss_core(objective: str, margin, y, w, psum_axis):
 
 
 def _margin_update_core(margin, leaf, node, learning_rate):
-    return margin + learning_rate * jnp.take(leaf, node)
+    # leaf [2^D] (scalar objectives) or [2^D, K] (softmax): axis-0 take
+    # serves both, yielding [N] or [N, K] updates
+    return margin + learning_rate * jnp.take(leaf, node, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -189,11 +213,14 @@ def _margin_update_core(margin, leaf, node, learning_rate):
 
 
 def _level_histogram(xb, node, g, h, n_nodes, num_bins):
-    """(grad, hess) histogram [n_nodes, F, num_bins] by flat segment-sum.
+    """(grad, hess) histogram [n_nodes, F, num_bins, C] by segment-sum.
 
-    One flat key (node, feature, bin) per (sample, feature) cell; two
-    segment-sums (g, h) over it. Every sample stays live through the
-    build (leaf-in-place nodes route left), so no masking pass is needed.
+    One flat key (node, feature, bin) per (sample, feature) cell; a
+    single scatter pass fills all 2C channels (C = 1 for scalar
+    objectives, K for softmax — the channels share one key, so
+    multiclass costs one wider scatter, not K scatters). Every sample
+    stays live through the build (leaf-in-place nodes route left), so no
+    masking pass is needed.
     """
     nf = xb.shape[1]
     n_seg = n_nodes * nf * num_bins
@@ -206,42 +233,54 @@ def _level_histogram(xb, node, g, h, n_nodes, num_bins):
         (node[:, None].astype(key_dtype) * nf + feat) * num_bins
         + xb.astype(key_dtype)
     ).reshape(-1)
-    gh = jnp.stack(
-        [jnp.broadcast_to(g[:, None], xb.shape).reshape(-1),
-         jnp.broadcast_to(h[:, None], xb.shape).reshape(-1)], axis=1
-    )  # [N*F, 2] — one scatter pass fills both histograms
-    hist = jax.ops.segment_sum(gh, flat, num_segments=n_seg)
-    hist = hist.reshape(n_nodes, nf, num_bins, 2)
-    return hist[..., 0], hist[..., 1]
+    g2 = g[:, None] if g.ndim == 1 else g
+    h2 = h[:, None] if h.ndim == 1 else h
+    c = g2.shape[1]
+    gh = jnp.concatenate([g2, h2], axis=1)  # [N, 2C]
+    vals = jnp.broadcast_to(
+        gh[:, None, :], (gh.shape[0], nf, 2 * c)
+    ).reshape(-1, 2 * c)
+    hist = jax.ops.segment_sum(vals, flat, num_segments=n_seg)
+    hist = hist.reshape(n_nodes, nf, num_bins, 2 * c)
+    return hist[..., :c], hist[..., c:]
 
 
 def _find_splits(ghist, hhist, reg_lambda, min_child_weight):
     """Vectorized best split per node.
 
-    ghist/hhist [n_nodes, F, B] → (feature [n_nodes], bin [n_nodes],
-    gain [n_nodes], gtot [n_nodes], htot [n_nodes]). A split at bin t
-    sends bins ≤ t left. gain = ½(GL²/(HL+λ) + GR²/(HR+λ) − G²/(H+λ)),
-    the xgboost structure score; children under min_child_weight are
-    masked out. feature = -1 flags "no positive-gain split" (leaf).
+    ghist/hhist [n_nodes, F, B, C] → (feature [n_nodes], bin [n_nodes],
+    gain [n_nodes], gtot [n_nodes, C], htot [n_nodes, C]). A split at
+    bin t sends bins ≤ t left. gain = ½ Σ_c (GL²/(HL+λ) + GR²/(HR+λ) −
+    G²/(H+λ)), the xgboost structure score summed over channels (all
+    classes share one structure); children whose total hessian is under
+    min_child_weight are masked out. feature = -1 flags "no
+    positive-gain split" (leaf).
     """
     gl = jnp.cumsum(ghist, axis=2)
     hl = jnp.cumsum(hhist, axis=2)
-    gtot = gl[:, 0, -1]
+    gtot = gl[:, 0, -1]  # [n, C] (identical for every feature)
     htot = hl[:, 0, -1]
-    gr = gtot[:, None, None] - gl
-    hr = htot[:, None, None] - hl
+    gr = gtot[:, None, None, :] - gl
+    hr = htot[:, None, None, :] - hl
     lam = reg_lambda
 
     def score(gsum, hsum):
         # an empty child at reg_lambda=0 is 0/0: select 0 instead of
         # letting a NaN survive the mask and poison every argmax
         denom = hsum + lam
-        return jnp.where(denom > 0.0, gsum * gsum / denom, 0.0)
+        return jnp.where(
+            denom > 0.0, gsum * gsum / denom, 0.0
+        ).sum(axis=-1)
 
     gain = 0.5 * (
-        score(gl, hl) + score(gr, hr) - score(gtot, htot)[:, None, None]
+        score(gl, hl) + score(gr, hr)
+        - score(gtot, htot)[:, None, None]
     )
-    ok = (hl >= min_child_weight) & (hr >= min_child_weight)
+    # cover = total hessian mass across channels (xgboost's multiclass
+    # min_child_weight semantics)
+    hl_tot = hl.sum(axis=-1)
+    hr_tot = hr.sum(axis=-1)
+    ok = (hl_tot >= min_child_weight) & (hr_tot >= min_child_weight)
     # the last bin's "split" sends everything left — never a real split
     ok = ok.at[:, :, -1].set(False)
     gain = jnp.where(ok, gain, -jnp.inf)
@@ -294,7 +333,8 @@ def _build_tree_core(xb, g, h, max_depth, num_bins, reg_lambda,
         )[:, 0]
         go_right = (nfeat >= 0) & (fval > nbin)
         node = node * 2 + go_right.astype(jnp.int32)
-    # leaf values from the last level's (G, H) per leaf
+    # leaf values from the last level's (G, H) per leaf — [2^D] for
+    # scalar objectives, [2^D, K] vector leaves for softmax
     gleaf = jax.ops.segment_sum(g, node, num_segments=n_leaves)
     hleaf = jax.ops.segment_sum(h, node, num_segments=n_leaves)
     if psum_axis is not None:
@@ -351,6 +391,7 @@ def make_forest_builder(
     mesh: Optional[Mesh] = None,
     axis: str = "dp",
     weighted: bool = False,
+    num_class: int = 0,
 ):
     """The whole boosting loop as ONE jitted ``lax.scan`` over trees.
 
@@ -382,8 +423,16 @@ def make_forest_builder(
             margin = _margin_update_core(margin, leaf, node, learning_rate)
             return margin, (feature, split_bin, leaf, loss)
 
+        # derive the initial margin FROM y (not fresh zeros): inside
+        # shard_map the scan carry must match the body output's varying
+        # manual axes, and only values computed from the sharded operand
+        # carry that type
+        margin0 = jnp.zeros_like(y)
+        if objective == "softmax":
+            margin0 = margin0[:, None] * jnp.ones(
+                (num_class,), dtype=jnp.float32)
         _, (feats, bins, leaves, losses) = jax.lax.scan(
-            body, jnp.zeros_like(y), None, length=num_trees
+            body, margin0, None, length=num_trees
         )
         return {"feature": feats, "bin": bins, "leaf": leaves}, losses
 
@@ -408,8 +457,9 @@ def predict_trees(trees: Dict, xb, max_depth: int):
     """Sum of leaf values over all trees for binned rows xb [N, F].
 
     trees: {"feature": [T, n_internal], "bin": [T, n_internal],
-    "leaf": [T, 2^D]} stacked over trees; the descent is D gathers per
-    tree, vmapped over T — no data-dependent control flow.
+    "leaf": [T, 2^D] or [T, 2^D, K] (softmax vector leaves)} stacked
+    over trees; the descent is D gathers per tree, vmapped over T — no
+    data-dependent control flow. Returns [N] or [N, K].
     """
     offsets = jnp.asarray(_tree_level_offsets(max_depth), dtype=jnp.int32)
 
@@ -424,11 +474,11 @@ def predict_trees(trees: Dict, xb, max_depth: int):
             )[:, 0]
             go_right = (nfeat >= 0) & (fval > nbin)
             node = node * 2 + go_right.astype(jnp.int32)
-        return jnp.take(leaf, node)
+        return jnp.take(leaf, node, axis=0)
 
     per_tree = jax.vmap(one_tree)(
         trees["feature"], trees["bin"], trees["leaf"]
-    )  # [T, N]
+    )  # [T, N] or [T, N, K]
     return jnp.sum(per_tree, axis=0)
 
 
@@ -699,6 +749,18 @@ class GBDTLearner:
         from dmlc_tpu.utils.logging import log_info
 
         p = self.param
+        if p.objective == "softmax":
+            # the shared chokepoint: fit AND fit_uri funnel here, so both
+            # get the clean errors (out-of-range ids silently one_hot to
+            # all-zero rows and train a NaN model otherwise)
+            check(p.num_class >= 2,
+                  "objective=softmax requires num_class >= 2")
+            y_arr = np.asarray(y)
+            check(len(y_arr) == 0 or (
+                float(y_arr.min()) >= 0
+                and float(y_arr.max()) < p.num_class),
+                "softmax labels must be class ids in [0, %d)",
+                p.num_class)
         weighted = weight is not None
         multiprocess = self.mesh is not None and jax.process_count() > 1
         if multiprocess:
@@ -733,17 +795,20 @@ class GBDTLearner:
                     p.num_trees, p.max_depth, p.num_bins, p.reg_lambda,
                     p.min_child_weight, p.learning_rate, p.objective,
                     self.mesh, self.axis, weighted=weighted,
+                    num_class=p.num_class,
                 ))
             self.trees, losses = self._forest[1](xb, yd, *wargs)
             return [float(v) for v in np.asarray(losses)]
         # live-logging path: one dispatch per tree so losses stream out
         # while training runs (the scan only reports at the end). Only
         # this path carries a margin across dispatches.
+        mshape = ((len(y),) if p.objective != "softmax"
+                  else (len(y), p.num_class))
         if multiprocess:
             margin = jax.make_array_from_process_local_data(
-                shard, np.zeros(len(y_np), dtype=np.float32))
+                shard, np.zeros(mshape, dtype=np.float32))
         else:
-            margin = jnp.zeros_like(yd)
+            margin = jnp.zeros(mshape, dtype=jnp.float32)
         if self._builder is None:
             self._builder = make_tree_builder(
                 p.max_depth, p.num_bins, p.reg_lambda,
@@ -812,10 +877,14 @@ class GBDTLearner:
         return np.asarray(margin)
 
     def predict(self, x: np.ndarray) -> np.ndarray:
-        """Probabilities under logistic, raw margin under squared."""
+        """Probabilities under logistic ([N]) and softmax ([N, K],
+        xgboost multi:softprob — argmax for class ids), raw margin under
+        squared."""
         margin = self.predict_margin(x)
         if self.param.objective == "logistic":
             return np.asarray(jax.nn.sigmoid(jnp.asarray(margin)))
+        if self.param.objective == "softmax":
+            return np.asarray(jax.nn.softmax(jnp.asarray(margin), axis=-1))
         return margin
 
     # ---- checkpointing via the Stream surface (SURVEY §5.4) -------------
