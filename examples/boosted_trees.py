@@ -50,6 +50,17 @@ def _load_dense(uri: str, num_features: int, part: int, nparts: int):
     return np.concatenate(xs), np.concatenate(ys)
 
 
+def _synthetic_multiclass(k: int, n: int = 8192, f: int = 12):
+    rng = np.random.RandomState(19)
+    x = rng.rand(n, f).astype(np.float32)
+    y = np.minimum(
+        (x[:, 0] > 0.5) * 2 + (x[:, 1] > 0.5), k - 1
+    ).astype(np.float32)
+    flip = rng.rand(n) < 0.04
+    y[flip] = rng.randint(0, k, int(flip.sum()))
+    return x, y
+
+
 def _synthetic(n: int = 8192, f: int = 16):
     rng = np.random.RandomState(11)
     x = rng.rand(n, f).astype(np.float32)
@@ -72,6 +83,12 @@ def main() -> int:
     ap.add_argument("--max-depth", type=int, default=5)
     ap.add_argument("--learning-rate", type=float, default=0.4)
     ap.add_argument("--num-bins", type=int, default=64)
+    ap.add_argument("--objective", default="logistic",
+                    choices=("logistic", "squared", "softmax"))
+    ap.add_argument("--num-class", type=int, default=0,
+                    help="class count for --objective softmax (labels "
+                         "are class ids); --synthetic then generates a "
+                         "4-class problem")
     ap.add_argument("--dp", type=int, default=0,
                     help="shard samples over a dp-way mesh axis "
                          "(histograms cross the mesh in one psum/level)")
@@ -94,8 +111,19 @@ def main() -> int:
 
         mesh = make_mesh({"dp": args.dp})
 
+    softmax = args.objective == "softmax"
+    if softmax and args.num_class < 2:
+        # default only where we control the data: a real uri's class
+        # count is the user's to declare (guessing trains a wrong-width
+        # model or dies on the label-range check)
+        if args.uri and not args.synthetic:
+            ap.error("--objective softmax with a data uri requires "
+                     "--num-class")
+        args.num_class = 4  # the synthetic multiclass default
     learner = GBDTLearner(
         mesh=mesh,
+        objective=args.objective,
+        num_class=args.num_class,
         num_trees=args.num_trees,
         max_depth=args.max_depth,
         learning_rate=args.learning_rate,
@@ -104,7 +132,8 @@ def main() -> int:
     log_every = max(1, args.num_trees // 5)
     t0 = time.time()
     if args.synthetic or not args.uri:
-        x, y = _synthetic()
+        x, y = _synthetic_multiclass(args.num_class) if softmax \
+            else _synthetic()
         if mesh:
             n = (x.shape[0] // args.dp) * args.dp
             x, y = x[:n], y[:n]
@@ -123,7 +152,8 @@ def main() -> int:
         dt = time.time() - t0  # fit only — the eval reload isn't training
         x, y = _load_dense(args.uri, args.num_features, 0, 1)
     prob = learner.predict(x)
-    acc = float(np.mean((prob > 0.5) == (y > 0.5)))
+    acc = float(np.mean(prob.argmax(axis=1) == y)) if softmax \
+        else float(np.mean((prob > 0.5) == (y > 0.5)))
     print(
         f"trees={args.num_trees} depth={args.max_depth} "
         f"rows={x.shape[0]} loss {history[0]:.4f} -> {history[-1]:.4f} "

@@ -223,6 +223,17 @@ class TestBoostedTreesExample:
         assert proc.returncode == 0, proc.stderr[-800:]
         assert "histogram psum" in proc.stdout
 
+    def test_softmax_objective(self):
+        proc = _run(
+            [sys.executable,
+             os.path.join(REPO, "examples", "boosted_trees.py"),
+             "--synthetic", "--objective", "softmax",
+             "--num-trees", "6", "--max-depth", "4"],
+            timeout=280,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "train-acc" in proc.stdout
+
     def test_libsvm_uri_input(self, tmp_path):
         """A parser uri feeds the hist-mode materialization path."""
         svm = tmp_path / "g.svm"
