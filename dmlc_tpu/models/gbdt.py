@@ -312,18 +312,21 @@ def _build_tree_core(xb, g, h, max_depth, num_bins, reg_lambda,
     n_leaves = 1 << max_depth
     n = xb.shape[0]
     node = jnp.zeros((n,), dtype=jnp.int32)  # id within current level
-    feats, bins = [], []
+    feats, bins, gains = [], [], []
     for depth in range(max_depth):
         n_nodes = 1 << depth
         ghist, hhist = _level_histogram(xb, node, g, h, n_nodes, num_bins)
         if psum_axis is not None:
             ghist, hhist = jax.lax.psum((ghist, hhist),
                                         axis_name=psum_axis)
-        feature, split_bin, _gain, _gt, _ht = _find_splits(
+        feature, split_bin, gain, _gt, _ht = _find_splits(
             ghist, hhist, reg_lambda, min_child_weight
         )
         feats.append(feature)
         bins.append(split_bin)
+        # realized gain per node (0 at leaf-in-place nodes): the raw
+        # material of gain-based feature importance
+        gains.append(jnp.where(feature >= 0, gain, 0.0))
         # descend: right iff this sample's bin at the split feature
         # exceeds the threshold; leaf-in-place nodes send all left
         nfeat = jnp.take(feature, node)  # [N]
@@ -346,6 +349,7 @@ def _build_tree_core(xb, g, h, max_depth, num_bins, reg_lambda,
     return (
         jnp.concatenate(feats),
         jnp.concatenate(bins),
+        jnp.concatenate(gains),
         leaf,
         node,
     )
@@ -375,7 +379,7 @@ def make_tree_builder(
         _build,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=(P(), P(), P(), P(axis)),
+        out_specs=(P(), P(), P(), P(), P(axis)),
     )
     return jax.jit(sharded)
 
@@ -416,12 +420,12 @@ def make_forest_builder(
         def body(margin, _):
             g, h, loss = _grad_loss_core(objective, margin, y, w,
                                          psum_axis)
-            feature, split_bin, leaf, node = _build_tree_core(
+            feature, split_bin, gain, leaf, node = _build_tree_core(
                 xb, g, h, max_depth, num_bins, reg_lambda,
                 min_child_weight, psum_axis,
             )
             margin = _margin_update_core(margin, leaf, node, learning_rate)
-            return margin, (feature, split_bin, leaf, loss)
+            return margin, (feature, split_bin, gain, leaf, loss)
 
         # derive the initial margin FROM y (not fresh zeros): inside
         # shard_map the scan carry must match the body output's varying
@@ -431,10 +435,11 @@ def make_forest_builder(
         if objective == "softmax":
             margin0 = margin0[:, None] * jnp.ones(
                 (num_class,), dtype=jnp.float32)
-        _, (feats, bins, leaves, losses) = jax.lax.scan(
+        _, (feats, bins, gains, leaves, losses) = jax.lax.scan(
             body, margin0, None, length=num_trees
         )
-        return {"feature": feats, "bin": bins, "leaf": leaves}, losses
+        return ({"feature": feats, "bin": bins, "gain": gains,
+                 "leaf": leaves}, losses)
 
     if mesh is None:
         return jax.jit(_forest)
@@ -816,13 +821,14 @@ class GBDTLearner:
             )
         grad_fn = self._make_grad_fn(weighted)
         update_fn = self._make_margin_update()
-        feats, bins, leaves = [], [], []
+        feats, bins, gains, leaves = [], [], [], []
         history = []
         for t in range(p.num_trees):
             g, h, mean_loss = grad_fn(margin, yd, *wargs)
-            feature, split_bin, leaf, node = self._builder(xb, g, h)
+            feature, split_bin, gain, leaf, node = self._builder(xb, g, h)
             feats.append(feature)
             bins.append(split_bin)
+            gains.append(gain)
             leaves.append(leaf)
             margin = update_fn(margin, leaf, node)
             history.append(float(mean_loss))
@@ -831,6 +837,7 @@ class GBDTLearner:
         self.trees = {
             "feature": jnp.stack(feats),
             "bin": jnp.stack(bins),
+            "gain": jnp.stack(gains),
             "leaf": jnp.stack(leaves),
         }
         return history
@@ -894,13 +901,17 @@ class GBDTLearner:
 
         check(self.trees is not None, "model not fitted")
         with create_stream(uri, "w") as out:
-            save_obj(out, {
+            payload = {
                 "param": self.param.to_dict(),
                 "edges": np.asarray(self.edges),
                 "feature": np.asarray(self.trees["feature"]),
                 "bin": np.asarray(self.trees["bin"]),
                 "leaf": np.asarray(self.trees["leaf"]),
-            })
+            }
+            if "gain" in self.trees:  # tolerant like load: a model
+                # restored from a pre-gain checkpoint must stay savable
+                payload["gain"] = np.asarray(self.trees["gain"])
+            save_obj(out, payload)
 
     def load(self, uri: str) -> None:
         from dmlc_tpu.io.filesystem import create_stream
@@ -919,3 +930,24 @@ class GBDTLearner:
             "bin": jnp.asarray(payload["bin"]),
             "leaf": jnp.asarray(payload["leaf"]),
         }
+        if "gain" in payload:  # absent in pre-gain checkpoints
+            self.trees["gain"] = jnp.asarray(payload["gain"])
+
+    def feature_importance(self, kind: str = "gain") -> np.ndarray:
+        """Per-feature importance [F] — xgboost get_score semantics:
+        ``gain`` sums each feature's realized split gains over the
+        forest; ``split`` counts its splits."""
+        check(self.trees is not None, "model not fitted")
+        check(kind in ("gain", "split"), "kind must be gain or split")
+        feats = np.asarray(self.trees["feature"]).ravel()
+        if kind == "split":
+            vals = np.ones_like(feats, dtype=np.float32)
+        else:
+            check("gain" in self.trees,
+                  "checkpoint predates gain recording — refit for "
+                  "gain importance (split importance still works)")
+            vals = np.asarray(self.trees["gain"]).ravel()
+        mask = feats >= 0
+        out = np.zeros(self.edges.shape[0], dtype=np.float32)
+        np.add.at(out, feats[mask], vals[mask])
+        return out

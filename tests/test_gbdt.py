@@ -199,6 +199,48 @@ class TestBoosting:
             np.asarray(loop.trees["leaf"]), rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(h_scan, h_loop, rtol=1e-5)
 
+    def test_pre_gain_checkpoint_stays_usable(self, tmp_path):
+        """A checkpoint without the gain arrays (pre-gain writer) must
+        load, predict, re-save, and give split importance — only gain
+        importance errors, cleanly."""
+        from dmlc_tpu.utils.logging import DMLCError
+
+        x, y = _synthetic(n=256, f=4)
+        a = GBDTLearner(num_trees=3, max_depth=3, num_bins=8)
+        a.fit(x, y)
+        del a.trees["gain"]  # simulate the old writer
+        old_uri = str(tmp_path / "old.bin")
+        a.save(old_uri)
+        b = GBDTLearner()
+        b.load(old_uri)
+        np.testing.assert_array_equal(b.predict(x), a.predict(x))
+        assert b.feature_importance("split").shape == (4,)
+        with pytest.raises(DMLCError):
+            b.feature_importance("gain")
+        b.save(str(tmp_path / "resaved.bin"))  # must not KeyError
+
+    def test_feature_importance(self):
+        """The synthetic signal lives in features 0-2; importance must
+        rank them above the noise features, in both kinds, on both
+        build paths."""
+        x, y = _synthetic(n=2048, f=8)
+        learner = GBDTLearner(num_trees=10, max_depth=4,
+                              learning_rate=0.5, num_bins=32)
+        learner.fit(x, y)
+        for kind in ("gain", "split"):
+            imp = learner.feature_importance(kind)
+            assert imp.shape == (8,)
+            assert np.all(imp >= 0)
+            signal = imp[:3].sum()
+            noise = imp[3:].sum()
+            assert signal > noise, (kind, imp)
+        loop = GBDTLearner(num_trees=10, max_depth=4,
+                           learning_rate=0.5, num_bins=32)
+        loop.fit(x, y, log_every=99)
+        np.testing.assert_allclose(
+            loop.feature_importance("gain"),
+            learner.feature_importance("gain"), rtol=1e-4, atol=1e-5)
+
     def test_save_load_round_trip(self, tmp_path):
         x, y = _synthetic(n=1024)
         learner = GBDTLearner(num_trees=5, max_depth=3, num_bins=16)
