@@ -28,7 +28,6 @@ from dataclasses import dataclass
 from typing import Iterator, Optional
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser

@@ -396,6 +396,7 @@ def make_forest_builder(
     axis: str = "dp",
     weighted: bool = False,
     num_class: int = 0,
+    with_eval: bool = False,
 ):
     """The whole boosting loop as ONE jitted ``lax.scan`` over trees.
 
@@ -409,15 +410,30 @@ def make_forest_builder(
     ``predict_trees`` consumes. One dispatch per fit; XLA sees the whole
     forest and schedules/fuses across the per-tree stages.
 
-    Returns jitted ``(xb, y[, w]) → (trees_dict, history [T])`` — the
-    trailing instance-weight array only when ``weighted``.
+    Returns jitted ``(xb, y[, w][, xe, ye]) → (trees_dict, history [T]
+    [, eval_history [T]])`` — the instance-weight array only when
+    ``weighted``; the binned eval set (+ per-tree post-update eval
+    losses in the output, the xgboost watchlist) only when
+    ``with_eval`` (mesh builds don't take an eval set — evaluate the
+    replicated model after fit instead).
     """
     psum_axis = axis if mesh is not None else None
+    offsets = jnp.asarray(_tree_level_offsets(max_depth), dtype=jnp.int32)
 
-    def _forest(xb, y, *maybe_w):
-        w = maybe_w[0] if weighted else None
+    def _forest(xb, y, *rest):
+        i = 0
+        w = rest[i] if weighted else None
+        i += 1 if weighted else 0
+        xe, ye = (rest[i], rest[i + 1]) if with_eval else (None, None)
 
-        def body(margin, _):
+        def _zero_margin(ref):
+            m = jnp.zeros_like(ref)
+            if objective == "softmax":
+                m = m[:, None] * jnp.ones((num_class,), dtype=jnp.float32)
+            return m
+
+        def body(carry, _):
+            margin, vmargin = carry
             g, h, loss = _grad_loss_core(objective, margin, y, w,
                                          psum_axis)
             feature, split_bin, gain, leaf, node = _build_tree_core(
@@ -425,24 +441,38 @@ def make_forest_builder(
                 min_child_weight, psum_axis,
             )
             margin = _margin_update_core(margin, leaf, node, learning_rate)
-            return margin, (feature, split_bin, gain, leaf, loss)
+            if with_eval:
+                vnode = _descend_tree(xe, feature, split_bin, max_depth,
+                                      offsets)
+                vmargin = _margin_update_core(vmargin, leaf, vnode,
+                                              learning_rate)
+                # post-update loss: "how good is the forest so far on
+                # held-out data" — the watchlist quantity
+                vloss = jnp.mean(_loss(objective, vmargin, ye))
+            else:
+                vloss = loss  # unused; keeps the scan ys uniform
+            return (margin, vmargin), (
+                feature, split_bin, gain, leaf, loss, vloss)
 
         # derive the initial margin FROM y (not fresh zeros): inside
         # shard_map the scan carry must match the body output's varying
         # manual axes, and only values computed from the sharded operand
         # carry that type
-        margin0 = jnp.zeros_like(y)
-        if objective == "softmax":
-            margin0 = margin0[:, None] * jnp.ones(
-                (num_class,), dtype=jnp.float32)
-        _, (feats, bins, gains, leaves, losses) = jax.lax.scan(
-            body, margin0, None, length=num_trees
+        vmargin0 = _zero_margin(ye) if with_eval else jnp.zeros(())
+        _, (feats, bins, gains, leaves, losses, vlosses) = jax.lax.scan(
+            body, (_zero_margin(y), vmargin0), None, length=num_trees
         )
-        return ({"feature": feats, "bin": bins, "gain": gains,
-                 "leaf": leaves}, losses)
+        trees = {"feature": feats, "bin": bins, "gain": gains,
+                 "leaf": leaves}
+        if with_eval:
+            return trees, losses, vlosses
+        return trees, losses
 
     if mesh is None:
         return jax.jit(_forest)
+    check(not with_eval,
+          "mesh forest builds don't take an eval set — evaluate the "
+          "replicated model after fit")
     data_specs = (P(axis), P(axis)) + ((P(axis),) if weighted else ())
     sharded = jax.shard_map(
         _forest,
@@ -458,6 +488,22 @@ def _tree_level_offsets(max_depth: int) -> np.ndarray:
     return np.cumsum([0] + [1 << d for d in range(max_depth)])[:-1]
 
 
+def _descend_tree(xb, feature, split_bin, max_depth, offsets):
+    """Leaf index [N] for binned rows under one tree's flat arrays —
+    the D-gather descent shared by prediction and eval-set tracking."""
+    node = jnp.zeros((xb.shape[0],), dtype=jnp.int32)
+    for depth in range(max_depth):
+        idx = offsets[depth] + node
+        nfeat = jnp.take(feature, idx)
+        nbin = jnp.take(split_bin, idx)
+        fval = jnp.take_along_axis(
+            xb, jnp.maximum(nfeat, 0)[:, None], axis=1
+        )[:, 0]
+        go_right = (nfeat >= 0) & (fval > nbin)
+        node = node * 2 + go_right.astype(jnp.int32)
+    return node
+
+
 def predict_trees(trees: Dict, xb, max_depth: int):
     """Sum of leaf values over all trees for binned rows xb [N, F].
 
@@ -469,16 +515,7 @@ def predict_trees(trees: Dict, xb, max_depth: int):
     offsets = jnp.asarray(_tree_level_offsets(max_depth), dtype=jnp.int32)
 
     def one_tree(feature, split_bin, leaf):
-        node = jnp.zeros((xb.shape[0],), dtype=jnp.int32)
-        for depth in range(max_depth):
-            idx = offsets[depth] + node
-            nfeat = jnp.take(feature, idx)
-            nbin = jnp.take(split_bin, idx)
-            fval = jnp.take_along_axis(
-                xb, jnp.maximum(nfeat, 0)[:, None], axis=1
-            )[:, 0]
-            go_right = (nfeat >= 0) & (fval > nbin)
-            node = node * 2 + go_right.astype(jnp.int32)
+        node = _descend_tree(xb, feature, split_bin, max_depth, offsets)
         return jnp.take(leaf, node, axis=0)
 
     per_tree = jax.vmap(one_tree)(
@@ -508,6 +545,9 @@ class GBDTLearner:
         self._builder = None
         self._forest = None  # fused lax.scan boosting loop (default path)
         self._engine = None  # multi-process row-count sync, lazy
+        self._eval_step = None  # cached watchlist step (loop path)
+        self.eval_history: Optional[list] = None  # per-tree eval_set loss
+        self.best_iteration: Optional[int] = None  # its argmin (0-based)
 
     # ---- fit -----------------------------------------------------------
     def _local_shards(self) -> int:
@@ -570,7 +610,8 @@ class GBDTLearner:
 
     def fit(self, x: np.ndarray, y: np.ndarray, log_every: int = 0,
             edges: Optional[np.ndarray] = None,
-            weight: Optional[np.ndarray] = None):
+            weight: Optional[np.ndarray] = None,
+            eval_set: Optional[tuple] = None):
         """Train on an in-memory dense [N, F] float matrix. Returns the
         per-tree weighted mean loss history (evaluated pre-update, so
         entry 0 is the base-margin loss).
@@ -578,6 +619,13 @@ class GBDTLearner:
         ``weight`` [N] scales each row's (g, h) — xgboost's instance
         weights: a weight-2 row trains exactly like two copies of it
         (histograms, split gains, leaf values; proven by test).
+
+        ``eval_set=(x_val, y_val)`` tracks the held-out loss after every
+        tree (the xgboost watchlist) INSIDE the fused scan — no extra
+        dispatches; afterwards ``self.eval_history`` holds the per-tree
+        losses and ``self.best_iteration`` the argmin, which
+        :meth:`truncate` can cut the forest back to. Single-process only
+        (evaluate a replicated mesh model after fit instead).
 
         Multi-process meshes: ``x``/``y`` are this process's LOCAL rows,
         and every process must pass IDENTICAL ``edges`` (bin boundaries
@@ -594,6 +642,15 @@ class GBDTLearner:
         if weight is not None:
             weight = np.asarray(weight, dtype=np.float32)
             check(weight.shape == y.shape, "weight must be [N]")
+        if eval_set is not None:
+            check(self.mesh is None,
+                  "eval_set requires mesh=None (evaluate the replicated "
+                  "model after a mesh fit)")
+            xe = np.asarray(eval_set[0], dtype=np.float32)
+            ye = np.asarray(eval_set[1], dtype=np.float32)
+            check(xe.ndim == 2 and xe.shape[1] == x.shape[1]
+                  and ye.shape == (xe.shape[0],),
+                  "eval_set must be (x_val [Ne, F], y_val [Ne])")
         multiprocess = self.mesh is not None and jax.process_count() > 1
         if multiprocess:
             check(edges is not None,
@@ -619,8 +676,12 @@ class GBDTLearner:
         # apply_bins already lives on device; _fit_binned's jnp.asarray
         # is a no-op there (a np.asarray round trip would D2H+H2D the
         # whole matrix for nothing)
+        eval_xb = eval_y = None
+        if eval_set is not None:
+            eval_xb = apply_bins(xe, self.edges)
+            eval_y = ye
         return self._fit_binned(apply_bins(x, self.edges), y, log_every,
-                                weight)
+                                weight, eval_xb, eval_y)
 
     def fit_uri(
         self,
@@ -750,7 +811,8 @@ class GBDTLearner:
         return self._fit_binned(xb, y, log_every, weight)
 
     def _fit_binned(self, xb: np.ndarray, y: np.ndarray, log_every: int,
-                    weight: Optional[np.ndarray] = None):
+                    weight: Optional[np.ndarray] = None,
+                    eval_xb=None, eval_y=None):
         from dmlc_tpu.utils.logging import log_info
 
         p = self.param
@@ -760,12 +822,14 @@ class GBDTLearner:
             # all-zero rows and train a NaN model otherwise)
             check(p.num_class >= 2,
                   "objective=softmax requires num_class >= 2")
-            y_arr = np.asarray(y)
-            check(len(y_arr) == 0 or (
-                float(y_arr.min()) >= 0
-                and float(y_arr.max()) < p.num_class),
-                "softmax labels must be class ids in [0, %d)",
-                p.num_class)
+            for arr, what in ((y, "softmax labels"),
+                              (eval_y, "softmax eval labels")):
+                if arr is None:
+                    continue
+                a = np.asarray(arr)
+                check(len(a) == 0 or (
+                    float(a.min()) >= 0 and float(a.max()) < p.num_class),
+                    "%s must be class ids in [0, %d)", what, p.num_class)
         weighted = weight is not None
         multiprocess = self.mesh is not None and jax.process_count() > 1
         if multiprocess:
@@ -790,19 +854,32 @@ class GBDTLearner:
                 yd = jax.device_put(yd, shard)
                 if weighted:
                     weight = jax.device_put(weight, shard)
+        with_eval = eval_xb is not None
+        if with_eval:
+            eval_xb = jnp.asarray(eval_xb)
+            eval_yd = jnp.asarray(eval_y)
+        self.eval_history = None
+        self.best_iteration = None
         wargs = (weight,) if weighted else ()
+        eargs = (eval_xb, eval_yd) if with_eval else ()
         if not log_every:
             # the default path: the WHOLE boosting loop is one lax.scan
             # dispatch (make_forest_builder) — per-tree dispatch overhead
             # retired, XLA schedules across tree stages
-            if self._forest is None or self._forest[0] != weighted:
-                self._forest = (weighted, make_forest_builder(
+            if self._forest is None or self._forest[0] != (weighted,
+                                                           with_eval):
+                self._forest = ((weighted, with_eval), make_forest_builder(
                     p.num_trees, p.max_depth, p.num_bins, p.reg_lambda,
                     p.min_child_weight, p.learning_rate, p.objective,
                     self.mesh, self.axis, weighted=weighted,
-                    num_class=p.num_class,
+                    num_class=p.num_class, with_eval=with_eval,
                 ))
-            self.trees, losses = self._forest[1](xb, yd, *wargs)
+            out = self._forest[1](xb, yd, *wargs, *eargs)
+            if with_eval:
+                self.trees, losses, vlosses = out
+                self._set_eval_history(np.asarray(vlosses))
+            else:
+                self.trees, losses = out
             return [float(v) for v in np.asarray(losses)]
         # live-logging path: one dispatch per tree so losses stream out
         # while training runs (the scan only reports at the end). Only
@@ -821,6 +898,12 @@ class GBDTLearner:
             )
         grad_fn = self._make_grad_fn(weighted)
         update_fn = self._make_margin_update()
+        if with_eval:
+            eval_step = self._make_eval_step()
+            vshape = ((len(eval_y),) if p.objective != "softmax"
+                      else (len(eval_y), p.num_class))
+            vmargin = jnp.zeros(vshape, dtype=jnp.float32)
+            vlosses = []
         feats, bins, gains, leaves = [], [], [], []
         history = []
         for t in range(p.num_trees):
@@ -832,6 +915,10 @@ class GBDTLearner:
             leaves.append(leaf)
             margin = update_fn(margin, leaf, node)
             history.append(float(mean_loss))
+            if with_eval:
+                vmargin, vloss = eval_step(eval_xb, eval_yd, feature,
+                                           split_bin, leaf, vmargin)
+                vlosses.append(float(vloss))
             if (t + 1) % log_every == 0:
                 log_info("tree %d loss %.6f", t + 1, history[-1])
         self.trees = {
@@ -840,6 +927,8 @@ class GBDTLearner:
             "gain": jnp.stack(gains),
             "leaf": jnp.stack(leaves),
         }
+        if with_eval:
+            self._set_eval_history(np.asarray(vlosses))
         return history
 
     def _make_grad_fn(self, weighted: bool = False):
@@ -924,6 +1013,7 @@ class GBDTLearner:
         # fit() after load() must rebuild them against the restored ones
         self._builder = None
         self._forest = None
+        self._eval_step = None
         self.edges = payload["edges"]
         self.trees = {
             "feature": jnp.asarray(payload["feature"]),
@@ -932,6 +1022,46 @@ class GBDTLearner:
         }
         if "gain" in payload:  # absent in pre-gain checkpoints
             self.trees["gain"] = jnp.asarray(payload["gain"])
+
+    def _make_eval_step(self):
+        """Cached jitted watchlist step for the live-logging path: the
+        eval arrays are ARGUMENTS, not closure constants (a fresh
+        closure per fit would bake [Ne, F] into the jaxpr and recompile
+        every call)."""
+        if getattr(self, "_eval_step", None) is None:
+            p = self.param
+            offsets = jnp.asarray(_tree_level_offsets(p.max_depth),
+                                  dtype=jnp.int32)
+            lr = p.learning_rate
+            objective = p.objective
+
+            @jax.jit
+            def eval_step(exb, eyd, feature, split_bin, leaf, vmargin):
+                vnode = _descend_tree(exb, feature, split_bin,
+                                      p.max_depth, offsets)
+                vmargin = _margin_update_core(vmargin, leaf, vnode, lr)
+                return vmargin, jnp.mean(_loss(objective, vmargin, eyd))
+
+            self._eval_step = eval_step
+        return self._eval_step
+
+    def _set_eval_history(self, vlosses: np.ndarray) -> None:
+        self.eval_history = [float(v) for v in vlosses]
+        self.best_iteration = int(np.argmin(vlosses))
+
+    def truncate(self, num_trees: int) -> None:
+        """Cut the forest back to its first ``num_trees`` trees — the
+        early-stopping companion to ``best_iteration`` (a scan has
+        static length, so selection happens after the fit):
+
+            learner.fit(x, y, eval_set=(xv, yv))
+            learner.truncate(learner.best_iteration + 1)
+        """
+        check(self.trees is not None, "model not fitted")
+        total = self.trees["feature"].shape[0]
+        check(1 <= num_trees <= total,
+              "num_trees must be in [1, %d]", total)
+        self.trees = {k: v[:num_trees] for k, v in self.trees.items()}
 
     def feature_importance(self, kind: str = "gain") -> np.ndarray:
         """Per-feature importance [F] — xgboost get_score semantics:

@@ -199,6 +199,50 @@ class TestBoosting:
             np.asarray(loop.trees["leaf"]), rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(h_scan, h_loop, rtol=1e-5)
 
+    def test_eval_set_tracking_and_truncate(self):
+        """The watchlist: eval loss per tree inside the fused scan; the
+        loop path must agree; truncate cuts back to best_iteration and
+        changes predictions accordingly."""
+        x, y = _synthetic(n=2048, f=6, seed=11)
+        xv, yv = _synthetic(n=512, f=6, seed=12)
+        scan = GBDTLearner(num_trees=10, max_depth=3, learning_rate=0.5,
+                           num_bins=16)
+        scan.fit(x, y, eval_set=(xv, yv))
+        assert scan.eval_history is not None
+        assert len(scan.eval_history) == 10
+        assert scan.best_iteration is not None
+        assert scan.eval_history[scan.best_iteration] == min(
+            scan.eval_history)
+        # held-out loss must actually improve on this learnable problem
+        assert scan.eval_history[-1] < 0.6931
+
+        loop = GBDTLearner(num_trees=10, max_depth=3, learning_rate=0.5,
+                           num_bins=16)
+        loop.fit(x, y, eval_set=(xv, yv), log_every=99)
+        np.testing.assert_allclose(loop.eval_history, scan.eval_history,
+                                   rtol=1e-5)
+
+        # truncate to k trees == fitting the same forest prefix
+        full_pred = scan.predict(xv)
+        scan.truncate(4)
+        assert scan.trees["feature"].shape[0] == 4
+        assert not np.allclose(scan.predict(xv), full_pred)
+        with pytest.raises(Exception):
+            scan.truncate(99)
+
+    def test_eval_set_rejects_mesh_and_bad_shapes(self):
+        from dmlc_tpu.parallel import make_mesh
+        from dmlc_tpu.utils.logging import DMLCError
+
+        x, y = _synthetic(n=512, f=4)
+        mesh = make_mesh({"dp": 8})
+        with pytest.raises(DMLCError):
+            GBDTLearner(mesh=mesh, num_trees=1).fit(
+                x, y, eval_set=(x[:64], y[:64]))
+        with pytest.raises(DMLCError):
+            GBDTLearner(num_trees=1).fit(
+                x, y, eval_set=(x[:64, :3], y[:64]))
+
     def test_pre_gain_checkpoint_stays_usable(self, tmp_path):
         """A checkpoint without the gain arrays (pre-gain writer) must
         load, predict, re-save, and give split importance — only gain
