@@ -70,6 +70,21 @@ class GBDTParam(Parameter):
         float, 1.0, lower_bound=0.0,
         description="Minimum hessian sum in a child for a split to count.",
     )
+    subsample = field(
+        float, 1.0, lower_bound=0.0, upper_bound=1.0,
+        description="Per-tree row subsampling rate (stochastic gradient "
+                    "boosting; bernoulli mask on (g, h)).",
+    )
+    colsample_bytree = field(
+        float, 1.0, lower_bound=0.0, upper_bound=1.0,
+        description="Per-tree feature subsampling rate (ceil(c*F) "
+                    "features drawn without replacement).",
+    )
+    seed = field(
+        int, 0,
+        description="PRNG seed for subsample/colsample masks "
+                    "(deterministic per (seed, tree)).",
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -245,7 +260,8 @@ def _level_histogram(xb, node, g, h, n_nodes, num_bins):
     return hist[..., :c], hist[..., c:]
 
 
-def _find_splits(ghist, hhist, reg_lambda, min_child_weight):
+def _find_splits(ghist, hhist, reg_lambda, min_child_weight,
+                 feat_mask=None):
     """Vectorized best split per node.
 
     ghist/hhist [n_nodes, F, B, C] → (feature [n_nodes], bin [n_nodes],
@@ -283,6 +299,8 @@ def _find_splits(ghist, hhist, reg_lambda, min_child_weight):
     ok = (hl_tot >= min_child_weight) & (hr_tot >= min_child_weight)
     # the last bin's "split" sends everything left — never a real split
     ok = ok.at[:, :, -1].set(False)
+    if feat_mask is not None:  # colsample: undrawn features can't split
+        ok = ok & feat_mask[None, :, None]
     gain = jnp.where(ok, gain, -jnp.inf)
     flat = gain.reshape(gain.shape[0], -1)
     best = jnp.argmax(flat, axis=1)
@@ -294,8 +312,56 @@ def _find_splits(ghist, hhist, reg_lambda, min_child_weight):
     return feature, split_bin, best_gain, gtot, htot
 
 
+def _stochastic_masks(base_key, tree_idx, n_rows, n_features, subsample,
+                      colsample, psum_axis):
+    """(row_mask [N] f32 | None, feat_mask [F] bool | None) for one tree.
+
+    Deterministic per (seed, tree): ``fold_in(key, t)`` — so the fused
+    scan and the live-logging loop produce IDENTICAL masks (proven by
+    test). The feature draw uses the pre-axis key (every shard must mask
+    the same ceil(c·F) features or their histograms disagree); the row
+    draw folds in the shard index so different shards drop different
+    rows — the distributed-bagging shape. Mesh builds therefore match
+    single-device builds only at subsample=1 (stochastic distributed
+    boosting differs by construction, as in xgboost).
+    """
+    k = jax.random.fold_in(base_key, tree_idx)
+    feat_mask = None
+    if colsample < 1.0:
+        keep = max(1, int(np.ceil(colsample * n_features)))
+        order = jax.random.permutation(jax.random.fold_in(k, 1),
+                                       n_features)
+        feat_mask = jnp.zeros((n_features,), dtype=bool).at[
+            order[:keep]].set(True)
+    row_mask = None
+    if subsample < 1.0:
+        rk = jax.random.fold_in(k, 2)
+        if psum_axis is not None:
+            rk = jax.random.fold_in(rk, jax.lax.axis_index(psum_axis))
+        row_mask = (jax.random.uniform(rk, (n_rows,))
+                    < subsample).astype(jnp.float32)
+    return row_mask, feat_mask
+
+
+def _apply_stochastic_masks(base_key, t, n_features, g, h, subsample,
+                            colsample, psum_axis):
+    """(masked g, masked h, feat_mask) for tree ``t`` — the ONE
+    application of :func:`_stochastic_masks` both the scan body and the
+    live-logging loop trace (bit-identical masks are what the
+    scan==loop forest-equivalence test enforces)."""
+    row_mask, feat_mask = _stochastic_masks(
+        base_key, t, g.shape[0], n_features, subsample, colsample,
+        psum_axis,
+    )
+    if row_mask is not None:
+        rexp = row_mask if g.ndim == 1 else row_mask[:, None]
+        g = g * rexp
+        h = h * rexp
+    return g, h, feat_mask
+
+
 def _build_tree_core(xb, g, h, max_depth, num_bins, reg_lambda,
-                     min_child_weight, psum_axis=None):
+                     min_child_weight, psum_axis=None, feat_mask=None):
     """One tree, level by level, all static shapes; traceable inside jit,
     shard_map, AND lax.scan (no Python-level data dependence).
 
@@ -320,7 +386,8 @@ def _build_tree_core(xb, g, h, max_depth, num_bins, reg_lambda,
             ghist, hhist = jax.lax.psum((ghist, hhist),
                                         axis_name=psum_axis)
         feature, split_bin, gain, _gt, _ht = _find_splits(
-            ghist, hhist, reg_lambda, min_child_weight
+            ghist, hhist, reg_lambda, min_child_weight,
+            feat_mask=feat_mask,
         )
         feats.append(feature)
         bins.append(split_bin)
@@ -362,23 +429,29 @@ def make_tree_builder(
     min_child_weight: float,
     mesh: Optional[Mesh] = None,
     axis: str = "dp",
+    with_feat_mask: bool = False,
 ):
-    """Jitted (xb, g, h) → tree arrays; the level loop is unrolled (depth
-    is a compile-time constant, ≤ 12), so one jit covers the whole build.
-    See :func:`_build_tree_core` for the encoding and mesh semantics."""
+    """Jitted (xb, g, h[, feat_mask]) → tree arrays; the level loop is
+    unrolled (depth is a compile-time constant, ≤ 12), so one jit covers
+    the whole build. See :func:`_build_tree_core` for the encoding and
+    mesh semantics; ``with_feat_mask`` adds the colsample feature mask
+    as a trailing (replicated) argument."""
 
-    def _build(xb, g, h):
+    def _build(xb, g, h, *maybe_mask):
         return _build_tree_core(
             xb, g, h, max_depth, num_bins, reg_lambda, min_child_weight,
             psum_axis=axis if mesh is not None else None,
+            feat_mask=maybe_mask[0] if with_feat_mask else None,
         )
 
     if mesh is None:
         return jax.jit(_build)
+    data_specs = (P(axis), P(axis), P(axis)) + (
+        (P(),) if with_feat_mask else ())
     sharded = jax.shard_map(
         _build,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis)),
+        in_specs=data_specs,
         out_specs=(P(), P(), P(), P(), P(axis)),
     )
     return jax.jit(sharded)
@@ -397,6 +470,9 @@ def make_forest_builder(
     weighted: bool = False,
     num_class: int = 0,
     with_eval: bool = False,
+    subsample: float = 1.0,
+    colsample: float = 1.0,
+    seed: int = 0,
 ):
     """The whole boosting loop as ONE jitted ``lax.scan`` over trees.
 
@@ -432,13 +508,22 @@ def make_forest_builder(
                 m = m[:, None] * jnp.ones((num_class,), dtype=jnp.float32)
             return m
 
-        def body(carry, _):
+        stochastic = subsample < 1.0 or colsample < 1.0
+        base_key = jax.random.PRNGKey(seed)
+
+        def body(carry, t):
             margin, vmargin = carry
             g, h, loss = _grad_loss_core(objective, margin, y, w,
                                          psum_axis)
+            feat_mask = None
+            if stochastic:
+                g, h, feat_mask = _apply_stochastic_masks(
+                    base_key, t, xb.shape[1], g, h, subsample,
+                    colsample, psum_axis,
+                )
             feature, split_bin, gain, leaf, node = _build_tree_core(
                 xb, g, h, max_depth, num_bins, reg_lambda,
-                min_child_weight, psum_axis,
+                min_child_weight, psum_axis, feat_mask=feat_mask,
             )
             margin = _margin_update_core(margin, leaf, node, learning_rate)
             if with_eval:
@@ -460,7 +545,8 @@ def make_forest_builder(
         # carry that type
         vmargin0 = _zero_margin(ye) if with_eval else jnp.zeros(())
         _, (feats, bins, gains, leaves, losses, vlosses) = jax.lax.scan(
-            body, (_zero_margin(y), vmargin0), None, length=num_trees
+            body, (_zero_margin(y), vmargin0),
+            jnp.arange(num_trees, dtype=jnp.int32)
         )
         trees = {"feature": feats, "bin": bins, "gain": gains,
                  "leaf": leaves}
@@ -873,6 +959,8 @@ class GBDTLearner:
                     p.min_child_weight, p.learning_rate, p.objective,
                     self.mesh, self.axis, weighted=weighted,
                     num_class=p.num_class, with_eval=with_eval,
+                    subsample=p.subsample,
+                    colsample=p.colsample_bytree, seed=p.seed,
                 ))
             out = self._forest[1](xb, yd, *wargs, *eargs)
             if with_eval:
@@ -891,11 +979,29 @@ class GBDTLearner:
                 shard, np.zeros(mshape, dtype=np.float32))
         else:
             margin = jnp.zeros(mshape, dtype=jnp.float32)
-        if self._builder is None:
-            self._builder = make_tree_builder(
+        stochastic = p.subsample < 1.0 or p.colsample_bytree < 1.0
+        colsample_on = p.colsample_bytree < 1.0
+        if self._builder is None or self._builder[0] != colsample_on:
+            self._builder = (colsample_on, make_tree_builder(
                 p.max_depth, p.num_bins, p.reg_lambda,
                 p.min_child_weight, self.mesh, self.axis,
-            )
+                with_feat_mask=colsample_on,
+            ))
+        if stochastic:
+            # jitted so the mask math runs with global-array semantics
+            # (an eager multiply would reject multi-process sharded g/h).
+            # Same helper + fold_in scheme as the scan body — identical
+            # masks and therefore identical forests at mesh=None (the
+            # mesh scan also folds in the shard index, which a
+            # non-shard_map jit cannot: there the two paths are both
+            # valid stochastic boosting but not mask-identical). The
+            # closure constant is a 2-int key — no recompile concern.
+            base_key = jax.random.PRNGKey(p.seed)
+            nf = int(xb.shape[1])
+            mask_step = jax.jit(
+                lambda t, g, h: _apply_stochastic_masks(
+                    base_key, t, nf, g, h, p.subsample,
+                    p.colsample_bytree, None))
         grad_fn = self._make_grad_fn(weighted)
         update_fn = self._make_margin_update()
         if with_eval:
@@ -908,7 +1014,13 @@ class GBDTLearner:
         history = []
         for t in range(p.num_trees):
             g, h, mean_loss = grad_fn(margin, yd, *wargs)
-            feature, split_bin, gain, leaf, node = self._builder(xb, g, h)
+            margs = ()
+            if stochastic:
+                g, h, feat_mask = mask_step(t, g, h)
+                if colsample_on:
+                    margs = (feat_mask,)
+            feature, split_bin, gain, leaf, node = self._builder[1](
+                xb, g, h, *margs)
             feats.append(feature)
             bins.append(split_bin)
             gains.append(gain)

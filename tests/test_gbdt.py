@@ -199,6 +199,42 @@ class TestBoosting:
             np.asarray(loop.trees["leaf"]), rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(h_scan, h_loop, rtol=1e-5)
 
+    def test_stochastic_boosting(self):
+        """subsample/colsample: deterministic per seed, different across
+        seeds, scan==loop at fixed seed, still converges, and colsample
+        restricts each tree to its drawn features."""
+        x, y = _synthetic(n=2048, f=8)
+        kw = dict(num_trees=8, max_depth=4, learning_rate=0.5,
+                  num_bins=16, subsample=0.7, colsample_bytree=0.5,
+                  seed=3)
+        a = GBDTLearner(**kw)
+        ha = a.fit(x, y)
+        assert ha[-1] < ha[0] * 0.8, ha
+        b = GBDTLearner(**kw)
+        b.fit(x, y)
+        np.testing.assert_array_equal(
+            np.asarray(a.trees["feature"]), np.asarray(b.trees["feature"]))
+        np.testing.assert_array_equal(
+            np.asarray(a.trees["leaf"]), np.asarray(b.trees["leaf"]))
+        c = GBDTLearner(**{**kw, "seed": 4})
+        c.fit(x, y)
+        assert not np.array_equal(np.asarray(a.trees["feature"]),
+                                  np.asarray(c.trees["feature"]))
+        loop = GBDTLearner(**kw)
+        loop.fit(x, y, log_every=99)
+        np.testing.assert_array_equal(
+            np.asarray(a.trees["feature"]),
+            np.asarray(loop.trees["feature"]))
+        np.testing.assert_allclose(
+            np.asarray(a.trees["leaf"]), np.asarray(loop.trees["leaf"]),
+            rtol=1e-5, atol=1e-7)
+        # colsample 0.5 of 8 features -> each tree splits on <= 4
+        # distinct features
+        feats = np.asarray(a.trees["feature"])
+        for t in range(feats.shape[0]):
+            used = set(feats[t][feats[t] >= 0].tolist())
+            assert len(used) <= 4, (t, used)
+
     def test_eval_set_tracking_and_truncate(self):
         """The watchlist: eval loss per tree inside the fused scan; the
         loop path must agree; truncate cuts back to best_iteration and
