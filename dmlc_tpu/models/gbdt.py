@@ -27,6 +27,14 @@ find:
 
 Inference is the same complete-tree descent: D gathers per tree, no
 branches, vmapped over trees.
+
+Scoping note (a deliberate semantic difference from xgboost): absent
+entries in sparse input densify to 0.0 and bin like any value — there is
+no learned per-node default direction for missing values (xgboost's
+sparsity-aware split). Dense numeric data behaves identically; highly
+sparse data where absence is informative will split differently. NaNs in
+dense input land in the last bin (searchsorted semantics), not a
+dedicated missing bin.
 """
 
 from __future__ import annotations
