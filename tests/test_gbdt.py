@@ -644,6 +644,52 @@ class TestFitUri:
         assert np.all(np.isfinite(history))
 
 
+class TestFuzz:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_random_configs_stay_finite(self, seed):
+        """Property sweep: random shapes x hyperparameters must always
+        produce finite losses, finite leaves, in-shape predictions, and
+        importance that sums over used features — no NaN escape hatches
+        at odd bin counts, depths, rates, or subsampling."""
+        rng = np.random.RandomState(seed)
+        n = int(rng.choice([64, 131, 512]))
+        f = int(rng.choice([1, 3, 17]))
+        objective = str(rng.choice(["logistic", "squared", "softmax"]))
+        k = int(rng.choice([2, 5])) if objective == "softmax" else 0
+        x = rng.randn(n, f).astype(np.float32)
+        if objective == "softmax":
+            y = rng.randint(0, k, n).astype(np.float32)
+        elif objective == "logistic":
+            y = (rng.rand(n) > 0.5).astype(np.float32)
+        else:
+            y = rng.randn(n).astype(np.float32)
+        learner = GBDTLearner(
+            objective=objective,
+            num_class=k,
+            num_trees=int(rng.choice([1, 3, 7])),
+            max_depth=int(rng.choice([1, 2, 6])),
+            learning_rate=float(rng.choice([0.01, 0.5, 1.0])),
+            num_bins=int(rng.choice([2, 7, 33])),
+            reg_lambda=float(rng.choice([0.0, 1.0, 10.0])),
+            min_child_weight=float(rng.choice([0.0, 1.0])),
+            subsample=float(rng.choice([0.5, 1.0])),
+            colsample_bytree=float(rng.choice([0.5, 1.0])),
+            seed=seed,
+        )
+        weight = (rng.rand(n).astype(np.float32) + 0.1
+                  if rng.rand() < 0.5 else None)
+        history = learner.fit(x, y, weight=weight)
+        assert np.all(np.isfinite(history)), history
+        assert np.all(np.isfinite(np.asarray(learner.trees["leaf"])))
+        probe = rng.randn(32, f).astype(np.float32)
+        pred = learner.predict(probe)
+        want_shape = (32, k) if objective == "softmax" else (32,)
+        assert pred.shape == want_shape
+        assert np.all(np.isfinite(pred))
+        imp = learner.feature_importance("split")
+        assert imp.shape == (f,) and np.all(imp >= 0)
+
+
 class TestMeshParity:
     def test_mesh_matches_single_device(self):
         """dp=8 histogram-psum build picks the same trees as the
