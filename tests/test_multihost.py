@@ -513,6 +513,67 @@ def test_two_process_mesh_trains_and_agrees(tmp_path, layout, port):
 
 @pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
                     reason="multihost tier disabled")
+def test_gbdt_three_process_world(tmp_path):
+    """world=3 (6-device global mesh): nothing in the histogram-psum or
+    row-count reconciliation may assume a two-process world or
+    power-of-two device counts."""
+    body = r'''
+import numpy as np
+
+from dmlc_tpu.models.gbdt import GBDTLearner, fit_bins
+from dmlc_tpu.parallel import data_parallel_mesh
+
+mesh = data_parallel_mesh()
+assert jax.process_count() == world == 3
+rng = np.random.RandomState(41)
+N, F = 6 * 128, 5
+x = rng.rand(N, F).astype(np.float32)
+y = (x[:, 0] > 0.5).astype(np.float32)
+edges = fit_bins(x, 8)
+part = N // world
+lo, hi = rank * part, (rank + 1) * part
+learner = GBDTLearner(mesh=mesh, num_trees=3, max_depth=3, num_bins=8,
+                      learning_rate=0.5)
+h = learner.fit(x[lo:hi], y[lo:hi], edges=edges)
+assert all(np.isfinite(h)), h
+feat = ",".join(str(int(v)) for v in
+                np.asarray(learner.trees["feature"]).ravel())
+bins = ",".join(str(int(v)) for v in
+                np.asarray(learner.trees["bin"]).ravel())
+leafsum = float(np.abs(np.asarray(learner.trees["leaf"])).sum())
+print("RESULT rank=%d feat=%s bins=%s leafsum=%.8f"
+      % (rank, feat, bins, leafsum), flush=True)
+'''
+    outs = _launch_workers(tmp_path, body, _free_port(), world=3)
+    results = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if "RESULT" in ln)
+        kv = dict(item.split("=", 1) for item in line.split()[1:])
+        results.append(kv)
+    for key in ("feat", "bins", "leafsum"):
+        assert len({r[key] for r in results}) == 1, (key, results)
+    # oracle: single-process full-data build picks the same trees —
+    # structure AND thresholds AND leaf values (a psum bug that keeps
+    # the argmax feature but shifts bins/leaves must not pass)
+    from dmlc_tpu.models.gbdt import GBDTLearner, fit_bins
+
+    rng = np.random.RandomState(41)
+    x = rng.rand(6 * 128, 5).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.float32)
+    oracle = GBDTLearner(num_trees=3, max_depth=3, num_bins=8,
+                         learning_rate=0.5)
+    oracle.fit(x, y, edges=fit_bins(x, 8))
+    assert results[0]["feat"] == ",".join(
+        str(int(v)) for v in np.asarray(oracle.trees["feature"]).ravel())
+    assert results[0]["bins"] == ",".join(
+        str(int(v)) for v in np.asarray(oracle.trees["bin"]).ravel())
+    np.testing.assert_allclose(
+        float(results[0]["leafsum"]),
+        float(np.abs(np.asarray(oracle.trees["leaf"])).sum()), rtol=2e-5)
+
+
+@pytest.mark.skipif(os.environ.get("DMLC_TPU_SKIP_MULTIHOST") == "1",
+                    reason="multihost tier disabled")
 def test_gbdt_histogram_psum_across_processes(tmp_path):
     """The distributed-xgboost shape: each process holds a row shard,
     per-level (grad, hess) histograms cross processes in one psum, and
