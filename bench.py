@@ -340,15 +340,18 @@ def _bench_nthread() -> int:
     return 1 if (os.cpu_count() or 1) <= 2 else 2
 
 
-def _timed_sgd_epochs(make_feed, size_mb, step_fn, layout, params, velocity):
+def _timed_sgd_epochs(make_feed, size_mb, step_fn, layout, params, velocity,
+                      stats_out=None):
     """TRIALS+1 timed epochs (first = warmup) through one jitted step —
-    the single timing protocol every ingest->SGD bench in this file uses."""
+    the single timing protocol every ingest->SGD bench in this file uses.
+    ``stats_out`` (a list) collects ``feed.stats()`` for each non-warmup
+    epoch — the per-stage stall breakdown next to its timing."""
     import jax
 
     from dmlc_tpu.models.linear import step_batch
 
     runs = []
-    for _ in range(TRIALS + 1):
+    for trial in range(TRIALS + 1):
         feed = make_feed()
         t0 = time.time()
         for batch in feed:
@@ -357,6 +360,8 @@ def _timed_sgd_epochs(make_feed, size_mb, step_fn, layout, params, velocity):
             )
         jax.block_until_ready(params)
         runs.append(round(size_mb / (time.time() - t0), 1))
+        if stats_out is not None and trial > 0 and hasattr(feed, "stats"):
+            stats_out.append(feed.stats())
         feed.close()
     return runs
 
@@ -548,6 +553,32 @@ def _bench_recordio_sgd(path: str) -> dict:
     }
 
 
+def _median_stall_stages(stats_list) -> dict:
+    """Median per-stage stall breakdown (seconds) over the non-warmup
+    epochs' ``DeviceFeed.stats()`` records, pool/parse counters included —
+    the 'where did the pipelined epoch's time go' artifact field."""
+    if not stats_list:
+        return {}
+    out = {}
+    for key in ("host_batch_ns", "dispatch_ns", "host_wait_ns",
+                "consume_ns"):
+        vals = [s.get(key, 0) for s in stats_list]
+        out[key.replace("_ns", "_s")] = round(
+            statistics.median(vals) / 1e9, 3)
+    pools = [s.get("pool") or {} for s in stats_list]
+    out["pool_allocated"] = int(statistics.median(
+        [p.get("allocated", 0) for p in pools]))
+    out["pool_reused"] = int(statistics.median(
+        [p.get("reused", 0) for p in pools]))
+    pipes = [s.get("pipeline") or {} for s in stats_list]
+    if any(p.get("chunks") for p in pipes):
+        out["parse_s"] = round(statistics.median(
+            [p.get("parse_ns", 0) for p in pipes]) / 1e9, 3)
+        out["parse_wait_s"] = round(statistics.median(
+            [p.get("consumer_wait_ns", 0) for p in pipes]) / 1e9, 3)
+    return out
+
+
 def _bench_device_feed(path: str) -> dict:
     """Feed-only (parse→densify→H2D) and ingest→SGD MB/s on the attached
     accelerator, median of warm passes (the jitted step persists across
@@ -614,6 +645,37 @@ def _bench_device_feed(path: str) -> dict:
         _feed, size_mb, step, "dense", params, velocity
     )
 
+    # tentpole A/B: fully-serial ingest (threaded=False parser — no parse
+    # fan-out, no host prefetch thread, one transfer in flight) vs the
+    # async pipeline (chunk-parse workers + host prefetch + transfer
+    # window 2). Same step, same data: the spread IS the overlap win, and
+    # the pipelined epochs' stage breakdown says where remaining time sat.
+    sparams = init_linear_params(29)
+    svel = {"w": jnp.zeros_like(sparams["w"]),
+            "b": jnp.zeros_like(sparams["b"])}
+    serial_spec = BatchSpec(batch_size=16384, layout="dense",
+                            num_features=29, prefetch=1)
+    serial_runs = _timed_sgd_epochs(
+        lambda: DeviceFeed(
+            create_parser(path, 0, 1, nthread=1, threaded=False),
+            serial_spec, host_prefetch=0,
+        ),
+        size_mb, step, "dense", sparams, svel,
+    )
+    pparams = init_linear_params(29)
+    pvel = {"w": jnp.zeros_like(pparams["w"]),
+            "b": jnp.zeros_like(pparams["b"])}
+    pipe_spec = BatchSpec(batch_size=16384, layout="dense",
+                          num_features=29, prefetch=2)
+    pipe_stats: list = []
+    pipe_runs = _timed_sgd_epochs(
+        lambda: DeviceFeed(
+            create_parser(path, 0, 1, nthread=max(2, nthread)),
+            pipe_spec, host_prefetch=2,
+        ),
+        size_mb, step, "dense", pparams, pvel, stats_out=pipe_stats,
+    )
+
     # the same text uri with #cachefile: epoch 1 builds a row-group cache
     # (DiskRowIter semantics, disk_row_iter.h:95-141), warm epochs stream
     # binary — the reference's own answer to per-epoch text-parse tax,
@@ -651,6 +713,11 @@ def _bench_device_feed(path: str) -> dict:
         "feed_stages": feed_stages,
         "sgd_e2e_mbps": round(statistics.median(sgd_runs[1:]), 1),
         "sgd_e2e_trials_mbps": sgd_runs[1:],
+        "sgd_e2e_serial_mbps": round(statistics.median(serial_runs[1:]), 1),
+        "sgd_e2e_serial_trials_mbps": serial_runs[1:],
+        "sgd_e2e_pipelined_mbps": round(statistics.median(pipe_runs[1:]), 1),
+        "sgd_e2e_pipelined_trials_mbps": pipe_runs[1:],
+        "pipelined_stall_stages": _median_stall_stages(pipe_stats),
         "sgd_e2e_cached_mbps": round(statistics.median(cached_runs[1:]), 1),
         "sgd_e2e_cached_trials_mbps": cached_runs[1:],
         "sgd_csr_e2e_mbps": round(statistics.median(csr_runs[1:]), 1),
@@ -753,7 +820,8 @@ def _remote_sweep(path: str) -> dict:
 _COMPACT_KEYS = (
     "recordio_ingest_mbps", "criteo_like_parse_mbps",
     "criteo_recordio_ingest_mbps", "remote_ingest_mbps",
-    "feed_dense_mbps", "sgd_e2e_mbps", "sgd_e2e_cached_mbps",
+    "feed_dense_mbps", "sgd_e2e_mbps", "sgd_e2e_serial_mbps",
+    "sgd_e2e_pipelined_mbps", "sgd_e2e_cached_mbps",
     "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
     "gbdt_fit_mrows_s",
     "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",

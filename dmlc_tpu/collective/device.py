@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dmlc_tpu.utils.jax_compat import axis_size, shard_map
+
 from dmlc_tpu.utils.logging import DMLCError
 
 
@@ -64,7 +66,7 @@ def all_gather(x, axis: str = "dp", tiled: bool = False):
 def ppermute_next(x, axis: str = "dp"):
     """Rotate shards one step around the mesh axis ring — the ICI analog of
     the tracker's ring links (tracker.py:212-225)."""
-    size = jax.lax.axis_size(axis)
+    size = axis_size(axis)
     perm = [(i, (i + 1) % size) for i in range(size)]
     return jax.lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -300,8 +302,7 @@ def make_allreduce_step(mesh: Mesh, axis: str = "dp", bucket: bool = True):
     all-reduce combiner heuristics — kept for A/B measurement
     (bench_collective.grad_bucket_metrics) and for models whose step
     already fuses everything into one psum call."""
-    shard_map = jax.shard_map
-
+    
     def _sum(grads):
         leaves, treedef = jax.tree.flatten(grads)
         if not bucket or len(leaves) <= 1:

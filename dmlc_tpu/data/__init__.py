@@ -19,6 +19,7 @@ from dmlc_tpu.data.parsers import (
     register_parser,
     PARSER_REGISTRY,
 )
+from dmlc_tpu.data.pipeline import PipelinedParser
 from dmlc_tpu.data.row_iter import (
     RowBlockIter,
     BasicRowIter,
@@ -43,6 +44,7 @@ __all__ = [
     "LibFMParser",
     "CSVParser",
     "ThreadedParser",
+    "PipelinedParser",
     "create_parser",
     "register_parser",
     "PARSER_REGISTRY",

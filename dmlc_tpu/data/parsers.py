@@ -60,6 +60,16 @@ class Parser:
         raise NotImplementedError
 
     # ---- iteration -----------------------------------------------------
+    def next_chunk(self) -> Optional[bytes]:
+        """Next raw chunk from the source (None at end), accounted in
+        ``bytes_read`` — the producer half of ``next_block``, split out so
+        the cross-chunk pipeline (data/pipeline.py) can pull chunks
+        without parsing them inline."""
+        chunk = self._source.next_chunk()
+        if chunk is not None:
+            self.bytes_read += len(chunk)
+        return chunk
+
     def _split_lines(self, chunk: bytes, nparts: int) -> List[bytes]:
         """Split a chunk at line boundaries into ~equal parts
         (text_parser.h:104-118 / BackFindEndLine :71-77)."""
@@ -78,10 +88,9 @@ class Parser:
     def next_block(self) -> Optional[RowBlock]:
         """Parse the next chunk into one RowBlock; None at end of data."""
         while True:
-            chunk = self._source.next_chunk()
+            chunk = self.next_chunk()
             if chunk is None:
                 return None
-            self.bytes_read += len(chunk)
             parts = self._split_lines(chunk, self._nthread)
             if self._pool is not None and len(parts) > 1:
                 containers = list(self._pool.map(self.parse_chunk, parts))
@@ -1055,15 +1064,22 @@ def create_parser(
     part_index: int = 0,
     num_parts: int = 1,
     data_format: str = "auto",
-    nthread: int = 2,
+    nthread: Optional[int] = None,
     threaded: bool = True,
 ) -> Parser:
     """Parser<I>::Create (src/data.cc:62-85,132-138).
 
     "auto" resolves through the ``format=`` URI arg, defaulting to libsvm.
-    The InputSplit underneath gets the default threaded-chunk prefetch, and
-    the parser itself is wrapped in ThreadedParser like the reference.
+    ``nthread=None`` resolves through the ``DMLC_TPU_NTHREAD`` knob
+    (params/knobs.py; default 2). Threaded text parsers take the
+    cross-chunk pipeline (data/pipeline.PipelinedParser: N parse workers
+    + bounded ordered queue) when the native C++ pipeline declines;
+    non-chunk parsers (registry plugins) keep the ThreadedParser block
+    prefetch.
     """
+    from dmlc_tpu.params.knobs import default_nthread
+
+    nthread = default_nthread(nthread)
     spec = URISpec(uri, part_index, num_parts)
     if data_format == "auto":
         data_format = spec.args.get("format", "libsvm")
@@ -1092,4 +1108,12 @@ def create_parser(
         seed=max(shuffle_seed, 0),
     )
     base = entry(source, spec.args, nthread)
-    return ThreadedParser(base) if threaded else base
+    if not threaded:
+        return base
+    if isinstance(base, Parser):
+        # chunk-level fan-out + ordered prefetch in one stage; the base's
+        # intra-chunk pool stays idle (ThreadPoolExecutor spawns lazily)
+        from dmlc_tpu.data.pipeline import PipelinedParser
+
+        return PipelinedParser(base, nthread=nthread)
+    return ThreadedParser(base)

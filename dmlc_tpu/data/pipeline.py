@@ -1,0 +1,159 @@
+"""Cross-chunk pipelined parsing: N workers + a bounded ordered queue.
+
+The base :class:`~dmlc_tpu.data.parsers.Parser` parallelizes WITHIN one
+chunk (split at line boundaries, pool.map, merge) and is synchronous
+ACROSS chunks: while the consumer holds block k, no part of chunk k+1 is
+being parsed. :class:`PipelinedParser` inverts that: each chunk is one
+parse task fanned over ``nthread`` workers through an
+:class:`~dmlc_tpu.io.readahead.OrderedWindow` — the bounded ordered
+queue that keeps up to ``window`` chunks in flight or buffered ahead of
+the consumer while delivering blocks strictly in chunk order. Record
+order is therefore bit-identical to serial iteration (the parity
+contract the tf.data input pipeline calls determinism, arXiv:2101.12127
+§3.2), parse of chunks k+1..k+W overlaps the consumer's work on chunk
+k, and a full queue blocks the producer side (backpressure) instead of
+growing without bound.
+
+This is the Python-stack twin of the native C++ pipeline's
+reader→workers→ordered-prefetch design (cpp/pipeline.cc): it serves the
+formats and sources the native router declines (custom registry
+parsers, mixed filesystems, native lib unavailable) with the same
+concurrency shape.
+
+Stage accounting mirrors the native pipeline's counters: ``stats()``
+reports worker parse time, consumer wait on the queue head, and chunk
+count — surfaced by ``DeviceFeed.stats()["pipeline"]`` next to the
+feed's own host/dispatch/wait split.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Optional
+
+from dmlc_tpu.data.parsers import Parser
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.io.readahead import OrderedWindow
+from dmlc_tpu.params.knobs import default_nthread
+from dmlc_tpu.utils.logging import check
+
+
+class PipelinedParser:
+    """Parse chunks of ``base`` on ``nthread`` workers, delivered in order.
+
+    ``base`` must be a :class:`Parser` (it supplies ``next_chunk`` and
+    ``parse_chunk``); construct it with ``nthread=1`` so the two levels
+    of parallelism don't nest — chunk-level fan-out replaces the
+    intra-chunk split. ``window`` bounds chunks in flight or parsed but
+    unconsumed (default 2×nthread). Exceptions raised by a worker
+    surface from ``next_block`` at the failed chunk's in-order position
+    and poison the queue; ``close`` (and ``before_first``) cancel all
+    pending work.
+    """
+
+    def __init__(
+        self,
+        base: Parser,
+        nthread: Optional[int] = None,
+        window: int = 0,
+    ):
+        check(isinstance(base, Parser),
+              "PipelinedParser requires a Parser base (got %s)",
+              type(base).__name__)
+        self._base = base
+        self._nthread = default_nthread(nthread)
+        self._window_arg = window
+        self._chunks = 0
+        self._parse_ns = 0  # summed across workers (can exceed wall time)
+        self._wait_ns = 0   # consumer blocked on the queue head
+        self._win: Optional[OrderedWindow] = None
+        self._eof = False
+        self._closed = False
+        self._open()
+
+    def _open(self) -> None:
+        self._win = OrderedWindow(
+            self._parse_timed, workers=self._nthread,
+            window=self._window_arg, name="pipelined-parse",
+        )
+        self._eof = False
+
+    def _parse_timed(self, chunk: bytes):
+        t0 = time.monotonic_ns()
+        try:
+            return self._base.parse_chunk(chunk)
+        finally:
+            self._parse_ns += time.monotonic_ns() - t0
+
+    def _fill(self) -> None:
+        """Top the window up with fresh chunks (the producer half; runs on
+        the consumer thread, so a full window — backpressure — simply
+        stops the chunk reads)."""
+        while not self._eof and self._win.free_slots > 0:
+            chunk = self._base.next_chunk()
+            if chunk is None:
+                self._eof = True
+                return
+            self._chunks += 1
+            self._win.submit(chunk)
+
+    # ---- Parser surface -------------------------------------------------
+    @property
+    def bytes_read(self) -> int:
+        return self._base.bytes_read
+
+    def next_block(self) -> Optional[RowBlock]:
+        check(not self._closed, "parser is closed")
+        while True:
+            self._fill()
+            if len(self._win) == 0:
+                return None
+            t0 = time.monotonic_ns()
+            try:
+                container = self._win.pop()
+            finally:
+                self._wait_ns += time.monotonic_ns() - t0
+            if len(container):
+                return container.to_block()
+            # empty chunk (blank lines): keep pulling
+
+    def __iter__(self) -> Iterator[RowBlock]:
+        while True:
+            block = self.next_block()
+            if block is None:
+                return
+            yield block
+
+    def before_first(self) -> None:
+        """Restart for a fresh epoch: cancel in-flight work, rewind the
+        source, reopen the window. Counters keep accumulating (like
+        ``bytes_read``: stats describe the parser's lifetime)."""
+        self._win.close()
+        self._base.before_first()
+        self._open()
+        self._closed = False
+
+    def stats(self) -> dict:
+        """Python-pipeline stage counters, shaped like the native
+        pipeline's (ns): parse = worker time (summed), consumer_wait =
+        time ``next_block`` blocked on the queue head."""
+        return {
+            "chunks": self._chunks,
+            "parse_ns": self._parse_ns,
+            "consumer_wait_ns": self._wait_ns,
+            "nthread": self._nthread,
+            "window": self._win.window if self._win is not None else 0,
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._win.close()
+        self._base.close()
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -180,10 +180,13 @@ def create_row_block_iter(
     part_index: int = 0,
     num_parts: int = 1,
     data_format: str = "auto",
-    nthread: int = 2,
+    nthread: Optional[int] = None,
 ) -> RowBlockIter:
     """RowBlockIter<I>::Create (src/data.cc:87-128): a ``#cachefile`` suffix
-    selects DiskRowIter (external memory), else BasicRowIter (in memory)."""
+    selects DiskRowIter (external memory), else BasicRowIter (in memory).
+
+    ``nthread=None`` defers to the ``DMLC_TPU_NTHREAD`` env knob
+    (params.knobs) inside create_parser."""
     spec = URISpec(uri, part_index, num_parts)
 
     def make_parser():

@@ -104,7 +104,12 @@ def _recv_arrays(sock: socket.socket) -> Optional[Dict[str, np.ndarray]]:
 
 
 class BlockService:
-    """Serve one parser's RowBlocks to N consumers, dynamically sharded."""
+    """Serve one parser's RowBlocks to N consumers, dynamically sharded.
+
+    ``parser_kwargs`` pass through to :func:`create_parser` — notably
+    ``nthread`` (parse fan-out; defaults to the ``DMLC_TPU_NTHREAD`` env
+    knob), so a URI-constructed service gets the same pipelined chunk
+    parsing as a local feed."""
 
     def __init__(
         self,

@@ -15,7 +15,7 @@ from dmlc_tpu.device.csr import (
     pad_to_bucket_sharded,
     round_up_bucket,
 )
-from dmlc_tpu.device.feed import DeviceFeed, BatchSpec
+from dmlc_tpu.device.feed import DeviceFeed, BatchSpec, FixedShapePool
 
 __all__ = [
     "DeviceCSRBatch",
@@ -25,4 +25,5 @@ __all__ = [
     "round_up_bucket",
     "DeviceFeed",
     "BatchSpec",
+    "FixedShapePool",
 ]

@@ -75,27 +75,40 @@ class DeviceCSRBatch:
         return len(self.indices)
 
 
+def _staging(pool, shape, dtype):
+    """A zeroed staging array: from the feed's FixedShapePool when given
+    (host-buffer reuse — the allocation retired, the zero-fill kept),
+    else a fresh np.zeros."""
+    if pool is None:
+        return np.zeros(shape, dtype=dtype)
+    buf = pool.acquire(shape, dtype)
+    buf.fill(0)
+    return buf
+
+
 def pad_to_bucket(
     block: RowBlock,
     batch_size: int,
     nnz_bucket: Optional[int] = None,
     nnz_floor: int = 256,
+    pool=None,
 ) -> DeviceCSRBatch:
-    """Pad a host RowBlock slice into a static-shape DeviceCSRBatch."""
+    """Pad a host RowBlock slice into a static-shape DeviceCSRBatch.
+    ``pool`` (device/feed.FixedShapePool) recycles the staging arrays."""
     n = len(block)
     check(n <= batch_size, "block larger than batch_size")
     nnz = block.num_nonzero
     bucket = nnz_bucket if nnz_bucket is not None else round_up_bucket(nnz, nnz_floor)
     check(nnz <= bucket, "nnz exceeds bucket")
 
-    labels = np.zeros(batch_size, dtype=np.float32)
+    labels = _staging(pool, batch_size, np.float32)
     labels[:n] = block.label
-    weights = np.zeros(batch_size, dtype=np.float32)
+    weights = _staging(pool, batch_size, np.float32)
     weights[:n] = 1.0 if block.weight is None else block.weight
 
-    indices = np.zeros(bucket, dtype=np.int32)
-    values = np.zeros(bucket, dtype=np.float32)
-    row_ids = np.zeros(bucket, dtype=np.int32)
+    indices = _staging(pool, bucket, np.int32)
+    values = _staging(pool, bucket, np.float32)
+    row_ids = _staging(pool, bucket, np.int32)
     indices[:nnz] = block.index
     values[:nnz] = (
         np.ones(nnz, dtype=np.float32) if block.value is None else block.value
@@ -103,7 +116,11 @@ def pad_to_bucket(
     row_ids[:nnz] = np.repeat(
         np.arange(n, dtype=np.int32), np.diff(block.offset).astype(np.int64)
     )
-    offsets = np.full(batch_size + 1, nnz, dtype=np.int32)
+    if pool is None:
+        offsets = np.full(batch_size + 1, nnz, dtype=np.int32)
+    else:
+        offsets = pool.acquire(batch_size + 1, np.int32)
+        offsets.fill(nnz)
     offsets[: n + 1] = np.asarray(block.offset[: n + 1], dtype=np.int32)
     return DeviceCSRBatch(
         labels=labels,
@@ -222,14 +239,15 @@ def pad_to_bucket_sharded(
 
 
 def block_to_dense(
-    block: RowBlock, batch_size: int, num_features: int
+    block: RowBlock, batch_size: int, num_features: int, pool=None
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Densify a RowBlock into fixed [batch, num_features] — the right layout
     when the feature dim is small/dense (e.g. HIGGS's 28), letting the MXU do
-    a plain matmul instead of gather+segment-sum."""
+    a plain matmul instead of gather+segment-sum. ``pool``
+    (device/feed.FixedShapePool) recycles the staging arrays."""
     n = len(block)
     check(n <= batch_size, "block larger than batch_size")
-    x = np.zeros((batch_size, num_features), dtype=np.float32)
+    x = _staging(pool, (batch_size, num_features), np.float32)
     rows = np.repeat(np.arange(n), np.diff(block.offset).astype(np.int64))
     vals = (
         np.ones(block.num_nonzero, dtype=np.float32)
@@ -238,8 +256,8 @@ def block_to_dense(
     )
     keep = block.index < num_features
     x[rows[keep], block.index[keep]] = vals[keep]
-    labels = np.zeros(batch_size, dtype=np.float32)
+    labels = _staging(pool, batch_size, np.float32)
     labels[:n] = block.label
-    weights = np.zeros(batch_size, dtype=np.float32)
+    weights = _staging(pool, batch_size, np.float32)
     weights[:n] = 1.0 if block.weight is None else block.weight
     return x, labels, weights

@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Iterator, Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
@@ -39,6 +40,7 @@ from dmlc_tpu.device.csr import (
     pad_to_bucket,
     pad_to_bucket_sharded,
 )
+from dmlc_tpu.params.knobs import default_host_prefetch, default_prefetch
 from dmlc_tpu.utils.logging import check
 from dmlc_tpu.utils.threaded_iter import ThreadedIter
 
@@ -93,8 +95,146 @@ class BatchSpec:
     # device transfers in flight ahead of the consumer. jax dispatch is
     # async, so a deeper window hides per-batch dispatch/DMA latency (the
     # tunneled-chip profile especially) at the cost of pinning that many
-    # extra batches in HBM. 1 = the classic double-buffer.
-    prefetch: int = 1
+    # extra batches in HBM. 1 = the classic double-buffer; None resolves
+    # through the DMLC_TPU_PREFETCH knob (params/knobs.py).
+    prefetch: Optional[int] = None
+
+
+def _transfer_done(arr) -> bool:
+    """True once ``arr``'s async H2D copy no longer reads its host source
+    (jax.Array.is_ready without blocking; absent API → assume in flight)."""
+    ready = getattr(arr, "is_ready", None)
+    if ready is None:
+        return False
+    try:
+        return bool(ready())
+    except Exception:
+        return False
+
+
+class FixedShapePool:
+    """Host staging buffers keyed by (shape, dtype) bucket, reused across
+    batches.
+
+    Two jobs, per the static-shape discipline (device/csr.py header):
+
+    1. **Shape accounting.** Every ``acquire`` records its (shape, dtype)
+       key; ``shape_keys``/``stats()["shapes"]`` expose exactly the set of
+       distinct buffer shapes a feed produced — the contract a jitted
+       consumer compiles against (one trace per shape bucket, no
+       per-batch recompilation; proven by test).
+
+    2. **Buffer reuse.** With ``recycle=True`` the allocation per batch is
+       retired: ``retire(bufs, guards)`` offers a delivered batch's host
+       arrays back, and ``acquire`` hands them out again once their guard
+       device arrays report the async H2D copy complete (``is_ready``,
+       never blocking — a buffer whose transfer is still in flight is
+       simply left retired and a fresh one allocated, so the pool grows
+       to the in-flight depth and then stops allocating). ``recycle``
+       must be False when the transfer may alias the host buffer instead
+       of copying it (the cpu backend's zero-copy jit ingest,
+       ``DeviceFeed._put_tree``): there the consumer owns the buffer and
+       reuse would rewrite batches already delivered — bit-parity over
+       reuse.
+    """
+
+    # retired batches whose guards never report ready are dropped (GC'd)
+    # beyond this depth so a readiness-API-less runtime degrades to plain
+    # allocation, not a leak
+    MAX_RETIRED = 32
+
+    def __init__(self, recycle: bool = True):
+        self.recycle = recycle
+        self._free: dict = {}  # key -> [np.ndarray]
+        self._retired: deque = deque()  # (bufs, guard arrays)
+        self.allocated = 0
+        self.reused = 0
+        self._shapes: set = set()
+
+    @staticmethod
+    def _key(shape, dtype):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return (tuple(shape), np.dtype(dtype).str)
+
+    @property
+    def shape_keys(self) -> frozenset:
+        return frozenset(self._shapes)
+
+    def acquire(self, shape, dtype) -> np.ndarray:
+        key = self._key(shape, dtype)
+        self._shapes.add(key)
+        if self.recycle:
+            self._drain()
+            free = self._free.get(key)
+            if free:
+                self.reused += 1
+                return free.pop()
+        self.allocated += 1
+        return np.empty(key[0], dtype=dtype)
+
+    def retire(self, bufs, guards) -> None:
+        """Offer a delivered batch's staging buffers back, guarded by the
+        device arrays their transfer produced."""
+        if not self.recycle:
+            return
+        self._retired.append((list(bufs), list(guards)))
+        while len(self._retired) > self.MAX_RETIRED:
+            self._retired.popleft()  # degrade to allocation, never leak
+
+    def _drain(self) -> None:
+        # strictly oldest-first: a younger batch ready before an older one
+        # just waits its turn (the window is small; ordering keeps the
+        # free-list hot in cache and the logic obvious)
+        while self._retired:
+            bufs, guards = self._retired[0]
+            if not all(_transfer_done(g) for g in guards):
+                return
+            self._retired.popleft()
+            for buf in bufs:
+                self._free.setdefault(
+                    self._key(buf.shape, buf.dtype), []
+                ).append(buf)
+
+    def stats(self) -> dict:
+        return {
+            "shapes": len(self._shapes),
+            "allocated": self.allocated,
+            "reused": self.reused,
+            "pending_retire": len(self._retired),
+        }
+
+
+def stall_breakdown(stats: dict) -> str:
+    """One-line human summary of :meth:`DeviceFeed.stats` — where the
+    epoch's wall time sat (ms per stage) plus pool reuse, for fit-loop
+    logging and bench extra fields. ``host_wait`` ≈ 0 means the feed kept
+    up with the consumer; ``host_wait`` ≈ ``host_batch`` means the
+    consumer was ingest-bound."""
+    ms = 1e6
+    parts = [
+        "feed[%d batches]" % stats.get("batches", 0),
+        "host_batch %.1fms" % (stats.get("host_batch_ns", 0) / ms),
+        "dispatch %.1fms" % (stats.get("dispatch_ns", 0) / ms),
+        "host_wait %.1fms" % (stats.get("host_wait_ns", 0) / ms),
+        "consume %.1fms" % (stats.get("consume_ns", 0) / ms),
+    ]
+    pool = stats.get("pool") or {}
+    if pool.get("allocated"):
+        parts.append(
+            "pool %d shapes %d alloc %d reuse"
+            % (pool.get("shapes", 0), pool["allocated"],
+               pool.get("reused", 0))
+        )
+    pipe = stats.get("pipeline") or {}
+    if pipe.get("chunks"):
+        parts.append(
+            "parse[%d chunks x%d] %.1fms (+%.1fms wait)"
+            % (pipe["chunks"], pipe.get("nthread", 1),
+               pipe.get("parse_ns", 0) / ms,
+               pipe.get("consumer_wait_ns", 0) / ms)
+        )
+    return " | ".join(parts)
 
 
 class DeviceFeed:
@@ -115,8 +255,11 @@ class DeviceFeed:
         num_parts: int = 1,
         host_prefetch: Optional[int] = None,  # ThreadedIter queue depth
         # (host blocks); 0 = synchronous (no producer thread); None =
-        # auto: 0 on a 1-core host, else 2
+        # the DMLC_TPU_HOST_PREFETCH knob, else auto: 0 on a 1-core
+        # host, else 2
     ):
+        if host_prefetch is None:
+            host_prefetch = default_host_prefetch()
         if host_prefetch is None:
             host_prefetch = 0 if _available_cpus() <= 1 else 2
         if isinstance(source, str):
@@ -148,12 +291,21 @@ class DeviceFeed:
                     "multi-process csr feeds require an explicit "
                     "spec.nnz_bucket (auto bucketing is per-host)",
                 )
+        # the transfer window: spec value or the DMLC_TPU_PREFETCH knob
+        self._prefetch = default_prefetch(spec.prefetch)
+        # host staging buffers recycle only where the device transfer
+        # provably COPIES (accelerator H2D lands in device memory); the
+        # cpu backend may alias numpy buffers zero-copy through the jit
+        # boundary (_put_tree), where reuse would rewrite delivered
+        # batches — there the pool only does shape accounting
+        self.pool = FixedShapePool(recycle=jax.default_backend() != "cpu")
         # per-stage wall time (SURVEY §5.1: "where does feed time go?");
         # host_ns accumulates on the ThreadedIter thread, the rest on the
         # consuming thread — initialized BEFORE the producer thread starts
         self._host_ns = 0
         self._dispatch_ns = 0
         self._wait_ns = 0
+        self._consume_ns = 0
         self._batches = 0
         self._sync_host = host_prefetch <= 0
         if self._sync_host:
@@ -284,6 +436,8 @@ class DeviceFeed:
         return jax.device_put(arrays, shardings)
 
     def _to_device(self, block):
+        """→ (device batch, staging buffers to retire — () when the host
+        arrays came from the native pipeline or no pooled path)."""
         spec = self.spec
         if isinstance(block, tuple):  # native dense batch, pre-densified
             x, labels, weights, rows = block
@@ -293,13 +447,13 @@ class DeviceFeed:
                  "weight": P(self._axis)},
             )
             out["num_rows"] = rows
-            return out
+            return out, ()
         if isinstance(block, (DeviceCSRBatch, ShardedCSRBatch)):
-            return self._put_csr(block)  # native COO batch, pre-padded
+            return self._put_csr(block), ()  # native COO batch, pre-padded
         if spec.layout == "dense":
             check(spec.num_features > 0, "dense layout requires num_features")
             x, labels, weights = block_to_dense(
-                block, spec.batch_size, spec.num_features
+                block, spec.batch_size, spec.num_features, pool=self.pool
             )
             out = self._put_tree(
                 {"x": x, "label": labels, "weight": weights},
@@ -307,7 +461,7 @@ class DeviceFeed:
                  "weight": P(self._axis)},
             )
             out["num_rows"] = len(block)
-            return out
+            return out, (x, labels, weights)
         if spec.layout == "csr":
             shards = self._shards
             if shards > 1:
@@ -315,11 +469,15 @@ class DeviceFeed:
                     block, spec.batch_size, shards,
                     nnz_bucket=spec.nnz_bucket,
                 )
+                bufs = ()
             else:
                 batch = pad_to_bucket(
-                    block, spec.batch_size, nnz_bucket=spec.nnz_bucket
+                    block, spec.batch_size, nnz_bucket=spec.nnz_bucket,
+                    pool=self.pool,
                 )
-            return self._put_csr(batch)
+                bufs = (batch.labels, batch.weights, batch.indices,
+                        batch.values, batch.row_ids, batch.offsets)
+            return self._put_csr(batch), bufs
         raise ValueError(f"unknown layout {spec.layout!r}")
 
     def _put_csr(self, batch):
@@ -352,10 +510,24 @@ class DeviceFeed:
         out["num_nonzero"] = batch.num_nonzero
         return out
 
+    def _deliver(self, entry):
+        """Retire a pending batch's staging buffers (guarded by its own
+        device arrays: acquire() reuses them only once the async H2D copy
+        is done) and hand the batch to the consumer."""
+        batch, bufs = entry
+        if bufs:
+            self.pool.retire(
+                bufs, [v for v in batch.values() if isinstance(v, jax.Array)]
+            )
+        return batch
+
     def __iter__(self):
         """Yield device batches with ``spec.prefetch`` transfers in flight
-        ahead of the consumer (async dispatch pipelining)."""
-        window = max(1, int(self.spec.prefetch))
+        ahead of the consumer (async dispatch pipelining). A parser/host
+        error propagates at its in-order position after the batches before
+        it; the feed stays closeable afterwards (close() joins the
+        producer and parser threads)."""
+        window = self._prefetch
         pending = deque()
         it = iter(self._host_iter)
         while True:
@@ -376,20 +548,32 @@ class DeviceFeed:
             self._dispatch_ns += time.monotonic_ns() - t1
             self._batches += 1
             if len(pending) > window:
-                yield pending.popleft()
+                batch = self._deliver(pending.popleft())
+                t2 = time.monotonic_ns()
+                yield batch
+                self._consume_ns += time.monotonic_ns() - t2
         while pending:
-            yield pending.popleft()
+            batch = self._deliver(pending.popleft())
+            t2 = time.monotonic_ns()
+            yield batch
+            self._consume_ns += time.monotonic_ns() - t2
 
     def stats(self) -> dict:
         """Per-stage wall time (ns): host batch production (parse+densify),
-        device dispatch, and time this consumer spent waiting on the host
-        thread — plus the native pipeline's own stage counters when the
-        parser exposes them (SURVEY §5.1)."""
+        device dispatch, time this consumer spent waiting on the host
+        thread, and time the consumer held each batch (its step work) —
+        plus the staging-pool counters and the parser pipeline's own stage
+        counters when it exposes them (SURVEY §5.1). Together these
+        decompose an epoch: overlap-bound means host_wait ≈ 0 and
+        consume dominates; sum-of-stages-bound shows up as host_wait ≈
+        host_batch."""
         out = {
             "batches": self._batches,
             "host_batch_ns": self._host_ns,
             "dispatch_ns": self._dispatch_ns,
             "host_wait_ns": self._wait_ns,
+            "consume_ns": self._consume_ns,
+            "pool": self.pool.stats(),
         }
         parser_stats = getattr(self._parser, "stats", None)
         if callable(parser_stats):
@@ -406,6 +590,7 @@ class DeviceFeed:
         self._host_ns = 0
         self._dispatch_ns = 0
         self._wait_ns = 0
+        self._consume_ns = 0
         self._batches = 0
         self._host_iter.before_first()
 

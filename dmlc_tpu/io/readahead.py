@@ -55,6 +55,71 @@ class PushRejected(Exception):
     the consumer is about to see is the real diagnosis)."""
 
 
+class OrderedWindow:
+    """Bounded ordered thread-pool map: the shared concurrency core of
+    remote readahead (:func:`fetch_ordered`) and the pipelined chunk
+    parser (data/pipeline.PipelinedParser).
+
+    ``submit`` fans work onto ``workers`` threads; ``pop`` blocks on and
+    returns the OLDEST submission's result, so delivery order is exactly
+    submission order regardless of which worker finishes first. At most
+    ``window`` (default 2×workers) items are in flight or buffered —
+    the backpressure bound that keeps memory at ~window × item size. A
+    failed call raises from ``pop`` at its in-order position; ``close``
+    cancels everything still pending."""
+
+    def __init__(
+        self,
+        fn: Callable,
+        workers: int = DEFAULT_CONNECTIONS,
+        window: int = 0,
+        name: str = "readahead",
+    ):
+        self._fn = fn
+        self.workers = max(1, workers)
+        if window <= 0:
+            window = 2 * self.workers
+        self.window = max(window, self.workers)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.workers, thread_name_prefix=name
+        )
+        self._pending: deque = deque()
+        self._closed = False
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    @property
+    def free_slots(self) -> int:
+        return self.window - len(self._pending)
+
+    def submit(self, item) -> None:
+        check(not self._closed, "OrderedWindow is closed")
+        self._pending.append(self._pool.submit(self._fn, item))
+
+    def pop(self):
+        """Oldest submission's result (blocks). Errors re-raise here, in
+        order, and poison the window: everything behind the failure is
+        cancelled so a consumer that catches and retries cannot observe
+        out-of-order survivors."""
+        fut = self._pending.popleft()
+        try:
+            return fut.result()
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        """Cancel pending work and release the pool (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending:
+            fut.cancel()
+        self._pending.clear()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
 def fetch_ordered(
     fetch: Callable,
     items: Iterable,
@@ -65,26 +130,16 @@ def fetch_ordered(
     submission order. At most ``window`` (default 2×workers) calls are in
     flight or buffered, bounding memory; a failed fetch propagates at its
     in-order position and cancels the rest."""
-    workers = max(1, workers)
-    if window <= 0:
-        window = 2 * workers
-    window = max(window, workers)
-    it = iter(items)
-    pool = concurrent.futures.ThreadPoolExecutor(
-        max_workers=workers, thread_name_prefix="readahead"
-    )
-    pending: deque = deque()
+    win = OrderedWindow(fetch, workers=workers, window=window)
     try:
-        for item in it:
-            pending.append(pool.submit(fetch, item))
-            if len(pending) >= window:
-                yield pending.popleft().result()
-        while pending:
-            yield pending.popleft().result()
+        for item in items:
+            win.submit(item)
+            if win.free_slots <= 0:
+                yield win.pop()
+        while len(win):
+            yield win.pop()
     finally:
-        for fut in pending:
-            fut.cancel()
-        pool.shutdown(wait=False, cancel_futures=True)
+        win.close()
 
 
 class RemotePartitionReader:

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dmlc_tpu.utils.jax_compat import shard_map
+
 from dmlc_tpu.models.linear import _margin_grad, step_batch
 from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
 from dmlc_tpu.params.parameter import Parameter, field
@@ -125,7 +127,7 @@ def make_fm_train_step(
         params = _apply(params, gw, gb, gv, wsum)
         return params, {"loss_sum": loss_sum, "weight_sum": wsum}
 
-    step = jax.shard_map(
+    step = shard_map(
         _sharded, mesh=mesh, in_specs=(P(), batch_specs), out_specs=(P(), P())
     )
     return jax.jit(step, donate_argnums=(0,))
@@ -156,7 +158,9 @@ class FMLearner:
             l2=self.param.l2,
         )
 
-    def fit_feed(self, feed, epochs: int = 1):
+    def fit_feed(self, feed, epochs: int = 1, log_every: int = 0):
+        """Train over a csr DeviceFeed; ``log_every`` (epochs) also logs
+        the feed's per-stage stall breakdown (device.feed.stall_breakdown)."""
         from dmlc_tpu.models.linear import EpochMetrics
 
         check(feed.spec.layout == "csr", "FM consumes csr batches")
@@ -176,6 +180,12 @@ class FMLearner:
                 )
                 acc.add(metrics)
             history.append(acc.mean_loss())
+            if log_every and (epoch + 1) % log_every == 0:
+                from dmlc_tpu.device.feed import stall_breakdown
+                from dmlc_tpu.utils.logging import log_info
+
+                log_info("fm epoch %d loss %.6f %s", epoch, history[-1],
+                         stall_breakdown(feed.stats()))
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

@@ -46,6 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu.utils.jax_compat import shard_map
+
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import check
 
@@ -129,13 +131,20 @@ def fit_bins(x, num_bins: int = 256) -> np.ndarray:
             np.asarray(x, dtype=np.float32), qs, axis=0
         ).T.astype(np.float32)  # [F, B-1]
     # strictly increasing edges keep searchsorted stable when a feature has
-    # few distinct values (ties collapse quantiles to equal cut points)
+    # few distinct values (ties collapse quantiles to equal cut points).
+    # The sequential recurrence e[b] = max(e[b], e[b-1] + d[b-1]) with
+    # d = 4·eps·max(|e|, 1) is solved in closed form: with c = exclusive
+    # cumsum of d, substituting f[b] = e[b] − c[b] turns it into
+    # f[b] = max(f[b], f[b-1]), i.e. a running maximum — one vector pass
+    # instead of a per-bin host loop (which dominated fit_bins for wide
+    # feature spaces). float64 keeps the tiny increments from rounding
+    # away inside the accumulate; strictness survives the f32 cast
+    # because each increment (4·eps·scale) exceeds f32 ulp spacing.
     eps = np.finfo(np.float32).eps
-    scale = np.maximum(np.abs(edges), 1.0)
-    for b in range(1, edges.shape[1]):
-        lo = edges[:, b - 1] + eps * 4.0 * scale[:, b - 1]
-        edges[:, b] = np.maximum(edges[:, b], lo)
-    return edges
+    e = edges.astype(np.float64)
+    d = 4.0 * eps * np.maximum(np.abs(e), 1.0)
+    c = np.cumsum(d, axis=1) - d  # exclusive prefix sum
+    return (c + np.maximum.accumulate(e - c, axis=1)).astype(np.float32)
 
 
 def apply_bins(x, edges):
@@ -248,9 +257,18 @@ def _level_histogram(xb, node, g, h, n_nodes, num_bins):
     nf = xb.shape[1]
     n_seg = n_nodes * nf * num_bins
     # the key space can exceed int32 at permitted hyperparameters (e.g.
-    # num_bins=65536, F=1024, depth≥6) — widen before it wraps negative
-    # and segment_sum silently misroutes updates
-    key_dtype = jnp.int32 if n_seg < (1 << 31) else jnp.int64
+    # num_bins=65536, F=1024, depth≥6), where the flat key would wrap
+    # negative and segment_sum silently misroutes updates. An int64
+    # fallback is NOT a fix: jax defaults to x64-disabled, so the cast
+    # would quietly truncate back to int32. Refuse loudly instead.
+    check(
+        n_seg < (1 << 31),
+        "histogram key space nodes*features*bins = %d*%d*%d = %d overflows "
+        "int32; reduce max_depth, num_bins, or the feature count "
+        "(or shard features) so the product stays below 2**31",
+        n_nodes, nf, num_bins, n_seg,
+    )
+    key_dtype = jnp.int32
     feat = jnp.arange(nf, dtype=key_dtype)[None, :]
     flat = (
         (node[:, None].astype(key_dtype) * nf + feat) * num_bins
@@ -456,7 +474,7 @@ def make_tree_builder(
         return jax.jit(_build)
     data_specs = (P(axis), P(axis), P(axis)) + (
         (P(),) if with_feat_mask else ())
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _build,
         mesh=mesh,
         in_specs=data_specs,
@@ -568,7 +586,7 @@ def make_forest_builder(
           "mesh forest builds don't take an eval set — evaluate the "
           "replicated model after fit")
     data_specs = (P(axis), P(axis)) + ((P(axis),) if weighted else ())
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _forest,
         mesh=mesh,
         in_specs=data_specs,
@@ -727,6 +745,13 @@ class GBDTLearner:
         the reference stack's analog is rabit allreducing xgboost's
         quantile sketches; compute them from a shared sample, or on rank
         0 and broadcast via the collective engine).
+
+        With a mesh AND subsample/colsample_bytree < 1, ``log_every>0``
+        trains a DIFFERENT (equally valid) forest than the default fused
+        scan: the scan's shard_map folds the shard index into the mask
+        PRNG, which the live-logging path's plain jit cannot reproduce.
+        A warning is emitted; use ``log_every=0`` when you need the
+        scan-identical model.
         """
         p = self.param
         x = np.asarray(x, dtype=np.float32)
@@ -787,6 +812,7 @@ class GBDTLearner:
         log_every: int = 0,
         drop_remainder: bool = False,
         edges: Optional[np.ndarray] = None,
+        nthread: Optional[int] = None,
     ):
         """Train from any parser uri (LibSVM text, RecordIO row groups,
         ``#cachefile``, object store) without materializing the dense
@@ -808,7 +834,8 @@ class GBDTLearner:
         front); the default raises instead of silently dropping data.
         Multi-process: each process parses its own part AND must receive
         identical ``edges=`` (see ``fit``) — passing them also skips the
-        sketch pass entirely.
+        sketch pass entirely. ``nthread`` fans chunk parsing across
+        worker threads (None → the ``DMLC_TPU_NTHREAD`` env knob).
         """
         from dmlc_tpu.data import create_parser
 
@@ -818,7 +845,7 @@ class GBDTLearner:
             check(edges is not None,
                   "multi-process fit_uri requires shared edges= (per-host "
                   "sketches would bin the same value differently)")
-        parser = create_parser(uri, part_index, num_parts)
+        parser = create_parser(uri, part_index, num_parts, nthread=nthread)
         try:
             if edges is not None:
                 self.edges = np.asarray(edges, dtype=np.float32)
@@ -1004,6 +1031,14 @@ class GBDTLearner:
             # non-shard_map jit cannot: there the two paths are both
             # valid stochastic boosting but not mask-identical). The
             # closure constant is a 2-int key — no recompile concern.
+            if self.mesh is not None:
+                from dmlc_tpu.utils.logging import log_warning
+                log_warning(
+                    "gbdt: log_every with mesh + subsample/colsample < 1 "
+                    "draws different stochastic masks than the fused-scan "
+                    "path (log_every=0), so the two settings train "
+                    "different (equally valid) forests; set log_every=0 "
+                    "for a scan-identical model")
             base_key = jax.random.PRNGKey(p.seed)
             nf = int(xb.shape[1])
             mask_step = jax.jit(
@@ -1062,7 +1097,7 @@ class GBDTLearner:
         if self.mesh is None:
             return jax.jit(_fn)
         data = (P(self.axis),) * (3 if weighted else 2)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda *args: _fn(*args, axis=self.axis),
             mesh=self.mesh,
             in_specs=data,
@@ -1077,7 +1112,7 @@ class GBDTLearner:
 
         if self.mesh is None:
             return jax.jit(_fn)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             _fn, mesh=self.mesh,
             in_specs=(P(self.axis), P(), P(self.axis)),
             out_specs=P(self.axis),

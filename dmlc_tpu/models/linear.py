@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu.utils.jax_compat import shard_map
+
 from dmlc_tpu.ops.objectives import margin_loss_grad
 from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
 from dmlc_tpu.params.parameter import Parameter, field
@@ -282,7 +284,7 @@ def make_linear_train_step(
         params, velocity = _apply(params, velocity, gw, gb, wsum)
         return params, velocity, {"loss_sum": loss_sum, "weight_sum": wsum}
 
-    step = jax.shard_map(
+    step = shard_map(
         _sharded,
         mesh=mesh,
         in_specs=(P(), P(), batch_specs),
@@ -339,7 +341,7 @@ def make_feature_sharded_train_step(
         return new_params, {"loss_sum": loss_sum, "weight_sum": wsum}
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             _step,
             mesh=mesh,
             in_specs=({"w": P(mp), "b": P()}, P(dp, mp), P(dp), P(dp)),
@@ -446,6 +448,10 @@ class LinearLearner:
                         epoch, nstep, acc.mean_loss(),
                     )
             history.append(acc.mean_loss())
+            if log_every:
+                from dmlc_tpu.device.feed import stall_breakdown
+
+                log_info("epoch %d %s", epoch, stall_breakdown(feed.stats()))
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

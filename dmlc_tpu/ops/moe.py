@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu.utils.jax_compat import shard_map
+
 from dmlc_tpu.utils.logging import check
 
 
@@ -183,7 +185,7 @@ def make_moe_layer(
     # batch_axis composes dp on a multi-axis mesh (each dp-shard routes
     # its own tokens; expert weights stay replicated across dp)
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             _local,
             mesh=mesh,
             in_specs=(

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu.utils.jax_compat import axis_size, pcast, shard_map
+
 from dmlc_tpu.utils.logging import check
 
 
@@ -65,16 +67,16 @@ def make_pipeline(
 
     def _local(params, x):
         idx = jax.lax.axis_index(axis)
-        size = jax.lax.axis_size(axis)
+        size = axis_size(axis)
         batch = x.shape[0]
         mb = batch // m
         micro = x.reshape(m, mb, *x.shape[1:])
         # pcast-to-varying: the scan outputs vary over the axis, so the
         # initial carries must too (same trick as the ring-attention scan)
-        state = jax.lax.pcast(
+        state = pcast(
             jnp.zeros_like(micro[0]), axis, to="varying"
         )  # activation arriving from my left
-        outputs = jax.lax.pcast(jnp.zeros_like(micro), axis, to="varying")
+        outputs = pcast(jnp.zeros_like(micro), axis, to="varying")
         perm = [(i, i + 1) for i in range(size - 1)]  # forward handoff
 
         def tick(carry, t):
@@ -119,7 +121,7 @@ def make_pipeline(
     # batch_axis composes dp: each dp-shard streams its own microbatches
     # through the same per-device stages
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(axis), P(batch_axis)),

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dmlc_tpu.utils.jax_compat import axis_size, pcast, shard_map
+
 from dmlc_tpu.utils.logging import check
 
 _NEG_INF = -1e30  # mask value: large-negative beats -inf (0*inf=nan in bwd)
@@ -194,7 +196,7 @@ def make_ring_attention(
     zigzag = layout == "zigzag"
 
     def _local(q, k, v):
-        size = jax.lax.axis_size(axis)
+        size = axis_size(axis)
         idx = jax.lax.axis_index(axis)
         b, t_local, h, d = q.shape
         scale = 1.0 / jnp.sqrt(float(d))
@@ -220,11 +222,11 @@ def make_ring_attention(
         # pcast-to-varying: fresh constants enter the scan carry as
         # device-varying values (the step output varies over the axis)
 
-        m = jax.lax.pcast(
+        m = pcast(
             jnp.full((b, h, t_local), _NEG_INF, dtype=q.dtype),
             axis, to="varying",
         )
-        l = jax.lax.pcast(
+        l = pcast(
             jnp.zeros((b, h, t_local), dtype=q.dtype), axis, to="varying"
         )
         o = jnp.zeros_like(q)
@@ -323,7 +325,7 @@ def make_ring_attention(
     # dp-shard runs its own independent ring — no cross-talk)
     spec = P(batch_axis, axis)
     _sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             _local,
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -405,7 +407,7 @@ def make_ulysses_attention(
 
     u_spec = P(batch_axis, axis)
     _sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             _local,
             mesh=mesh,
             in_specs=(u_spec, u_spec, u_spec),

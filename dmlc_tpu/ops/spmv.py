@@ -19,6 +19,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from dmlc_tpu.utils.jax_compat import shard_map
+
 
 def expand_row_ids(offsets, nnz: int):
     """[rows + 1] CSR offsets → [nnz] COO row ids, on device.
@@ -87,7 +89,7 @@ def make_sharded_spmv(mesh, num_rows: int, axis: str = "dp"):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P()),

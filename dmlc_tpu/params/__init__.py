@@ -10,6 +10,11 @@ from dmlc_tpu.params.parameter import Parameter, ParamError, field
 from dmlc_tpu.params.registry import Registry, RegistryEntry
 from dmlc_tpu.params.config import Config
 from dmlc_tpu.params.env import get_env, set_env
+from dmlc_tpu.params.knobs import (
+    default_host_prefetch,
+    default_nthread,
+    default_prefetch,
+)
 
 __all__ = [
     "Parameter",
@@ -20,4 +25,7 @@ __all__ = [
     "Config",
     "get_env",
     "set_env",
+    "default_nthread",
+    "default_prefetch",
+    "default_host_prefetch",
 ]

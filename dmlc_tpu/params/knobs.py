@@ -1,0 +1,50 @@
+"""Ingest-pipeline tuning knobs, resolved through the typed env layer.
+
+The ingest→HBM pipeline (data/pipeline.py + device/feed.py) has three
+load-bearing degrees of freedom, each exposed the reference way
+(parameter.h:1035-1063 typed GetEnv) so deployments tune them without
+code changes:
+
+- ``DMLC_TPU_NTHREAD``   — parse workers per parser (chunk fan-out width)
+- ``DMLC_TPU_PREFETCH``  — device transfers kept in flight ahead of the
+  consumer (``BatchSpec.prefetch``; 1 = classic double-buffer)
+- ``DMLC_TPU_HOST_PREFETCH`` — parsed-but-undispatched host batches the
+  feed's producer thread may buffer (-1 = auto: 0 on a 1-core host,
+  else 2 — ``DeviceFeed.host_prefetch``'s own default)
+
+Every call site that previously hard-coded a width resolves through
+these helpers, so one env var retunes the whole stack (create_parser,
+DeviceFeed, the learners' fit loops, bench.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dmlc_tpu.params.env import get_env
+
+
+def default_nthread(explicit: Optional[int] = None) -> int:
+    """Parse-worker count: the explicit argument when given, else the
+    ``DMLC_TPU_NTHREAD`` env knob, else 2 (the reference's default)."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    return max(1, get_env("DMLC_TPU_NTHREAD", 2))
+
+
+def default_prefetch(explicit: Optional[int] = None) -> int:
+    """Device-transfer window: explicit argument, else ``DMLC_TPU_PREFETCH``,
+    else 1 (double-buffer)."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    return max(1, get_env("DMLC_TPU_PREFETCH", 1))
+
+
+def default_host_prefetch(explicit: Optional[int] = None) -> Optional[int]:
+    """Host-batch queue depth: explicit argument, else
+    ``DMLC_TPU_HOST_PREFETCH`` (-1 → None → DeviceFeed's cpu-count auto),
+    else None."""
+    if explicit is not None:
+        return explicit
+    val = get_env("DMLC_TPU_HOST_PREFETCH", -1)
+    return None if val < 0 else val

@@ -1,0 +1,54 @@
+"""Version-compat shims for the jax API surface this package uses.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to
+``jax.shard_map`` in newer jax releases; the keyword signature this
+package uses (``mesh=``, ``in_specs=``, ``out_specs=``) is identical in
+both homes, so resolving the symbol once here keeps every mesh code
+path working across the versions the container may carry.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map  # jax >= 0.6
+except AttributeError:  # pragma: no cover - depends on installed jax
+    import functools
+
+    from jax.experimental import shard_map as _esm
+
+    @functools.wraps(_esm.shard_map)
+    def shard_map(f, **kwargs):
+        # newer callers say check_vma; the experimental API calls the same
+        # thing check_rep
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        # the experimental rewrite machinery chokes on symbolic-Zero
+        # cotangents (grad through a shard_map whose aux output is
+        # unused); skipping the replication check sidesteps it and only
+        # costs the rep-based transpose optimization
+        kwargs.setdefault("check_rep", False)
+        return _esm.shard_map(f, **kwargs)
+
+
+def pcast(x, axis_name, *, to):
+    """``jax.lax.pcast`` where available (the explicit replicated→varying
+    cast newer check-vma shard_map requires); identity on older jax,
+    whose shard_map tracks replication implicitly."""
+    cast = getattr(jax.lax, "pcast", None)
+    if cast is None:
+        return x
+    return cast(x, axis_name, to=to)
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` where available; otherwise the classic
+    ``psum(1, axis)`` idiom (constant-folded at trace time)."""
+    size = getattr(jax.lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+__all__ = ["shard_map", "axis_size", "pcast"]

@@ -187,14 +187,15 @@ class TestFactoryAndIters:
         parser = create_parser("mem://test/x.svm")
         # mem:// is a registered remote-style filesystem: with the native
         # library loaded it takes the push-mode native pipeline; otherwise
-        # the Python ThreadedParser stack
+        # the Python cross-chunk PipelinedParser stack
         from dmlc_tpu import native
         from dmlc_tpu.data.parsers import NativePipelineParser
+        from dmlc_tpu.data.pipeline import PipelinedParser
 
         if native.available():
             assert isinstance(parser, NativePipelineParser)
         else:
-            assert isinstance(parser, ThreadedParser)
+            assert isinstance(parser, PipelinedParser)
         total = sum(len(b) for b in parser)
         assert total == 500
 

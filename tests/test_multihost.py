@@ -329,9 +329,10 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dmlc_tpu.parallel import data_parallel_mesh
+from dmlc_tpu.utils.jax_compat import shard_map
 
 mesh = data_parallel_mesh()
-total = jax.jit(jax.shard_map(
+total = jax.jit(shard_map(
     lambda: jax.lax.psum(jnp.float32(1.0), "dp"),
     mesh=mesh, in_specs=(), out_specs=P()))()
 rabit.tracker_print(

@@ -19,6 +19,7 @@ from dmlc_tpu.models.linear import (
     make_linear_train_step,
 )
 from dmlc_tpu.parallel import make_multislice_mesh
+from dmlc_tpu.utils.jax_compat import shard_map
 
 
 def _mesh_2x4():
@@ -184,6 +185,6 @@ class TestHybridDpStep:
             return jax.lax.psum(jnp.float32(1.0), ("dcn", "dp"))
 
         total = jax.jit(
-            jax.shard_map(marker, mesh=mesh, in_specs=(), out_specs=P())
+            shard_map(marker, mesh=mesh, in_specs=(), out_specs=P())
         )()
         assert float(total) == 8.0
