@@ -1162,6 +1162,15 @@ def main() -> None:
     headline, headline_extra = _combine_headline(sweeps)
     extra = {**headline_extra, **extra}
 
+    try:
+        # whole-run obs registry dump (per-stage histograms included);
+        # detail-file only — too big for the compact stdout summary
+        from dmlc_tpu import obs
+
+        extra["metrics"] = obs.registry().snapshot()
+    except Exception as err:
+        extra["metrics_error"] = str(err)[:120]
+
     # full record to the detail file; COMPACT summary (≤2 KB) to stdout
     detail_path = os.environ.get(
         "DMLC_TPU_BENCH_DETAIL",

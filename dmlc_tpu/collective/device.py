@@ -9,6 +9,7 @@ mesh via jax.experimental.multihost_utils.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -16,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dmlc_tpu import obs
 from dmlc_tpu.utils.jax_compat import axis_size, shard_map
 
 from dmlc_tpu.utils.logging import DMLCError
@@ -124,6 +126,24 @@ class DeviceEngine:
             self._reduce_fns[op] = fn
         return fn
 
+    @staticmethod
+    def _record(what: str, nbytes: int, t0: int) -> None:
+        """Count a completed host collective in the obs registry.
+
+        Registered per call — collectives are per-step, not per-row, and
+        the registry hands back the same child for a repeated
+        (name, labels) pair."""
+        reg = obs.registry()
+        reg.counter(
+            "dmlc_collective_ops_total", "host collectives completed",
+            op=what).inc()
+        reg.counter(
+            "dmlc_collective_moved_bytes_total",
+            "payload bytes through host collectives", op=what).inc(nbytes)
+        reg.histogram(
+            "dmlc_collective_op_ns", "per-op host collective latency",
+            op=what).observe(time.monotonic_ns() - t0)
+
     def _check_live(self) -> None:
         if self._aborted:
             raise DMLCError(
@@ -168,9 +188,11 @@ class DeviceEngine:
             raise ValueError(f"unknown op {op!r}")
         if op == "bitor" and arr.dtype.kind not in "iub":
             raise TypeError(f"bitor needs an integer dtype, got {arr.dtype}")
+        t0 = time.monotonic_ns()
         if self.world_size == 1:
             # Single process owns every device: nothing to reduce across
             # processes; return as-is (matches rabit world=1 semantics).
+            self._record("allreduce", int(arr.nbytes), t0)
             return arr
         try:
             from jax.sharding import NamedSharding
@@ -179,8 +201,11 @@ class DeviceEngine:
             garr = jax.make_array_from_process_local_data(
                 sharding, arr[None], (self.world_size,) + arr.shape
             )
-            out = self._reduce_fn(op)(garr)
-            return np.asarray(out)
+            with obs.span("allreduce", op=op, nbytes=int(arr.nbytes)):
+                out = self._reduce_fn(op)(garr)
+            res = np.asarray(out)
+            self._record("allreduce", int(arr.nbytes), t0)
+            return res
         except Exception as err:  # noqa: BLE001 — backend error translation
             # deterministic user errors were screened by _validate/op-check
             # above; what reaches here is transport-shaped (ValueError
@@ -215,9 +240,12 @@ class DeviceEngine:
 
         self._check_live()
         is_root = self.rank == root
+        t0 = time.monotonic_ns()
         if self.world_size == 1:
             assert array is not None
-            return self._validate(array)
+            arr = self._validate(array)
+            self._record("broadcast", int(arr.nbytes), t0)
+            return arr
         header = np.zeros(self._HDR_SLOTS, dtype=np.int64)
         arr = header  # placeholder payload when the root's input is invalid
         root_err: Optional[Exception] = None
@@ -257,9 +285,12 @@ class DeviceEngine:
                 ndim = int(header[0])
                 shape = tuple(int(d) for d in header[1 : 1 + ndim])
                 arr = np.zeros(shape, dtype=self._DTYPE_BY_NUM[int(header[-1])])
-            return np.asarray(
-                multihost_utils.broadcast_one_to_all(arr, is_source=is_root)
-            )
+            with obs.span("broadcast", root=root, nbytes=int(arr.nbytes)):
+                out = np.asarray(
+                    multihost_utils.broadcast_one_to_all(arr, is_source=is_root)
+                )
+            self._record("broadcast", int(arr.nbytes), t0)
+            return out
         except (TypeError, ValueError) as err:
             if err is root_err or int(header[0]) < 0:
                 raise  # validated user error, already lockstep
@@ -271,11 +302,14 @@ class DeviceEngine:
         from jax.experimental import multihost_utils
 
         self._check_live()
+        t0 = time.monotonic_ns()
         if self.world_size > 1:
             try:
-                multihost_utils.sync_global_devices("dmlc_tpu_barrier")
+                with obs.span("barrier"):
+                    multihost_utils.sync_global_devices("dmlc_tpu_barrier")
             except Exception as err:  # noqa: BLE001 — backend translation
                 raise self._translate(err, "barrier") from err
+        self._record("barrier", 0, t0)
 
     def abort(self) -> None:
         """Mark the engine dead: collectives fail fast with DMLCError until

@@ -28,14 +28,18 @@ feed's own host/dispatch/wait split.
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Iterator, Optional
 
+from dmlc_tpu import obs
 from dmlc_tpu.data.parsers import Parser
 from dmlc_tpu.data.row_block import RowBlock
 from dmlc_tpu.io.readahead import OrderedWindow
 from dmlc_tpu.params.knobs import default_nthread
 from dmlc_tpu.utils.logging import check
+
+_PIPE_IDS = itertools.count()  # per-instance obs label (pipe="c0")
 
 
 class PipelinedParser:
@@ -63,10 +67,22 @@ class PipelinedParser:
         self._base = base
         self._nthread = default_nthread(nthread)
         self._window_arg = window
-        self._chunks = 0
-        self._parse_ns = 0  # summed across workers (can exceed wall time)
-        self._wait_ns = 0   # consumer blocked on the queue head
+        # stage counters live in the obs registry (docs/observability.md);
+        # parse time is summed across workers (can exceed wall time),
+        # consumer_wait is time next_block blocked on the queue head
+        pid = "c%d" % next(_PIPE_IDS)
+        reg = obs.registry()
+        self._m_chunks = reg.counter(
+            "dmlc_pipeline_chunks_total", "chunks submitted to the window",
+            pipe=pid)
+        self._h_parse = reg.histogram(
+            "dmlc_pipeline_parse_ns", "per-chunk worker parse time",
+            pipe=pid)
+        self._h_wait = reg.histogram(
+            "dmlc_pipeline_consumer_wait_ns",
+            "per-pop consumer wait on the queue head", pipe=pid)
         self._win: Optional[OrderedWindow] = None
+        self._seq = 0  # in-order chunk id (span labels), not telemetry
         self._eof = False
         self._closed = False
         self._open()
@@ -78,12 +94,14 @@ class PipelinedParser:
         )
         self._eof = False
 
-    def _parse_timed(self, chunk: bytes):
+    def _parse_timed(self, task):
+        seq, chunk = task
         t0 = time.monotonic_ns()
         try:
-            return self._base.parse_chunk(chunk)
+            with obs.span("parse", chunk=seq):
+                return self._base.parse_chunk(chunk)
         finally:
-            self._parse_ns += time.monotonic_ns() - t0
+            self._h_parse.observe(time.monotonic_ns() - t0)
 
     def _fill(self) -> None:
         """Top the window up with fresh chunks (the producer half; runs on
@@ -94,8 +112,9 @@ class PipelinedParser:
             if chunk is None:
                 self._eof = True
                 return
-            self._chunks += 1
-            self._win.submit(chunk)
+            self._m_chunks.inc()
+            self._win.submit((self._seq, chunk))
+            self._seq += 1
 
     # ---- Parser surface -------------------------------------------------
     @property
@@ -112,7 +131,7 @@ class PipelinedParser:
             try:
                 container = self._win.pop()
             finally:
-                self._wait_ns += time.monotonic_ns() - t0
+                self._h_wait.observe(time.monotonic_ns() - t0)
             if len(container):
                 return container.to_block()
             # empty chunk (blank lines): keep pulling
@@ -136,11 +155,14 @@ class PipelinedParser:
     def stats(self) -> dict:
         """Python-pipeline stage counters, shaped like the native
         pipeline's (ns): parse = worker time (summed), consumer_wait =
-        time ``next_block`` blocked on the queue head."""
+        time ``next_block`` blocked on the queue head. Timings read off
+        the obs registry children (lifetime totals, like bytes_read);
+        chunks off ``_seq`` so the count stays live under
+        DMLC_TPU_METRICS=0."""
         return {
-            "chunks": self._chunks,
-            "parse_ns": self._parse_ns,
-            "consumer_wait_ns": self._wait_ns,
+            "chunks": int(self._seq),
+            "parse_ns": int(self._h_parse.sum),
+            "consumer_wait_ns": int(self._h_wait.sum),
             "nthread": self._nthread,
             "window": self._win.window if self._win is not None else 0,
         }

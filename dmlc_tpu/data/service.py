@@ -137,18 +137,36 @@ class BlockService:
         # stack trace consumers don't need)
         self._responses_done = 0  # monotonic completed-response counter —
         # wait()'s forward-progress signal (a gauge alone cannot tell
-        # "steadily delivering" from "wedged")
+        # "steadily delivering" from "wedged"). Control-flow state, so it
+        # stays a plain int (must keep working under DMLC_TPU_METRICS=0);
+        # the obs registry carries the telemetry mirror.
         self._bytes_sent = 0  # monotonic payload bytes pushed to sockets —
         # makes an in-flight send to a slow consumer visible as progress
-        # (responses_done only ticks at completion)
-        self.blocks_served = 0
-        self.blocks_dropped = 0  # undelivered blocks still pending at
-        # close() — rows that never reached any consumer
+        # (responses_done only ticks at completion). Plain int, same
+        # reason as _responses_done.
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        # obs metrics, labeled by bound port (one label set per service)
+        from dmlc_tpu import obs
+
+        svc = str(self.address[1])
+        reg = obs.registry()
+        self._m_served = reg.counter(
+            "dmlc_service_blocks_served_total",
+            "blocks handed to a consumer", svc=svc)
+        self._m_dropped = reg.counter(
+            "dmlc_service_blocks_dropped_total",
+            "undelivered blocks at close (rows lost to the epoch)", svc=svc)
+        self._m_responses = reg.counter(
+            "dmlc_service_responses_total",
+            "responses completed (telemetry mirror of the wait() signal)",
+            svc=svc)
+        self._m_sent = reg.counter(
+            "dmlc_service_sent_bytes_total",
+            "payload bytes pushed to consumer sockets", svc=svc)
         self._threads: list = []
         self._conns: list = []
         self._accept_thread = threading.Thread(
@@ -157,6 +175,16 @@ class BlockService:
         self._accept_thread.start()
 
     # ---- server side ---------------------------------------------------
+
+    @property
+    def blocks_served(self) -> int:
+        return int(self._m_served.value)
+
+    @property
+    def blocks_dropped(self) -> int:
+        """Undelivered blocks still pending at close() — rows that never
+        reached any consumer."""
+        return int(self._m_dropped.value)
 
     def _next_block_arrays(self) -> Optional[Dict[str, np.ndarray]]:
         with self._lock:
@@ -183,7 +211,7 @@ class BlockService:
                 self._done = True
                 self._drained.set()
                 return None
-            self.blocks_served += 1
+            self._m_served.inc()
         out = {}
         for name in _BLOCK_FIELDS:
             arr = getattr(block, name)
@@ -203,6 +231,7 @@ class BlockService:
             sent = conn.send(view[: 1 << 20])
             with self._lock:
                 self._bytes_sent += sent
+            self._m_sent.inc(sent)
             view = view[sent:]
 
     def _serve_conn(self, conn: socket.socket) -> None:
@@ -233,6 +262,7 @@ class BlockService:
                 finally:
                     with self._lock:
                         self._responses_done += 1
+                    self._m_responses.inc()
         except (DMLCError, OSError):
             # consumer went away; requeue any block it never received so the
             # stream stays lossless for the remaining consumers
@@ -326,12 +356,12 @@ class BlockService:
             try:
                 if self._pending:  # redelivery never happened — those rows
                     # left the epoch; surface the loss, don't exit "clean"
-                    self.blocks_dropped += len(self._pending)
+                    self._m_dropped.inc(len(self._pending))
                     rows = sum(len(a["offset"]) - 1 for a in self._pending)
                     log_warning(
                         "block service closing with %d undelivered "
                         "block(s) (%d rows never reached a consumer)",
-                        self.blocks_dropped, rows,
+                        len(self._pending), rows,
                     )
                     self._pending.clear()
             finally:
@@ -354,9 +384,14 @@ class RemoteBlockParser:
     """
 
     def __init__(self, address: Tuple[str, int], timeout: float = 60.0):
+        from dmlc_tpu import obs
+
         self._sock = socket.create_connection(address, timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.bytes_read = 0
+        self.bytes_read = 0  # Parser API surface; obs mirror below
+        self._m_read = obs.registry().counter(
+            "dmlc_io_read_bytes_total", "payload bytes ingested by source",
+            source="service")
         self._closed = False
         self._ended = False
 
@@ -375,7 +410,9 @@ class RemoteBlockParser:
         if arrays is None:
             self._ended = True
             return None
-        self.bytes_read += sum(a.nbytes for a in arrays.values())
+        nbytes = sum(a.nbytes for a in arrays.values())
+        self.bytes_read += nbytes
+        self._m_read.inc(nbytes)
         return RowBlock(
             offset=arrays["offset"],
             label=arrays["label"],

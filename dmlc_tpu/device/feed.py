@@ -21,6 +21,7 @@ Batch layouts:
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from collections import deque
@@ -31,6 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_tpu import obs
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
 from dmlc_tpu.data.row_block import RowBlockContainer
 from dmlc_tpu.device.csr import (
@@ -43,6 +45,12 @@ from dmlc_tpu.device.csr import (
 from dmlc_tpu.params.knobs import default_host_prefetch, default_prefetch
 from dmlc_tpu.utils.logging import check
 from dmlc_tpu.utils.threaded_iter import ThreadedIter
+
+# obs label values: each feed/pool instance gets its own metric children
+# ("feed=f3"), so concurrent feeds never clobber each other's windows and
+# SPMD hosts (same construction order) produce host-comparable vectors
+_FEED_IDS = itertools.count()
+_POOL_IDS = itertools.count()
 
 
 def _available_cpus() -> int:
@@ -147,6 +155,16 @@ class FixedShapePool:
         self.recycle = recycle
         self._free: dict = {}  # key -> [np.ndarray]
         self._retired: deque = deque()  # (bufs, guard arrays)
+        pid = "p%d" % next(_POOL_IDS)
+        reg = obs.registry()
+        self._m_allocated = reg.counter(
+            "dmlc_pool_allocated_total",
+            "staging buffers newly allocated", pool=pid)
+        self._m_reused = reg.counter(
+            "dmlc_pool_reused_total",
+            "staging buffers recycled from the free list", pool=pid)
+        # plain ints next to the registry mirrors: the hit-rate surface
+        # (stats(), tests, bench) stays truthful under DMLC_TPU_METRICS=0
         self.allocated = 0
         self.reused = 0
         self._shapes: set = set()
@@ -169,8 +187,10 @@ class FixedShapePool:
             free = self._free.get(key)
             if free:
                 self.reused += 1
+                self._m_reused.inc()
                 return free.pop()
         self.allocated += 1
+        self._m_allocated.inc()
         return np.empty(key[0], dtype=dtype)
 
     def retire(self, bufs, guards) -> None:
@@ -210,7 +230,12 @@ def stall_breakdown(stats: dict) -> str:
     epoch's wall time sat (ms per stage) plus pool reuse, for fit-loop
     logging and bench extra fields. ``host_wait`` ≈ 0 means the feed kept
     up with the consumer; ``host_wait`` ≈ ``host_batch`` means the
-    consumer was ingest-bound."""
+    consumer was ingest-bound.
+
+    Purely a formatter: the numbers come from the obs registry
+    (``dmlc_feed_*`` / ``dmlc_pool_*`` / ``dmlc_pipeline_*`` metrics,
+    epoch-windowed by ``stats()`` — docs/observability.md has the name
+    table)."""
     ms = 1e6
     parts = [
         "feed[%d batches]" % stats.get("batches", 0),
@@ -299,14 +324,31 @@ class DeviceFeed:
         # boundary (_put_tree), where reuse would rewrite delivered
         # batches — there the pool only does shape accounting
         self.pool = FixedShapePool(recycle=jax.default_backend() != "cpu")
-        # per-stage wall time (SURVEY §5.1: "where does feed time go?");
-        # host_ns accumulates on the ThreadedIter thread, the rest on the
-        # consuming thread — initialized BEFORE the producer thread starts
-        self._host_ns = 0
-        self._dispatch_ns = 0
-        self._wait_ns = 0
-        self._consume_ns = 0
-        self._batches = 0
+        # per-stage wall time (SURVEY §5.1: "where does feed time go?")
+        # lives in the obs registry as per-batch histograms; the host stage
+        # observes on the ThreadedIter thread, the rest on the consuming
+        # thread — registered BEFORE the producer thread starts. stats()
+        # windows the monotonic registry totals with _epoch_base so it
+        # still describes the current epoch.
+        fid = "f%d" % next(_FEED_IDS)
+        reg = obs.registry()
+        self._stage = {
+            "host_batch_ns": reg.histogram(
+                "dmlc_feed_host_batch_ns",
+                "per-batch host production (parse + densify/pad)", feed=fid),
+            "dispatch_ns": reg.histogram(
+                "dmlc_feed_dispatch_ns",
+                "per-batch async device transfer submission", feed=fid),
+            "host_wait_ns": reg.histogram(
+                "dmlc_feed_host_wait_ns",
+                "per-batch consumer wait on the host producer", feed=fid),
+            "consume_ns": reg.histogram(
+                "dmlc_feed_consume_ns",
+                "per-batch time the consumer held the batch", feed=fid),
+        }
+        self._m_batches = reg.counter(
+            "dmlc_feed_batches_total", "device batches delivered", feed=fid)
+        self._epoch_base: dict = {}
         self._sync_host = host_prefetch <= 0
         if self._sync_host:
             # synchronous host stage: on a 1-core host the prefetch
@@ -355,7 +397,8 @@ class DeviceFeed:
             except StopIteration:
                 return
             finally:
-                self._host_ns += time.monotonic_ns() - t0
+                self._stage["host_batch_ns"].observe(
+                    time.monotonic_ns() - t0)
             yield item
 
     def _host_batches_python(self) -> Iterator:
@@ -530,33 +573,45 @@ class DeviceFeed:
         window = self._prefetch
         pending = deque()
         it = iter(self._host_iter)
-        while True:
-            t0 = time.monotonic_ns()
-            try:
-                block = next(it)
-            except StopIteration:
-                break
-            finally:
-                # sync mode has no producer thread to wait on: the time
-                # inside next() IS host production and already accrues to
-                # _host_ns — also counting it here would double-book the
-                # stage breakdown
-                if not self._sync_host:
-                    self._wait_ns += time.monotonic_ns() - t0
-            t1 = time.monotonic_ns()
-            pending.append(self._to_device(block))  # async dispatch
-            self._dispatch_ns += time.monotonic_ns() - t1
-            self._batches += 1
-            if len(pending) > window:
-                batch = self._deliver(pending.popleft())
-                t2 = time.monotonic_ns()
-                yield batch
-                self._consume_ns += time.monotonic_ns() - t2
-        while pending:
-            batch = self._deliver(pending.popleft())
+        nbatch = 0
+        ndelivered = 0
+
+        def _consume(entry):
+            nonlocal ndelivered
+            batch = self._deliver(entry)
             t2 = time.monotonic_ns()
-            yield batch
-            self._consume_ns += time.monotonic_ns() - t2
+            # the consume span covers the yield: its duration IS the time
+            # the consumer held the batch (generator suspended)
+            with obs.span("consume", batch=ndelivered):
+                yield batch
+            self._stage["consume_ns"].observe(time.monotonic_ns() - t2)
+            ndelivered += 1
+
+        while True:
+            with obs.span("feed_batch", batch=nbatch):
+                t0 = time.monotonic_ns()
+                try:
+                    block = next(it)
+                except StopIteration:
+                    break
+                finally:
+                    # sync mode has no producer thread to wait on: the time
+                    # inside next() IS host production and already accrues
+                    # to the host_batch stage — also counting it here would
+                    # double-book the stage breakdown
+                    if not self._sync_host:
+                        self._stage["host_wait_ns"].observe(
+                            time.monotonic_ns() - t0)
+                t1 = time.monotonic_ns()
+                with obs.span("dispatch", batch=nbatch):
+                    pending.append(self._to_device(block))  # async dispatch
+                self._stage["dispatch_ns"].observe(time.monotonic_ns() - t1)
+                self._m_batches.inc()
+                nbatch += 1
+            if len(pending) > window:
+                yield from _consume(pending.popleft())
+        while pending:
+            yield from _consume(pending.popleft())
 
     def stats(self) -> dict:
         """Per-stage wall time (ns): host batch production (parse+densify),
@@ -567,14 +622,13 @@ class DeviceFeed:
         decompose an epoch: overlap-bound means host_wait ≈ 0 and
         consume dominates; sum-of-stages-bound shows up as host_wait ≈
         host_batch."""
+        base = self._epoch_base
         out = {
-            "batches": self._batches,
-            "host_batch_ns": self._host_ns,
-            "dispatch_ns": self._dispatch_ns,
-            "host_wait_ns": self._wait_ns,
-            "consume_ns": self._consume_ns,
+            "batches": int(self._m_batches.value - base.get("batches", 0)),
             "pool": self.pool.stats(),
         }
+        for key, hist in self._stage.items():
+            out[key] = int(hist.sum - base.get(key, 0))
         parser_stats = getattr(self._parser, "stats", None)
         if callable(parser_stats):
             pipeline = parser_stats()
@@ -585,13 +639,14 @@ class DeviceFeed:
     def before_first(self) -> None:
         self._host_iter.close()
         self._parser.before_first()
-        # counters window-align with the native pipeline's (which reset on
-        # reopen): stats() always describes the current epoch
-        self._host_ns = 0
-        self._dispatch_ns = 0
-        self._wait_ns = 0
-        self._consume_ns = 0
-        self._batches = 0
+        # registry metrics are monotonic (Prometheus semantics); stats()
+        # windows them against this baseline so it always describes the
+        # current epoch, aligned with the native pipeline's per-reopen
+        # counters
+        self._epoch_base = {
+            key: hist.sum for key, hist in self._stage.items()
+        }
+        self._epoch_base["batches"] = self._m_batches.value
         self._host_iter.before_first()
 
     @property

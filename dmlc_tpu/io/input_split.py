@@ -34,6 +34,7 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from dmlc_tpu import obs
 from dmlc_tpu.io import recordio as _rio
 from dmlc_tpu.io.filesystem import (
     FileInfo,
@@ -49,6 +50,12 @@ from dmlc_tpu.utils.threaded_iter import ThreadedIter
 # 8 MiB chunk buffer, matching kBufferSize = 2UL<<20 uint32 words x 4 bytes
 # (src/io/input_split_base.h:39-40).
 DEFAULT_CHUNK_BYTES = (2 << 20) * 4
+
+# process-wide ingest byte counter (docs/observability.md); splits of every
+# flavor funnel raw reads through it
+_M_READ = obs.registry().counter(
+    "dmlc_io_read_bytes_total", "payload bytes ingested by source",
+    source="input_split")
 
 
 class InputSplit:
@@ -229,6 +236,7 @@ class InputSplitBase(InputSplit):
             self._file_ptr += 1
             self._close_stream()
             self._fs_stream = self._open(self._file_ptr)
+        _M_READ.inc(size - nleft)
         if len(parts) == 1:
             return parts[0]
         return b"".join(parts)
@@ -482,6 +490,7 @@ class IndexedRecordIOSplitter(InputSplitBase):
             parts.append(data)
             nleft -= len(data)
             self._offset_curr += len(data)
+        _M_READ.inc(size)
         return b"".join(parts)
 
     def next_batch(self, n_records: int) -> Optional[bytes]:
@@ -577,6 +586,7 @@ class SingleFileSplit(InputSplit):
             self._eof = True
             out, self._tail = self._tail, b""
             return out or None
+        _M_READ.inc(len(data))
         buf = self._tail + data
         pos = max(buf.rfind(b"\n"), buf.rfind(b"\r")) + 1
         if pos == 0:

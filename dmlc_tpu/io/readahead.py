@@ -75,6 +75,8 @@ class OrderedWindow:
         window: int = 0,
         name: str = "readahead",
     ):
+        from dmlc_tpu import obs  # deferred: io is a low layer
+
         self._fn = fn
         self.workers = max(1, workers)
         if window <= 0:
@@ -85,6 +87,18 @@ class OrderedWindow:
         )
         self._pending: deque = deque()
         self._closed = False
+        # process-wide stage counters (all windows share them: readahead
+        # windows are transient, and totals are what skew reports want)
+        reg = obs.registry()
+        self._m_submitted = reg.counter(
+            "dmlc_readahead_submitted_total",
+            "items submitted to ordered windows")
+        self._m_completed = reg.counter(
+            "dmlc_readahead_completed_total",
+            "items delivered in order from ordered windows")
+        self._m_cancelled = reg.counter(
+            "dmlc_readahead_cancelled_total",
+            "pending items cancelled at window close")
 
     def __len__(self) -> int:
         return len(self._pending)
@@ -95,6 +109,7 @@ class OrderedWindow:
 
     def submit(self, item) -> None:
         check(not self._closed, "OrderedWindow is closed")
+        self._m_submitted.inc()
         self._pending.append(self._pool.submit(self._fn, item))
 
     def pop(self):
@@ -104,16 +119,20 @@ class OrderedWindow:
         out-of-order survivors."""
         fut = self._pending.popleft()
         try:
-            return fut.result()
+            out = fut.result()
         except BaseException:
             self.close()
             raise
+        self._m_completed.inc()
+        return out
 
     def close(self) -> None:
         """Cancel pending work and release the pool (idempotent)."""
         if self._closed:
             return
         self._closed = True
+        if self._pending:
+            self._m_cancelled.inc(len(self._pending))
         for fut in self._pending:
             fut.cancel()
         self._pending.clear()

@@ -25,6 +25,7 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from dmlc_tpu import obs
 from dmlc_tpu.io.stream import Stream
 from dmlc_tpu.utils.logging import check
 
@@ -116,17 +117,22 @@ class RecordIOReader:
     def __init__(self, stream: Stream):
         self._stream = stream
         self._eos = False
+        self._m_read = obs.registry().counter(
+            "dmlc_io_read_bytes_total", "payload bytes ingested by source",
+            source="recordio")
 
     def next_record(self) -> Optional[bytes]:
         if self._eos:
             return None
         parts: List[bytes] = []
+        nread = 0
         while True:
             header = self._stream.read(8)
             if len(header) == 0 and not parts:
                 self._eos = True
                 return None
             check(len(header) == 8, "Invalid RecordIO file: truncated header")
+            nread += 8
             magic, lrec = struct.unpack("<II", header)
             check(magic == RECORDIO_MAGIC, "Invalid RecordIO file: bad magic")
             cflag = decode_flag(lrec)
@@ -135,9 +141,11 @@ class RecordIOReader:
             if upper:
                 payload = self._stream.read_exact(upper)
                 parts.append(payload[:length])
+                nread += upper
             if cflag in (0, 3):
                 break
             parts.append(_MAGIC_BYTES)
+        self._m_read.inc(nread)
         return b"".join(parts)
 
     def __iter__(self) -> Iterator[bytes]:

@@ -11,6 +11,7 @@ score(x) = b + Σ_i w_i x_i + ½ Σ_k [(Σ_i v_ik x_i)² − Σ_i v_ik² x_i²]
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import jax
@@ -170,22 +171,43 @@ class FMLearner:
             "feed mesh and learner mesh must match (csr entry layouts "
             "differ between mesh and single-device runs)",
         )
+        from dmlc_tpu import obs
+
+        reg = obs.registry()
+        m_steps = reg.counter(
+            "dmlc_fit_steps_total", "optimizer steps taken", model="fm")
+        m_epochs = reg.counter(
+            "dmlc_fit_epochs_total", "epochs completed", model="fm")
+        g_loss = reg.gauge(
+            "dmlc_fit_loss_value", "last epoch mean loss", model="fm")
+        h_epoch = reg.histogram(
+            "dmlc_fit_epoch_ns", "wall time per epoch", model="fm")
         history = []
         for epoch in range(epochs):
             acc = EpochMetrics()
-            for batch in feed:
-                self._ensure(self.param.num_features)
-                self.params, metrics = self._step(
-                    self.params, step_batch(batch, "csr")
-                )
-                acc.add(metrics)
-            history.append(acc.mean_loss())
+            nstep = 0
+            t0 = time.monotonic_ns()
+            with obs.span("epoch", model="fm", epoch=epoch):
+                for batch in feed:
+                    self._ensure(self.param.num_features)
+                    self.params, metrics = self._step(
+                        self.params, step_batch(batch, "csr")
+                    )
+                    acc.add(metrics)
+                    nstep += 1
+            h_epoch.observe(time.monotonic_ns() - t0)
+            m_steps.inc(nstep)
+            m_epochs.inc()
+            loss = acc.mean_loss()
+            g_loss.set(loss)
+            history.append(loss)
             if log_every and (epoch + 1) % log_every == 0:
                 from dmlc_tpu.device.feed import stall_breakdown
                 from dmlc_tpu.utils.logging import log_info
 
                 log_info("fm epoch %d loss %.6f %s", epoch, history[-1],
                          stall_breakdown(feed.stats()))
+            obs.export_epoch(reg)
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

@@ -39,6 +39,7 @@ dedicated missing bin.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import jax
@@ -934,9 +935,31 @@ class GBDTLearner:
     def _fit_binned(self, xb: np.ndarray, y: np.ndarray, log_every: int,
                     weight: Optional[np.ndarray] = None,
                     eval_xb=None, eval_y=None):
+        from dmlc_tpu import obs
         from dmlc_tpu.utils.logging import log_info
 
         p = self.param
+        # one fit = one "epoch"; trees are the steps (both the fused-scan
+        # and the live-logging path funnel their history through _obs_fit)
+        reg = obs.registry()
+        _t_fit = time.monotonic_ns()
+
+        def _obs_fit(history):
+            reg.histogram(
+                "dmlc_fit_epoch_ns", "wall time per epoch",
+                model="gbdt").observe(time.monotonic_ns() - _t_fit)
+            reg.counter(
+                "dmlc_fit_steps_total", "optimizer steps taken",
+                model="gbdt").inc(len(history))
+            reg.counter(
+                "dmlc_fit_epochs_total", "epochs completed",
+                model="gbdt").inc()
+            if history:
+                reg.gauge(
+                    "dmlc_fit_loss_value", "last epoch mean loss",
+                    model="gbdt").set(history[-1])
+            obs.export_epoch(reg)
+            return history
         if p.objective == "softmax":
             # the shared chokepoint: fit AND fit_uri funnel here, so both
             # get the clean errors (out-of-range ids silently one_hot to
@@ -997,13 +1020,14 @@ class GBDTLearner:
                     subsample=p.subsample,
                     colsample=p.colsample_bytree, seed=p.seed,
                 ))
-            out = self._forest[1](xb, yd, *wargs, *eargs)
+            with obs.span("fit", model="gbdt", trees=p.num_trees):
+                out = self._forest[1](xb, yd, *wargs, *eargs)
             if with_eval:
                 self.trees, losses, vlosses = out
                 self._set_eval_history(np.asarray(vlosses))
             else:
                 self.trees, losses = out
-            return [float(v) for v in np.asarray(losses)]
+            return _obs_fit([float(v) for v in np.asarray(losses)])
         # live-logging path: one dispatch per tree so losses stream out
         # while training runs (the scan only reports at the end). Only
         # this path carries a margin across dispatches.
@@ -1055,27 +1079,28 @@ class GBDTLearner:
             vlosses = []
         feats, bins, gains, leaves = [], [], [], []
         history = []
-        for t in range(p.num_trees):
-            g, h, mean_loss = grad_fn(margin, yd, *wargs)
-            margs = ()
-            if stochastic:
-                g, h, feat_mask = mask_step(t, g, h)
-                if colsample_on:
-                    margs = (feat_mask,)
-            feature, split_bin, gain, leaf, node = self._builder[1](
-                xb, g, h, *margs)
-            feats.append(feature)
-            bins.append(split_bin)
-            gains.append(gain)
-            leaves.append(leaf)
-            margin = update_fn(margin, leaf, node)
-            history.append(float(mean_loss))
-            if with_eval:
-                vmargin, vloss = eval_step(eval_xb, eval_yd, feature,
-                                           split_bin, leaf, vmargin)
-                vlosses.append(float(vloss))
-            if (t + 1) % log_every == 0:
-                log_info("tree %d loss %.6f", t + 1, history[-1])
+        with obs.span("fit", model="gbdt", trees=p.num_trees):
+            for t in range(p.num_trees):
+                g, h, mean_loss = grad_fn(margin, yd, *wargs)
+                margs = ()
+                if stochastic:
+                    g, h, feat_mask = mask_step(t, g, h)
+                    if colsample_on:
+                        margs = (feat_mask,)
+                feature, split_bin, gain, leaf, node = self._builder[1](
+                    xb, g, h, *margs)
+                feats.append(feature)
+                bins.append(split_bin)
+                gains.append(gain)
+                leaves.append(leaf)
+                margin = update_fn(margin, leaf, node)
+                history.append(float(mean_loss))
+                if with_eval:
+                    vmargin, vloss = eval_step(eval_xb, eval_yd, feature,
+                                               split_bin, leaf, vmargin)
+                    vlosses.append(float(vloss))
+                if (t + 1) % log_every == 0:
+                    log_info("tree %d loss %.6f", t + 1, history[-1])
         self.trees = {
             "feature": jnp.stack(feats),
             "bin": jnp.stack(bins),
@@ -1084,7 +1109,7 @@ class GBDTLearner:
         }
         if with_eval:
             self._set_eval_history(np.asarray(vlosses))
-        return history
+        return _obs_fit(history)
 
     def _make_grad_fn(self, weighted: bool = False):
         objective = self.param.objective

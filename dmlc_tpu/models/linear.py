@@ -17,6 +17,7 @@ downstream (rabit-based) consumers run, built TPU-first:
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import jax
@@ -431,27 +432,46 @@ class LinearLearner:
             "feed mesh and learner mesh must match (csr entry layouts "
             "differ between mesh and single-device runs)",
         )
+        from dmlc_tpu import obs
+
+        reg = obs.registry()
+        m_steps = reg.counter(
+            "dmlc_fit_steps_total", "optimizer steps taken", model="linear")
+        m_epochs = reg.counter(
+            "dmlc_fit_epochs_total", "epochs completed", model="linear")
+        g_loss = reg.gauge(
+            "dmlc_fit_loss_value", "last epoch mean loss", model="linear")
+        h_epoch = reg.histogram(
+            "dmlc_fit_epoch_ns", "wall time per epoch", model="linear")
         history = []
         for epoch in range(epochs):
             acc = EpochMetrics()
             nstep = 0
-            for batch in feed:
-                self._ensure(feed.spec.num_features, layout)
-                self.params, self.velocity, metrics = self._step(
-                    self.params, self.velocity, step_batch(batch, layout)
-                )
-                acc.add(metrics)
-                nstep += 1
-                if log_every and nstep % log_every == 0:
-                    log_info(
-                        "epoch %d step %d loss %.6f",
-                        epoch, nstep, acc.mean_loss(),
+            t0 = time.monotonic_ns()
+            with obs.span("epoch", model="linear", epoch=epoch):
+                for batch in feed:
+                    self._ensure(feed.spec.num_features, layout)
+                    self.params, self.velocity, metrics = self._step(
+                        self.params, self.velocity, step_batch(batch, layout)
                     )
-            history.append(acc.mean_loss())
+                    acc.add(metrics)
+                    nstep += 1
+                    if log_every and nstep % log_every == 0:
+                        log_info(
+                            "epoch %d step %d loss %.6f",
+                            epoch, nstep, acc.mean_loss(),
+                        )
+            h_epoch.observe(time.monotonic_ns() - t0)
+            m_steps.inc(nstep)
+            m_epochs.inc()
+            loss = acc.mean_loss()
+            g_loss.set(loss)
+            history.append(loss)
             if log_every:
                 from dmlc_tpu.device.feed import stall_breakdown
 
                 log_info("epoch %d %s", epoch, stall_breakdown(feed.stats()))
+            obs.export_epoch(reg)
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

@@ -1,0 +1,61 @@
+"""dmlc_tpu.obs — unified metrics + tracing.
+
+One observability surface for the whole stack (the tf.data lesson,
+arXiv:2101.12127: uniform per-stage metrics are the precondition for
+bottleneck diagnosis and auto-tuning):
+
+- :func:`registry` — the process-wide label-aware Counter/Gauge/Histogram
+  store every stage counter lives in (``DMLC_TPU_METRICS=0`` disables;
+  see obs/metrics.py)
+- :func:`span` / :func:`step_span` — Chrome-trace span context managers
+  gated by ``DMLC_TPU_TRACE=<path>`` (see obs/trace.py)
+- exporters — JSONL / Prometheus textfile / log-sink summary, driven at
+  epoch boundaries by :func:`export_epoch` via ``DMLC_TPU_METRICS_EXPORT``
+- :func:`cross_host_snapshot` / :func:`report_skew` — per-host
+  min/median/max over a ``collective.DeviceEngine`` allreduce
+
+Metric names follow ``dmlc_<area>_<name>_<unit>`` and every registered
+name is documented in docs/observability.md (enforced by
+``scripts/check_metric_names.py`` / tests/test_metric_lint.py).
+"""
+
+from dmlc_tpu.obs.aggregate import cross_host_snapshot, report_skew
+from dmlc_tpu.obs.exporters import (
+    export_epoch,
+    export_jsonl,
+    export_prometheus,
+    summary_line,
+)
+from dmlc_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    registry,
+)
+from dmlc_tpu.obs.trace import (
+    clear as clear_trace,
+    events as trace_events,
+    flush as flush_trace,
+    span,
+    step_span,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "registry",
+    "span",
+    "step_span",
+    "trace_events",
+    "clear_trace",
+    "flush_trace",
+    "export_epoch",
+    "export_jsonl",
+    "export_prometheus",
+    "summary_line",
+    "cross_host_snapshot",
+    "report_skew",
+]

@@ -1,0 +1,113 @@
+"""Pluggable exporters over the obs registry.
+
+Three sinks, all fed from :meth:`Registry.families` / ``snapshot()``:
+
+- **JSONL** (:func:`export_jsonl`) — appends one ``{"ts_unix": ...,
+  "metrics": {...}}`` line per export; the machine-readable epoch trail.
+- **Prometheus textfile** (:func:`export_prometheus`) — the node-exporter
+  textfile-collector format, written atomically (tmp + rename) so a
+  scraper never reads a torn file.
+- **Log sink** (:func:`summary_line`) — one compact ``k=v`` line through
+  ``utils.logging`` for epoch-boundary fit-loop logs.
+
+:func:`export_epoch` is the fit loops' single call: it honors the
+``DMLC_TPU_METRICS_EXPORT`` knob (``*.prom`` → Prometheus, else JSONL),
+flushes any active trace, and returns the summary line for the caller to
+log. With the knob unset and no metrics, it is a cheap no-op.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from dmlc_tpu.obs import trace
+from dmlc_tpu.obs.metrics import Registry, format_name, registry
+from dmlc_tpu.params.knobs import metrics_export_path
+
+
+def export_jsonl(path: str, reg: Optional[Registry] = None) -> None:
+    reg = reg or registry()
+    line = json.dumps({"ts_unix": time.time(), "metrics": reg.snapshot()})
+    with open(path, "a") as fh:
+        fh.write(line + "\n")
+
+
+def _prom_labels(labelkey) -> str:
+    if not labelkey:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, v) for k, v in labelkey)
+
+
+def export_prometheus(path: str, reg: Optional[Registry] = None) -> None:
+    """Write the whole registry in Prometheus textfile format (cumulative
+    ``le`` buckets for histograms), atomically."""
+    reg = reg or registry()
+    lines = []
+    for name, (kind, help_, children) in sorted(reg.families().items()):
+        if help_:
+            lines.append("# HELP %s %s" % (name, help_))
+        lines.append("# TYPE %s %s" % (name, kind))
+        for key, child in sorted(children.items()):
+            if kind == "histogram":
+                for le, acc in child.cumulative():
+                    lk = key + (("le", le),)
+                    lines.append("%s_bucket%s %d"
+                                 % (name, _prom_labels(lk), acc))
+                lines.append("%s_sum%s %s"
+                             % (name, _prom_labels(key), child.sum))
+                lines.append("%s_count%s %d"
+                             % (name, _prom_labels(key), child.count))
+            else:
+                lines.append("%s%s %s"
+                             % (name, _prom_labels(key), child.value))
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+
+
+def summary_line(prefix: Optional[str] = None,
+                 reg: Optional[Registry] = None) -> str:
+    """Compact one-line ``name=value`` summary (histograms as
+    ``sum/count``), optionally filtered to names starting with ``prefix``
+    — the log-sink form for epoch boundaries."""
+    reg = reg or registry()
+    parts = []
+    for name, (kind, _help, children) in sorted(reg.families().items()):
+        if prefix and not name.startswith(prefix):
+            continue
+        for key, child in sorted(children.items()):
+            flat = format_name(name, key)
+            if kind == "histogram":
+                parts.append("%s=%.0f/%d" % (flat, child.sum, child.count))
+            else:
+                v = child.value
+                parts.append("%s=%g" % (flat, v))
+    return " ".join(parts)
+
+
+def export_epoch(reg: Optional[Registry] = None,
+                 log_prefix: Optional[str] = None) -> str:
+    """Epoch-boundary export: write the ``DMLC_TPU_METRICS_EXPORT`` file
+    (if configured), flush the active trace (if any), and return the
+    log-sink summary line (callers decide whether/at what level to log
+    it). Export failures degrade to a summary-only return — telemetry
+    must never fail a fit loop."""
+    reg = reg or registry()
+    path = metrics_export_path()
+    if path:
+        try:
+            if path.endswith(".prom"):
+                export_prometheus(path, reg)
+            else:
+                export_jsonl(path, reg)
+        except OSError:
+            pass
+    try:
+        trace.flush()
+    except OSError:
+        pass
+    return summary_line(prefix=log_prefix, reg=reg)

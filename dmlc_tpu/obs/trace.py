@@ -1,0 +1,172 @@
+"""Span tracer: Chrome trace-event JSON (Perfetto-loadable) pipeline spans.
+
+``span("parse", chunk=i)`` is a context manager that records one complete
+("ph": "X") trace event — name, start, duration, thread — into an
+in-process buffer; ``flush()`` (and an atexit hook) writes the buffer to
+the path named by ``DMLC_TPU_TRACE`` as ``{"traceEvents": [...]}``, the
+format both chrome://tracing and https://ui.perfetto.dev open directly.
+
+Tracing is OFF unless ``DMLC_TPU_TRACE`` is set: ``span()`` then returns a
+shared no-op context manager (two empty method calls per span). The env
+var is re-read per ``span()`` call — one dict lookup — so tests and
+long-lived processes can turn tracing on/off without re-imports.
+
+Span durations are measured by :class:`dmlc_tpu.utils.timer.Timer` (the
+repo's one stopwatch — obs reuses it rather than growing a second one).
+
+Optional jax bridging: with ``DMLC_TPU_TRACE_JAX=1`` each span also enters
+a ``jax.profiler.TraceAnnotation`` (and ``step_span`` a
+``StepTraceAnnotation``) when the running jax exposes them, so the same
+span names show up inside an XLA profiler capture next to the device
+timeline. Absent jax or the API, the bridge silently stays off.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+from dmlc_tpu.utils.timer import Timer, get_time
+
+_lock = threading.Lock()
+_events: List[Dict] = []
+_atexit_registered = False
+_EPOCH = get_time()  # trace timestamps are µs since process trace epoch
+
+_PID = os.getpid()
+
+
+def _now_us() -> float:
+    return (get_time() - _EPOCH) * 1e6
+
+
+def _jax_annotation_cls(step: bool = False):
+    if os.environ.get("DMLC_TPU_TRACE_JAX") != "1":
+        return None
+    try:
+        import jax.profiler as _jp
+    except Exception:
+        return None
+    return getattr(
+        _jp, "StepTraceAnnotation" if step else "TraceAnnotation", None
+    )
+
+
+class _NoopSpan:
+    """Shared disabled span: stateless, safe to reuse concurrently."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_timer", "_ts", "_annot")
+
+    def __init__(self, name: str, args: Dict, annot=None):
+        self.name = name
+        self.args = args
+        self._timer = Timer()
+        self._ts = 0.0
+        self._annot = annot
+
+    def __enter__(self):
+        if self._annot is not None:
+            self._annot.__enter__()
+        self._ts = _now_us()
+        self._timer.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.__exit__(*exc)
+        if self._annot is not None:
+            self._annot.__exit__(*exc)
+        event = {
+            "name": self.name,
+            "ph": "X",
+            "ts": self._ts,
+            "dur": self._timer.elapsed * 1e6,
+            "pid": _PID,
+            "tid": threading.get_ident(),
+        }
+        if self.args:
+            event["args"] = self.args
+        with _lock:
+            _events.append(event)
+        return False
+
+
+def _active_path() -> Optional[str]:
+    # raw os.environ read: this sits on the per-batch path and must not
+    # pay the typed-parse layer for the common "unset" case
+    return os.environ.get("DMLC_TPU_TRACE") or None
+
+
+def _ensure_atexit() -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(flush)
+
+
+def span(name: str, **args):
+    """Context manager timing one pipeline stage as a named trace span.
+
+    No-op (a shared inert object) unless ``DMLC_TPU_TRACE`` names an
+    output file. Keyword args become the event's ``args`` payload —
+    keep them small and JSON-serializable (chunk/batch indices)."""
+    if _active_path() is None:
+        return NOOP_SPAN
+    _ensure_atexit()
+    cls = _jax_annotation_cls()
+    annot = cls(name) if cls is not None else None
+    return _Span(name, args, annot)
+
+
+def step_span(step_num: int, name: str = "step", **args):
+    """Like :func:`span` but bridges to ``jax.profiler.StepTraceAnnotation``
+    (the profiler's step marker) when available — for fit-loop epochs."""
+    if _active_path() is None:
+        return NOOP_SPAN
+    _ensure_atexit()
+    cls = _jax_annotation_cls(step=True)
+    annot = cls(name, step_num=step_num) if cls is not None else None
+    return _Span(name, dict(args, step=step_num), annot)
+
+
+def events() -> List[Dict]:
+    """Copy of the buffered trace events (ordered by span *completion*)."""
+    with _lock:
+        return list(_events)
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Write all buffered events to ``path`` (default: ``DMLC_TPU_TRACE``)
+    as a Chrome trace JSON object. Returns the path written, or None when
+    there is no destination. The buffer is kept: repeated flushes rewrite
+    the file with the complete history (the file is always loadable)."""
+    path = path or _active_path()
+    if path is None:
+        return None
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+    return path

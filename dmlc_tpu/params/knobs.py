@@ -15,6 +15,19 @@ code changes:
 Every call site that previously hard-coded a width resolves through
 these helpers, so one env var retunes the whole stack (create_parser,
 DeviceFeed, the learners' fit loops, bench.py).
+
+The observability layer (dmlc_tpu/obs) adds three more:
+
+- ``DMLC_TPU_METRICS`` — 0 disables the metrics registry (registrations
+  hand out a shared no-op child; default 1, whose hot path is one
+  lock-and-add)
+- ``DMLC_TPU_TRACE`` — path for the Chrome trace-event JSON written by
+  ``obs.span`` (empty = tracing off, the default)
+- ``DMLC_TPU_METRICS_EXPORT`` — path the registry is exported to at
+  epoch boundaries: ``*.prom`` → Prometheus textfile, else JSONL
+  (empty = no file export, the default)
+- ``DMLC_TPU_HEARTBEAT_GAP`` — seconds without a worker heartbeat
+  before the tracker logs it as a straggler (default 60)
 """
 
 from __future__ import annotations
@@ -48,3 +61,29 @@ def default_host_prefetch(explicit: Optional[int] = None) -> Optional[int]:
         return explicit
     val = get_env("DMLC_TPU_HOST_PREFETCH", -1)
     return None if val < 0 else val
+
+
+def metrics_enabled() -> bool:
+    """Whether the obs metrics registry hands out live children
+    (``DMLC_TPU_METRICS``, default on). Read at metric *registration*
+    time, never on the per-increment path."""
+    return get_env("DMLC_TPU_METRICS", True)
+
+
+def trace_path() -> str:
+    """Chrome-trace output path for ``obs.span`` (``DMLC_TPU_TRACE``;
+    empty = tracing off)."""
+    return get_env("DMLC_TPU_TRACE", "")
+
+
+def metrics_export_path() -> str:
+    """Epoch-boundary registry export target (``DMLC_TPU_METRICS_EXPORT``;
+    ``*.prom`` → Prometheus textfile, anything else → JSONL appends,
+    empty = no file export)."""
+    return get_env("DMLC_TPU_METRICS_EXPORT", "")
+
+
+def heartbeat_gap() -> float:
+    """Straggler threshold in seconds for tracker heartbeats
+    (``DMLC_TPU_HEARTBEAT_GAP``, default 60)."""
+    return float(get_env("DMLC_TPU_HEARTBEAT_GAP", 60.0))

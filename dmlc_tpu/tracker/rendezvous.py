@@ -22,6 +22,13 @@ rendezvous with the reference tracker:
   (tracker.py:336-386)
 - world size may be decided by the first worker (tracker.py:281-287)
 
+TPU-new on top of the reference protocol: a lightweight ``heartbeat``
+command (send_heartbeat) lets running workers report liveness plus an
+epoch/metrics summary line; the tracker records last_seen per rank and
+logs workers whose gap exceeds ``DMLC_TPU_HEARTBEAT_GAP`` as stragglers.
+Reference trackers ignore unknown jobids, and our tracker treats the
+command as fire-and-forget, so the extension stays wire-compatible.
+
 On TPU this socket machinery is only the *control* plane (CPU-parity runs and
 process bootstrap); the data plane is XLA collectives over ICI — see
 dmlc_tpu.collective.
@@ -38,6 +45,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from dmlc_tpu import obs
+from dmlc_tpu.params.knobs import heartbeat_gap
 from dmlc_tpu.utils.logging import DMLCError
 
 MAGIC = 0xFF99
@@ -305,11 +314,53 @@ class RabitTracker:
         self.thread: Optional[threading.Thread] = None
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
+        # heartbeat satellite state: rank → last_seen / last payload line
+        self.heartbeat_gap = heartbeat_gap()
+        self._hb_lock = threading.Lock()
+        self._last_seen: Dict[int, float] = {}
+        self._hb_info: Dict[int, str] = {}
+        self._hb_flagged: Set[int] = set()
+        self._m_heartbeats = obs.registry().counter(
+            "dmlc_tracker_heartbeats_total", "worker heartbeats received")
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, object]:
         """Env contract handed to workers (tracker.py:177-183)."""
         return {"DMLC_TRACKER_URI": self.host_ip, "DMLC_TRACKER_PORT": self.port}
+
+    # ---- heartbeat satellite -------------------------------------------
+    def _note_heartbeat(self, rank: int, payload: str) -> None:
+        """Record a worker's liveness report and flag stragglers: any
+        other rank whose last report is older than ``heartbeat_gap``
+        seconds gets warned about once (re-flagged only after it
+        reports again)."""
+        now = time.time()
+        with self._hb_lock:
+            self._last_seen[rank] = now
+            self._hb_info[rank] = payload
+            self._hb_flagged.discard(rank)
+            stale = [
+                (r, now - seen) for r, seen in self._last_seen.items()
+                if r != rank and now - seen > self.heartbeat_gap
+                and r not in self._hb_flagged
+            ]
+            self._hb_flagged.update(r for r, _ in stale)
+        self._m_heartbeats.inc()
+        logger.debug("heartbeat from rank %d: %s", rank, payload)
+        for r, gap in stale:
+            logger.warning(
+                "straggler: rank %d last heartbeat %.1fs ago (threshold "
+                "%.1fs); last report: %s",
+                r, gap, self.heartbeat_gap, self._hb_info.get(r, ""),
+            )
+
+    def heartbeats(self) -> Dict[int, Tuple[float, str]]:
+        """Snapshot of rank → (last_seen unix time, last payload line)."""
+        with self._hb_lock:
+            return {
+                r: (seen, self._hb_info.get(r, ""))
+                for r, seen in self._last_seen.items()
+            }
 
     def _accept_loop(self, num_workers: int) -> None:
         shutdown: Dict[int, _Worker] = {}
@@ -319,7 +370,12 @@ class RabitTracker:
         todo: List[int] = []
         tree = parent = ring = None
         while len(shutdown) != num_workers:
-            fd, addr = self.sock.accept()
+            try:
+                fd, addr = self.sock.accept()
+            except OSError:
+                # close() pulled the listening socket out from under us:
+                # a deliberate stop, not a protocol failure
+                return
             try:
                 worker = _Worker(fd, addr)
             except ConnectionError as err:
@@ -328,6 +384,17 @@ class RabitTracker:
                 continue
             if worker.cmd == "print":
                 logger.info(worker.conn.recv_str().strip())
+                continue
+            if worker.cmd == "heartbeat":
+                try:
+                    payload = worker.conn.recv_str()
+                    self._note_heartbeat(worker.rank, payload)
+                    worker.conn.send_int(0)
+                except (ConnectionError, OSError) as err:
+                    logger.warning("heartbeat from %s failed: %s",
+                                   worker.host, err)
+                finally:
+                    worker.conn.close()
                 continue
             if worker.cmd == "shutdown":
                 assert worker.rank >= 0 and worker.rank not in shutdown
@@ -430,6 +497,39 @@ class RabitTracker:
 
     def close(self) -> None:
         self.sock.close()
+
+
+def send_heartbeat(
+    tracker_uri: str,
+    tracker_port: int,
+    rank: int,
+    epoch: int = -1,
+    metrics: str = "",
+    timeout: float = 10.0,
+) -> None:
+    """Worker-side heartbeat: one short-lived connection carrying the
+    standard handshake with cmd="heartbeat" plus a free-form payload line
+    (``epoch=N <metrics>`` — e.g. ``obs.summary_line()``). Waits for the
+    tracker's ack so a heartbeat observed by the caller is recorded."""
+    sock = socket.create_connection((tracker_uri, tracker_port),
+                                    timeout=timeout)
+    conn = FramedSocket(sock)
+    try:
+        conn.send_int(MAGIC)
+        magic = conn.recv_int()
+        if magic != MAGIC:
+            raise ConnectionError(f"invalid tracker magic {magic:#x}")
+        conn.send_int(rank)
+        conn.send_int(-1)
+        conn.send_str("NULL")
+        conn.send_str("heartbeat")
+        payload = f"epoch={epoch}"
+        if metrics:
+            payload += " " + metrics
+        conn.send_str(payload)
+        conn.recv_int()  # ack
+    finally:
+        conn.close()
 
 
 class PSTracker:

@@ -5,6 +5,8 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from dmlc_tpu.utils.logging import check
+
 
 def get_time() -> float:
     """Seconds from a monotonic high-resolution clock, as double.
@@ -19,7 +21,8 @@ class Timer:
     """Context-manager stopwatch with accumulated elapsed time.
 
     TPU-new: the reference only has GetTime(); pipelines here want per-stage
-    timers (SURVEY §5.1), so this accumulates across multiple enters.
+    timers (SURVEY §5.1 — and obs.span durations), so this accumulates
+    across multiple enters.
     """
 
     def __init__(self) -> None:
@@ -31,10 +34,17 @@ class Timer:
         return self
 
     def __exit__(self, *exc) -> None:
-        assert self._start is not None
+        # a library-surface misuse, not an internal invariant: raise the
+        # catchable DMLCError, never a stripped-out assert
+        check(self._start is not None,
+              "Timer.__exit__ without a matching __enter__")
         self.elapsed += get_time() - self._start
         self._start = None
 
     def reset(self) -> None:
+        """Zero the accumulated time. Safe mid-timing: an in-flight
+        enter restarts from now instead of being forgotten (its exit
+        would otherwise raise)."""
         self.elapsed = 0.0
-        self._start = None
+        if self._start is not None:
+            self._start = get_time()

@@ -1,0 +1,62 @@
+"""Metric-name lint (scripts/check_metric_names.py) wired into the test
+suite: every registered metric name must follow dmlc_<area>_<name>_<unit>
+and be documented in docs/observability.md."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_metric_names.py")
+
+
+def test_metric_names_lint():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.fixture()
+def lint_mod():
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import check_metric_names
+        yield check_metric_names
+    finally:
+        sys.path.pop(0)
+
+
+def test_lint_catches_violations(lint_mod, monkeypatch):
+    """The lint actually fires on bad registrations (guards against the
+    call-site regex or the rules rotting)."""
+    monkeypatch.setattr(lint_mod, "registered_names", lambda: {
+        "bad_name": [("x.py", "counter")],
+        "dmlc_area_thing_widgets": [("y.py", "histogram")],
+        "dmlc_area_undocumented_total": [("z.py", "counter")],
+        "dmlc_area_sent_bytes": [("w.py", "counter")],
+    })
+    monkeypatch.setattr(
+        lint_mod, "documented_names",
+        lambda: {"bad_name", "dmlc_area_thing_widgets",
+                 "dmlc_area_sent_bytes", "dmlc_area_stale_total"})
+    errors = "\n".join(lint_mod.lint())
+    assert "bad_name: must start with dmlc_" in errors
+    assert "dmlc_area_thing_widgets: unit suffix" in errors
+    assert "dmlc_area_undocumented_total: not documented" in errors
+    assert "dmlc_area_sent_bytes: counters must end _total" in errors
+    assert "dmlc_area_stale_total: documented" in errors
+
+
+def test_lint_clean_set_passes(lint_mod, monkeypatch):
+    monkeypatch.setattr(lint_mod, "registered_names", lambda: {
+        "dmlc_area_good_total": [("x.py", "counter")],
+        "dmlc_area_time_ns": [("y.py", "histogram")],
+    })
+    monkeypatch.setattr(
+        lint_mod, "documented_names",
+        lambda: {"dmlc_area_good_total", "dmlc_area_time_ns"})
+    assert lint_mod.lint() == []

@@ -1,0 +1,324 @@
+"""Unified observability layer (dmlc_tpu/obs): registry semantics,
+thread safety, disabled-path cost, span tracing, exporters, cross-host
+aggregation, tracker heartbeats, and the Timer satellite fixes.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import obs
+from dmlc_tpu.obs.metrics import DEFAULT_BUCKETS, NOOP, Registry
+from dmlc_tpu.utils.logging import DMLCError
+from dmlc_tpu.utils.timer import Timer
+
+
+class TestRegistry:
+    def test_idempotent_children_and_kind_conflict(self):
+        reg = Registry()
+        a = reg.counter("dmlc_t_x_total", "help", feed="f0")
+        b = reg.counter("dmlc_t_x_total", feed="f0")
+        assert a is b
+        c = reg.counter("dmlc_t_x_total", feed="f1")
+        assert c is not a
+        with pytest.raises(DMLCError):
+            reg.gauge("dmlc_t_x_total", feed="f0")
+
+    def test_snapshot_and_flat_values(self):
+        reg = Registry()
+        reg.counter("dmlc_t_c_total", "c", k="v").inc(3)
+        reg.gauge("dmlc_t_g_value", "g").set(2.5)
+        reg.histogram("dmlc_t_h_ns", "h").observe(5)
+        snap = reg.snapshot()
+        assert snap['dmlc_t_c_total{k="v"}'] == 3
+        assert snap["dmlc_t_g_value"] == 2.5
+        assert snap["dmlc_t_h_ns"]["count"] == 1
+        assert snap["dmlc_t_h_ns"]["sum"] == 5
+        flat = reg.flat_values()
+        assert flat["dmlc_t_h_ns:sum"] == 5.0
+        assert flat["dmlc_t_h_ns:count"] == 1.0
+
+    def test_thread_safety_8_writers(self):
+        reg = Registry()
+        c = reg.counter("dmlc_t_threads_total")
+        h = reg.histogram("dmlc_t_threads_ns")
+        per_thread, nthreads = 5000, 8
+
+        def work():
+            for i in range(per_thread):
+                c.inc()
+                h.observe(i)
+
+        threads = [threading.Thread(target=work) for _ in range(nthreads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = per_thread * nthreads
+        assert c.value == total
+        assert h.count == total
+        assert h.sum == nthreads * per_thread * (per_thread - 1) / 2
+        assert sum(h.buckets().values()) == total
+
+
+class TestHistogramBuckets:
+    def test_le_edge_semantics(self):
+        reg = Registry()
+        h = reg.histogram("dmlc_t_edges_ns", buckets=(10, 100, 1000))
+        # le semantics: a value equal to a bound counts IN that bound
+        for v in (1, 10, 11, 100, 1000, 1001):
+            h.observe(v)
+        assert h.buckets() == {"10": 2, "100": 2, "1000": 1, "+Inf": 1}
+        # cumulative covers every bound plus +Inf
+        assert dict(h.cumulative()) == {
+            "10": 2, "100": 4, "1000": 5, "+Inf": 6}
+
+    def test_default_buckets_log_scale(self):
+        assert DEFAULT_BUCKETS[0] == 1
+        assert all(b2 == b1 * 4 for b1, b2 in
+                   zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:]))
+        h = Registry().histogram("dmlc_t_default_ns")
+        h.observe(0)      # below the first bound → first bucket
+        h.observe(4 ** 25)  # beyond the last bound → overflow
+        b = h.buckets()
+        assert b["1"] == 1 and b["+Inf"] == 1
+
+
+class TestDisabledPath:
+    def test_disabled_returns_shared_noop(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_METRICS", "0")
+        reg = Registry()
+        c = reg.counter("dmlc_t_off_total", who="x")
+        h = reg.histogram("dmlc_t_off_ns")
+        assert c is NOOP and h is NOOP
+        c.inc()
+        h.observe(1)
+        assert c.value == 0 and h.sum == 0.0
+        assert reg.snapshot() == {} and reg.flat_values() == {}
+
+    def test_disabled_overhead_under_2x_noop_call(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_METRICS", "0")
+        inc = Registry().counter("dmlc_t_cost_total").inc
+
+        def baseline():
+            pass
+
+        n = 200_000
+
+        def timed(fn):
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        timed(baseline)  # warm up both paths
+        timed(inc)
+        assert timed(inc) < 2.0 * timed(baseline) + 1e-3
+
+
+class TestSpans:
+    def test_span_disabled_without_env(self, monkeypatch):
+        monkeypatch.delenv("DMLC_TPU_TRACE", raising=False)
+        obs.clear_trace()
+        with obs.span("nothing"):
+            pass
+        assert obs.trace_events() == []
+
+    def test_nesting_ordering_and_flush(self, monkeypatch, tmp_path):
+        out = tmp_path / "t.json"
+        monkeypatch.setenv("DMLC_TPU_TRACE", str(out))
+        obs.clear_trace()
+        with obs.span("outer", epoch=0):
+            with obs.span("inner_a", chunk=1):
+                time.sleep(0.002)
+            with obs.span("inner_b", chunk=2):
+                time.sleep(0.002)
+        path = obs.flush_trace()
+        assert path == str(out)
+        doc = json.loads(out.read_text())
+        events = {e["name"]: e for e in doc["traceEvents"]}
+        assert set(events) == {"outer", "inner_a", "inner_b"}
+        outer, a, b = events["outer"], events["inner_a"], events["inner_b"]
+        for e in (outer, a, b):
+            assert e["ph"] == "X" and e["dur"] > 0
+        # containment: both inners inside outer, a before b, same thread
+        for inner in (a, b):
+            assert inner["ts"] >= outer["ts"]
+            assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+            assert inner["tid"] == outer["tid"]
+        assert a["ts"] + a["dur"] <= b["ts"] + 1
+        assert a["args"] == {"chunk": 1}
+        obs.clear_trace()
+
+    def test_feed_spans_emitted(self, monkeypatch, tmp_path):
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.device.feed import BatchSpec, DeviceFeed
+        from dmlc_tpu.io.input_split import create_input_split
+
+        out = tmp_path / "feed.json"
+        monkeypatch.setenv("DMLC_TPU_TRACE", str(out))
+        obs.clear_trace()
+        rng = np.random.RandomState(0)
+        lines = []
+        for i in range(600):
+            ids = np.sort(rng.choice(40, size=1 + i % 7, replace=False))
+            feats = " ".join("%d:%.6f" % (j, rng.rand()) for j in ids)
+            lines.append("%d %s" % (i % 2, feats))
+        path = tmp_path / "t.svm"
+        path.write_text("\n".join(lines) + "\n")
+        split = create_input_split(str(path), 0, 1, "text", threaded=False)
+        spec = BatchSpec(batch_size=128, layout="dense", num_features=40)
+        feed = DeviceFeed(LibSVMParser(split, nthread=1), spec)
+        for batch in feed:
+            np.asarray(batch["label"])
+        feed.close()
+        names = {e["name"] for e in obs.trace_events()}
+        assert {"feed_batch", "dispatch", "consume"} <= names
+        obs.flush_trace()
+        json.loads(out.read_text())  # loadable Chrome trace
+        obs.clear_trace()
+
+
+class TestExporters:
+    def _reg(self):
+        reg = Registry()
+        reg.counter("dmlc_t_exp_total", "a counter", k="v").inc(7)
+        reg.histogram("dmlc_t_exp_ns", "a hist").observe(3)
+        return reg
+
+    def test_jsonl_appends(self, tmp_path):
+        reg = self._reg()
+        path = tmp_path / "m.jsonl"
+        obs.export_jsonl(str(path), reg)
+        obs.export_jsonl(str(path), reg)
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 2
+        rec = json.loads(lines[-1])
+        assert rec["metrics"]['dmlc_t_exp_total{k="v"}'] == 7
+
+    def test_prometheus_textfile(self, tmp_path):
+        reg = self._reg()
+        path = tmp_path / "m.prom"
+        obs.export_prometheus(str(path), reg)
+        text = path.read_text()
+        assert "# TYPE dmlc_t_exp_total counter" in text
+        assert 'dmlc_t_exp_total{k="v"} 7' in text
+        assert 'dmlc_t_exp_ns_bucket{le="4"} 1' in text
+        assert 'dmlc_t_exp_ns_bucket{le="+Inf"} 1' in text
+        assert "dmlc_t_exp_ns_count 1" in text
+
+    def test_summary_line_and_export_epoch(self, monkeypatch, tmp_path):
+        reg = self._reg()
+        line = obs.summary_line(reg=reg)
+        assert 'dmlc_t_exp_total{k="v"}=7' in line
+        assert "dmlc_t_exp_ns=3/1" in line
+        out = tmp_path / "epoch.prom"
+        monkeypatch.setenv("DMLC_TPU_METRICS_EXPORT", str(out))
+        got = obs.export_epoch(reg)
+        assert got == line
+        assert out.exists()
+        # export failure degrades, never raises
+        monkeypatch.setenv("DMLC_TPU_METRICS_EXPORT",
+                           str(tmp_path / "no" / "dir" / "x.prom"))
+        assert obs.export_epoch(reg) == line
+
+
+class TestCrossHost:
+    def test_single_host_snapshot_exact(self):
+        from dmlc_tpu.collective.device import DeviceEngine
+
+        reg = Registry()
+        reg.counter("dmlc_t_xh_total", "c").inc(42)
+        reg.histogram("dmlc_t_xh_ns", "h").observe(10)
+        snap = obs.cross_host_snapshot(DeviceEngine(), reg=reg)
+        assert snap["world"] == 1 and snap["rank"] == 0
+        m = snap["metrics"]["dmlc_t_xh_total"]
+        assert m["min"] == m["median"] == m["max"] == m["sum"] == 42.0
+        assert snap["metrics"]["dmlc_t_xh_ns:count"]["max"] == 1.0
+
+    def test_prefix_filter_and_report_skew(self):
+        from dmlc_tpu.collective.device import DeviceEngine
+
+        reg = Registry()
+        reg.counter("dmlc_t_keep_total").inc(1)
+        reg.counter("dmlc_other_drop_total").inc(1)
+        snap = obs.report_skew(DeviceEngine(), reg=reg, prefix="dmlc_t_")
+        assert list(snap["metrics"]) == ["dmlc_t_keep_total"]
+
+
+class TestTimerSatellite:
+    def test_exit_without_enter_raises_dmlc_error(self):
+        with pytest.raises(DMLCError):
+            Timer().__exit__(None, None, None)
+
+    def test_reset_mid_timing_keeps_timing_valid(self):
+        t = Timer()
+        with t:
+            time.sleep(0.002)
+            t.reset()  # mid-flight: restarts, exit must not raise
+        assert 0.0 <= t.elapsed < 0.5
+
+    def test_accumulates_across_enters(self):
+        t = Timer()
+        for _ in range(2):
+            with t:
+                time.sleep(0.001)
+        assert t.elapsed >= 0.002
+        t.reset()
+        assert t.elapsed == 0.0
+
+
+class TestHeartbeat:
+    def test_heartbeat_recorded_and_counted(self):
+        from dmlc_tpu.tracker.rendezvous import RabitTracker, send_heartbeat
+
+        before = obs.registry().counter(
+            "dmlc_tracker_heartbeats_total").value
+        tracker = RabitTracker("127.0.0.1", num_workers=1)
+        try:
+            tracker.start(1)
+            send_heartbeat("127.0.0.1", tracker.port, rank=0, epoch=2,
+                           metrics="loss=0.25")
+            deadline = time.time() + 5
+            while not tracker.heartbeats() and time.time() < deadline:
+                time.sleep(0.01)
+            hb = tracker.heartbeats()
+            assert 0 in hb
+            last_seen, line = hb[0]
+            assert line == "epoch=2 loss=0.25"
+            assert last_seen <= time.time()
+            assert obs.registry().counter(
+                "dmlc_tracker_heartbeats_total").value >= before + 1
+        finally:
+            tracker.close()
+
+    def test_straggler_flagging(self, caplog):
+        import logging as _logging
+
+        from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+        tracker = RabitTracker("127.0.0.1", num_workers=2)
+        try:
+            tracker.heartbeat_gap = 0.01
+            tracker._note_heartbeat(0, "epoch=0")
+            time.sleep(0.05)
+            with caplog.at_level(_logging.WARNING, "dmlc_tpu.tracker"):
+                tracker._note_heartbeat(1, "epoch=0")
+            assert any("straggler: rank 0" in r.getMessage()
+                       for r in caplog.records)
+            # flagged once: a second report from rank 1 does not re-warn
+            caplog.clear()
+            with caplog.at_level(_logging.WARNING, "dmlc_tpu.tracker"):
+                tracker._note_heartbeat(1, "epoch=1")
+            assert not caplog.records
+            # rank 0 reporting again clears its flag
+            tracker._note_heartbeat(0, "epoch=1")
+            assert 0 not in tracker._hb_flagged
+        finally:
+            tracker.close()
