@@ -167,7 +167,12 @@ def checkpoint(state: Any, uri: Optional[str] = None) -> None:
     (file://, gs://, mem://...) — the building blocks the reference exposes as
     Serializable + Stream::Create (io.h:112-126, SURVEY §5.4).
     """
+    from dmlc_tpu.resilience import faultpoint
+
     global _version, _checkpoint_blob
+    # before the version bump: an injected commit fault must leave the
+    # in-process snapshot exactly as it was (no half-committed version)
+    faultpoint("ckpt.commit")
     _version += 1
     stream = MemoryStream()
     # the version travels inside the blob so a restarted process (or a
@@ -186,9 +191,12 @@ def load_checkpoint(uri: Optional[str] = None) -> Optional[Any]:
     Also restores ``version_number()`` to the loaded snapshot's version, so
     version-gated loops agree across restarted and surviving workers.
     """
+    from dmlc_tpu.resilience import faultpoint
+
     global _version, _checkpoint_blob
     blob = _checkpoint_blob
     if blob is None and uri:
+        faultpoint("ckpt.read")
         stream = create_stream(uri, "r", allow_null=True)
         if stream is not None:
             data = []
@@ -359,7 +367,7 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
     not supported; the restarted process must come back with the same
     jobid/rank).
     """
-    import time as _time
+    from dmlc_tpu.resilience import backoff_sleep
 
     attempt = 0
     while True:
@@ -395,7 +403,9 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
                 # engine fails fast on the next round_fn, which brings us
                 # back here to try again until attempts run out
                 log_info("recover rendezvous failed (%s); will retry", rerr)
-                _time.sleep(1.0)
+                # jittered so a whole world of workers does not hammer a
+                # restarting tracker in lockstep
+                backoff_sleep(attempt, "collective.recover", base_s=0.5)
 
 
 __all__ = [

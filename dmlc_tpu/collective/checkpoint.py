@@ -29,7 +29,7 @@ import numpy as np
 
 from dmlc_tpu.io.filesystem import URI, create_stream, get_filesystem
 from dmlc_tpu.io.serializer import load_obj, save_obj
-from dmlc_tpu.utils.logging import DMLCError, check
+from dmlc_tpu.utils.logging import DMLCError, check, log_warning
 
 
 def _to_host(tree: Any) -> Any:
@@ -57,6 +57,14 @@ class CheckpointManager:
     version, mirroring rabit where the global model is logically one).
     ``per_rank=True`` writes one state file per rank (rabit's local model)
     and loads this rank's own file.
+
+    ``fallback_uri`` (default: the ``DMLC_TPU_CKPT_FALLBACK_URI`` knob) is
+    the graceful-degradation path: when a commit to the primary URI fails
+    even after the io layer's retries, the same version is committed to
+    the fallback directory instead of losing the snapshot, and
+    ``load_checkpoint`` considers both locations (newest committed version
+    wins). Meant for a second failure domain — e.g. primary on an object
+    store, fallback on local disk.
     """
 
     def __init__(
@@ -66,6 +74,7 @@ class CheckpointManager:
         world_size: int = 1,
         per_rank: bool = False,
         keep: int = 2,
+        fallback_uri: Optional[str] = None,
     ):
         check(keep >= 1, "keep must be >= 1")
         self.uri = uri.rstrip("/")
@@ -73,6 +82,16 @@ class CheckpointManager:
         self.world_size = world_size
         self.per_rank = per_rank
         self.keep = keep
+        if fallback_uri is None:  # "" explicitly disables the env knob
+            from dmlc_tpu.params.knobs import ckpt_fallback_uri
+
+            fallback_uri = ckpt_fallback_uri()
+        fallback_uri = (fallback_uri or "").rstrip("/") or None
+        if fallback_uri is not None:
+            check(fallback_uri != self.uri,
+                  "fallback checkpoint URI must differ from the primary")
+        self._fallback_uri = fallback_uri
+        self._fallback: Optional["CheckpointManager"] = None
         parsed = URI.parse(self.uri)
         if parsed.protocol in ("file://", ""):
             import os
@@ -90,8 +109,38 @@ class CheckpointManager:
         return self._version
 
     def checkpoint(self, state: Any) -> int:
-        """Commit ``state`` as version ``version_number + 1``; returns it."""
+        """Commit ``state`` as version ``version_number + 1``; returns it.
+
+        A commit that still fails after the io layer's retries degrades to
+        the fallback URI (when configured) instead of dropping the
+        snapshot; config-shaped errors (``FileNotFoundError`` etc. on a
+        local path) are not degradation candidates and surface directly.
+        """
         version = self._version + 1
+        try:
+            self._commit(version, state)
+        except (DMLCError, OSError) as err:
+            fb = self._fallback_manager()
+            if fb is None or isinstance(
+                err, (FileNotFoundError, PermissionError, IsADirectoryError,
+                      NotADirectoryError)
+            ):
+                raise
+            log_warning(
+                "checkpoint v%d commit to %s failed (%s); degrading to "
+                "fallback %s", version, self.uri, err, fb.uri,
+            )
+            fb._version = version - 1  # keep version numbering aligned
+            fb._commit(version, state)
+        self._version = version
+        if self.rank == 0:
+            self._prune(version)
+        return version
+
+    def _commit(self, version: int, state: Any) -> None:
+        from dmlc_tpu.resilience import faultpoint
+
+        faultpoint("ckpt.commit")
         if self.per_rank or self.rank == 0:
             stream = create_stream(self._state_uri(version, self.rank), "w")
             try:
@@ -100,10 +149,15 @@ class CheckpointManager:
                 stream.close()
         if self.rank == 0:
             self._write_latest(version)
-        self._version = version
-        if self.rank == 0:
-            self._prune(version)
-        return version
+
+    def _fallback_manager(self) -> Optional["CheckpointManager"]:
+        if self._fallback is None and self._fallback_uri is not None:
+            self._fallback = CheckpointManager(
+                self._fallback_uri, rank=self.rank,
+                world_size=self.world_size, per_rank=self.per_rank,
+                keep=self.keep, fallback_uri="",  # no fallback chains
+            )
+        return self._fallback
 
     def load_checkpoint(self) -> Tuple[int, Optional[Any]]:
         """(version, state) of the newest committed checkpoint, or (0, None).
@@ -114,8 +168,23 @@ class CheckpointManager:
         tracker.py:279-291). In ``per_rank`` mode the commit point (rank
         0's LATEST) cannot guarantee every rank's file landed, so a missing
         state file falls back version by version through the retained
-        window before failing.
+        window before failing. With a fallback URI configured, whichever
+        location holds the newest committed version is loaded — a restart
+        after a degraded commit resumes from the fallback copy.
         """
+        fb = self._fallback_manager()
+        if fb is not None:
+            primary_latest = self._read_latest() or 0
+            if (fb._read_latest() or 0) > primary_latest:
+                version, state = fb.load_checkpoint()
+                self._version = max(self._version, version)
+                return version, state
+        return self._load_from_self()
+
+    def _load_from_self(self) -> Tuple[int, Optional[Any]]:
+        from dmlc_tpu.resilience import faultpoint
+
+        faultpoint("ckpt.read")
         latest = self._read_latest()
         if not latest:
             return 0, None

@@ -27,7 +27,6 @@ from __future__ import annotations
 import os
 import socket
 import threading
-import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -106,16 +105,20 @@ class SocketEngine:
         return conn
 
     def _connect(self, cmd: str, retries: int) -> None:
-        last_err = None
-        for attempt in range(retries):
-            try:
-                conn = self._dial_tracker(cmd)
-                break
-            except (ConnectionError, OSError) as err:
-                last_err = err
-                time.sleep(0.2 * (attempt + 1))
-        else:
-            raise DMLCError(f"cannot reach tracker: {last_err}")
+        from dmlc_tpu.resilience import RetryPolicy, faultpoint
+
+        def dial():
+            faultpoint("collective.connect")
+            return self._dial_tracker(cmd)
+
+        # classifier narrowed to connection errors on purpose: a DMLCError
+        # here is a bad-magic handshake (wrong service, version skew) and
+        # redialing the same port cannot fix it
+        conn = RetryPolicy(
+            max_attempts=max(1, retries), base_s=0.2, cap_s=2.0,
+            classify=lambda err: isinstance(err, (ConnectionError, OSError)),
+        ).call(dial, "collective.connect",
+               display=f"tracker {self.tracker_uri}:{self.tracker_port}")
 
         self.rank = conn.recv_int()
         self.parent_rank = conn.recv_int()
@@ -183,6 +186,9 @@ class SocketEngine:
     # ---- framed array transport ---------------------------------------
     @staticmethod
     def _send_array(conn: FramedSocket, arr: np.ndarray) -> None:
+        from dmlc_tpu.resilience import faultpoint
+
+        faultpoint("collective.send")
         payload = arr.tobytes()
         header = f"{arr.dtype.str}|{','.join(map(str, arr.shape))}"
         conn.send_str(header)
@@ -191,6 +197,9 @@ class SocketEngine:
 
     @staticmethod
     def _recv_array(conn: FramedSocket) -> np.ndarray:
+        from dmlc_tpu.resilience import faultpoint
+
+        faultpoint("collective.recv")
         header = conn.recv_str()
         # dtype.str may itself start with '|' (e.g. "|u1"), so split from the
         # right where the shape field is.

@@ -385,8 +385,18 @@ class RemoteBlockParser:
 
     def __init__(self, address: Tuple[str, int], timeout: float = 60.0):
         from dmlc_tpu import obs
+        from dmlc_tpu.resilience import RetryPolicy, faultpoint
 
-        self._sock = socket.create_connection(address, timeout=timeout)
+        def dial():
+            faultpoint("service.connect")
+            return socket.create_connection(address, timeout=timeout)
+
+        # the service may still be binding when a disaggregated client
+        # starts (tools/serve host races the training job): retry the
+        # dial under the shared policy instead of failing the first race
+        self._sock = RetryPolicy(max_attempts=5, base_s=0.2, cap_s=2.0).call(
+            dial, "service.connect", display=f"block service {address}"
+        )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.bytes_read = 0  # Parser API surface; obs mirror below
         self._m_read = obs.registry().counter(
@@ -396,8 +406,11 @@ class RemoteBlockParser:
         self._ended = False
 
     def next_block(self) -> Optional[RowBlock]:
+        from dmlc_tpu.resilience import faultpoint
+
         if self._ended:
             return None
+        faultpoint("service.next")
         self._sock.sendall(struct.pack("<I", _REQ_NEXT))
         try:
             arrays = _recv_arrays(self._sock)

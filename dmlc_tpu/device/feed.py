@@ -385,12 +385,15 @@ class DeviceFeed:
         )
 
     def _host_batches(self) -> Iterator:
+        from dmlc_tpu.resilience import faultpoint
+
         producer = (
             self._host_batches_native()
             if self._use_native_batches()
             else self._host_batches_python()
         )
         while True:
+            faultpoint("device.feed")
             t0 = time.monotonic_ns()
             try:
                 item = next(producer)

@@ -39,7 +39,7 @@ from dmlc_tpu.io.object_store import (
     _http,
     _keepalive_get,
     _ObjectStoreBase,
-    _retry_call,
+    _write_call,
 )
 from dmlc_tpu.io.stream import Stream
 from dmlc_tpu.utils.logging import check
@@ -233,7 +233,7 @@ class AzureBlobFileSystem(_ObjectStoreBase):
                     ):
                         pass
 
-                _retry_call(_put, f"azure Put Blob {key}")
+                _write_call(_put, "io.azure.write", f"azure Put Blob {key}")
                 self._block_ids = None  # finalize becomes a no-op
                 return
             if not data and last:
@@ -252,7 +252,7 @@ class AzureBlobFileSystem(_ObjectStoreBase):
                 with fs._request("PUT", url, payload=data):
                     pass
 
-            _retry_call(_put_block, f"azure Put Block {key}")
+            _write_call(_put_block, "io.azure.write", f"azure Put Block {key}")
             self._block_ids.append(block_id)
 
         def _finalize(self) -> None:
@@ -275,15 +275,20 @@ class AzureBlobFileSystem(_ObjectStoreBase):
                 with fs._request("PUT", url, payload=body):
                     pass
 
-            _retry_call(_commit, f"azure Put Block List {key}")
+            _write_call(_commit, "io.azure.write", f"azure Put Block List {key}")
 
     def _open_write(self, path: URI) -> Stream:
         return self._AzureWriteStream(self, path)
 
     def delete(self, path: URI) -> None:
         container, key = self._bucket_key(path)
-        with self._request("DELETE", self._url(container, key)):
-            pass
+
+        def _delete():
+            with self._request("DELETE", self._url(container, key)):
+                pass
+
+        # Delete Blob is idempotent; retry like the other backends do
+        _write_call(_delete, "io.azure.delete", f"azure Delete Blob {key}")
 
 
 from dmlc_tpu.io.filesystem import register_filesystem  # noqa: E402

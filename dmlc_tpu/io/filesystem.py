@@ -317,15 +317,20 @@ def read_range_with_retry(
     Retries continue from the bytes already delivered (the reconnect shape
     of s3_filesys.cc:319-342). A response whose body is shorter than its
     own Content-Length is a truncated connection and retries; a clean
-    response shorter than the asked range is EOF. Throttling responses
-    (408/429) retry like 5xx — the parallel readahead makes them likelier,
-    and aborting ingest on rate limiting would be a regression vs the
-    single-connection reconnect loop. ``cancelled()`` (optional) is checked
-    between attempts so a teardown can stop a long retry budget promptly.
+    response shorter than the asked range is EOF.
+
+    Retry discipline (classification, jittered backoff, deadline, budget,
+    ``dmlc_retry_*`` metrics under site ``io.read``) is delegated to
+    :class:`dmlc_tpu.resilience.RetryPolicy`; this loop keeps only the
+    range-specific parts: a delivered byte is progress and refills the
+    attempt count (bounded by the policy's absolute ceiling), 416 means
+    the offset is at/past EOF and returns empty. ``cancelled()``
+    (optional) is checked between attempts so a teardown can stop a long
+    retry budget promptly.
     """
-    import http.client as _hc
-    import time as _time
     import urllib.error
+
+    from dmlc_tpu.resilience import RetryPolicy, faultpoint
 
     # single preallocated buffer + readinto: the ingest hot path hands
     # every fetched byte to the native pipeline, so the fetch layer must
@@ -339,14 +344,16 @@ def read_range_with_retry(
         out = None
         view = into[:length]
     filled = 0
-    retries = max_retry
-    total_attempts = 0
+    state = RetryPolicy(max_attempts=max_retry, base_s=retry_sleep_s).start(
+        "io.read", display=f"range read of {display}"
+    )
     while filled < length:
         if cancelled is not None and cancelled():
             raise DMLCError(f"range read of {display} cancelled")
         want = length - filled
         got = 0  # bytes this attempt delivered (read in the except path)
         try:
+            faultpoint("io.read")
             with open_ranged(offset + filled, offset + length) as resp:
                 header = resp.headers.get("Content-Length")
                 expected = int(header) if header is not None else None
@@ -378,28 +385,14 @@ def read_range_with_retry(
                     )
             if filled < length and got < want:
                 break  # clean short bounded response: range hit EOF
-        except (urllib.error.URLError, OSError, _hc.HTTPException) as err:
-            if isinstance(err, urllib.error.HTTPError):
-                if err.code == 416:  # offset at/past EOF: empty range
-                    err.close()
-                    break
-                if err.code < 500 and err.code not in (408, 429):
-                    raise  # 4xx (except throttling): not transient
-            if got > 0:
-                # the connection delivered bytes before dropping: that is
-                # progress, not a stall — a long object over a flaky link
-                # must not exhaust the budget while still advancing
-                retries = max_retry
-            retries -= 1
-            total_attempts += 1
-            # absolute ceiling: progress resets must not turn a server
-            # that drips one byte per connection into a multi-day hang
-            if retries <= 0 or total_attempts >= max_retry * 10:
-                raise DMLCError(
-                    f"range read of {display} failed after "
-                    f"{total_attempts} attempts: {err}"
-                ) from err
-            _time.sleep(retry_sleep_s)
+        except Exception as err:  # noqa: BLE001 — the policy classifies
+            if isinstance(err, urllib.error.HTTPError) and err.code == 416:
+                err.close()  # offset at/past EOF: empty range
+                break
+            # a connection that delivered bytes before dropping made
+            # progress, not a stall — refill the attempt count (the policy
+            # caps total attempts so a byte-dripping server still bounds)
+            state.failed(err, progressed=got > 0)
     if into is not None:
         return filled
     if filled == length:
@@ -448,37 +441,44 @@ class RangedReadStream(SeekStream):
             self._resp = None
 
     def read(self, nbytes: int) -> bytes:
-        import time as _time
+        from dmlc_tpu.resilience import RetryPolicy, faultpoint
 
         if self._pos >= self._size:
             return b""
         nbytes = min(nbytes, self._size - self._pos)
         out = bytearray()
-        retries = self._max_retry
+        state = RetryPolicy(
+            max_attempts=self._max_retry, base_s=self._retry_sleep_s
+        ).start("io.read", display=f"reconnecting read of {self._display}")
+        progressed = False
         last_err: Optional[Exception] = None
         while len(out) < nbytes:
             try:
+                faultpoint("io.read")
                 if self._resp is None or self._resp_pos != self._pos:
                     self._drop()
                     self._resp = self._open_ranged(self._pos)
                     self._resp_pos = self._pos
                 chunk = self._resp.read(nbytes - len(out))
-            except Exception as err:  # noqa: BLE001 — reconnect like the reference
+            except Exception as err:  # noqa: BLE001 — the policy classifies
                 last_err = err
                 chunk = b""
             if chunk:
                 out.extend(chunk)
                 self._pos += len(chunk)
                 self._resp_pos = self._pos
+                progressed = True
             else:
                 self._drop()
-                retries -= 1
-                if retries <= 0:
-                    raise DMLCError(
-                        f"read failed after {self._max_retry} reconnects at "
-                        f"offset {self._pos} of {self._display}: {last_err}"
-                    )
-                _time.sleep(self._retry_sleep_s)
+                # a mid-body peer close surfaces as an empty read, not an
+                # exception — synthesize a transient error for the policy
+                state.failed(
+                    last_err if last_err is not None
+                    else OSError("connection closed mid-read"),
+                    progressed=progressed,
+                )
+                progressed = False
+                last_err = None
         return bytes(out)
 
     def close(self) -> None:

@@ -37,7 +37,6 @@ import io
 import json
 import os
 import threading
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -288,18 +287,26 @@ class ObjectWriteStream(Stream):
         raise NotImplementedError
 
 
-def _retry_call(fn, what: str):
-    """Retry a REST call ≤3 times (s3_filesys.cc:712-751)."""
-    last = None
-    for attempt in range(WRITE_MAX_RETRY):
-        try:
-            return fn()
-        except (urllib.error.URLError, OSError, DMLCError) as err:
-            if isinstance(err, urllib.error.HTTPError) and err.code < 500:
-                raise  # 4xx: not transient
-            last = err
-            time.sleep(READ_RETRY_SLEEP_S * (attempt + 1))
-    raise DMLCError(f"{what} failed after {WRITE_MAX_RETRY} retries: {last}")
+def _write_call(fn, site: str, what: str):
+    """One mutating REST call (s3_filesys.cc:712-751 shape) under the
+    shared retry policy, with an ``io.write`` faultpoint inside the
+    retried region so injected write faults exercise the same recovery
+    path real ones do.
+
+    This replaces the old ``_retry_call`` helper, which slept a full
+    backoff *after* the final failed attempt and treated throttling
+    (429/408) as fatal because ``code < 500`` — both fixed by
+    :class:`dmlc_tpu.resilience.RetryPolicy`'s loop and classifier.
+    """
+    from dmlc_tpu.resilience import RetryPolicy, faultpoint
+
+    def attempt():
+        faultpoint("io.write")
+        return fn()
+
+    return RetryPolicy(
+        max_attempts=WRITE_MAX_RETRY, base_s=READ_RETRY_SLEEP_S
+    ).call(attempt, site, display=what)
 
 
 # ---------------------------------------------------------------------------
@@ -525,7 +532,7 @@ class S3FileSystem(_ObjectStoreBase):
                 ns = tree.tag[: tree.tag.index("}") + 1] if tree.tag.startswith("{") else ""
                 return tree.findtext(f"{ns}UploadId")
 
-            self._upload_id = _retry_call(call, "InitiateMultipartUpload")
+            self._upload_id = _write_call(call, "io.s3.write", "InitiateMultipartUpload")
             check(self._upload_id, "no UploadId in InitiateMultipartUpload reply")
 
         def _upload_part(self, data: bytes, last: bool) -> None:
@@ -538,7 +545,7 @@ class S3FileSystem(_ObjectStoreBase):
                     with fs._request("PUT", url, payload=data):
                         pass
 
-                _retry_call(put, "PutObject")
+                _write_call(put, "io.s3.write", "PutObject")
                 self._part_no = -1  # mark single-shot done
                 return
             if self._upload_id is None:
@@ -553,7 +560,7 @@ class S3FileSystem(_ObjectStoreBase):
                 with fs._request("PUT", url, payload=data) as resp:
                     return resp.headers.get("ETag", "")
 
-            self._etags.append(_retry_call(call, f"UploadPart {n}"))
+            self._etags.append(_write_call(call, "io.s3.write", f"UploadPart {n}"))
 
         def _finalize(self) -> None:
             if self._part_no <= 0:  # single-shot PUT already complete
@@ -572,7 +579,7 @@ class S3FileSystem(_ObjectStoreBase):
                 with fs._request("POST", url, payload=body):
                     pass
 
-            _retry_call(call, "CompleteMultipartUpload")
+            _write_call(call, "io.s3.write", "CompleteMultipartUpload")
 
     def _open_write(self, path: URI) -> Stream:
         return self._S3WriteStream(self, path)
@@ -584,7 +591,7 @@ class S3FileSystem(_ObjectStoreBase):
             with self._request("DELETE", self._url(bucket, key)):
                 pass
 
-        _retry_call(call, "DeleteObject")
+        _write_call(call, "io.s3.delete", "DeleteObject")
 
 
 # ---------------------------------------------------------------------------
@@ -655,7 +662,7 @@ class GCSFileSystem(_ObjectStoreBase):
             with _http(req):
                 pass
 
-        _retry_call(call, "gcs DeleteObject")
+        _write_call(call, "io.gcs.delete", "gcs DeleteObject")
 
     def _list(self, bucket: str, prefix: str, delimiter: str):
         files: List[Tuple[str, int]] = []
@@ -708,7 +715,7 @@ class GCSFileSystem(_ObjectStoreBase):
                         "X-GUploader-UploadID"
                     )
 
-            self._session = _retry_call(call, "start resumable upload")
+            self._session = _write_call(call, "io.gcs.write", "start resumable upload")
             check(self._session, "no session URI from resumable upload start")
 
         def _upload_part(self, data: bytes, last: bool) -> None:
@@ -735,7 +742,7 @@ class GCSFileSystem(_ObjectStoreBase):
                 except urllib.error.HTTPError as err:
                     if err.code != 308:  # 308 = resume incomplete (expected)
                         raise
-            _retry_call(call, "resumable upload chunk")
+            _write_call(call, "io.gcs.write", "resumable upload chunk")
             self._offset += len(data)
 
         def _finalize(self) -> None:

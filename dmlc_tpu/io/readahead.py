@@ -377,10 +377,16 @@ class RemotePartitionReader:
                 raise PushRejected(str(err)) from err
 
     def __iter__(self) -> Iterator[bytes]:
-        def fetch(rng: Tuple[int, int, int]) -> bytes:
+        from dmlc_tpu.params.knobs import hedge_threshold_s
+        from dmlc_tpu.resilience import faultpoint, hedged_call
+
+        hedge_s = hedge_threshold_s()
+
+        def fetch_once(rng: Tuple[int, int, int]) -> bytes:
             idx, local, length = rng
             if self._cancel.is_set():
                 raise DMLCError("readahead cancelled")
+            faultpoint("readahead.fetch")
             if self._supports_cancel:
                 data = self._fs.read_range(
                     self._paths[idx], local, length,
@@ -395,5 +401,12 @@ class RemotePartitionReader:
                 self._paths[idx].str_full(), local, len(data), length,
             )
             return data
+
+        def fetch(rng: Tuple[int, int, int]) -> bytes:
+            # hedging is only safe here: fetch_once allocates its own
+            # buffer per attempt, so a duplicated request cannot race a
+            # shared destination (the feed_into/into= path must never
+            # hedge — two winners into one buffer is corruption)
+            return hedged_call(lambda: fetch_once(rng), hedge_s)
 
         return fetch_ordered(fetch, self.ranges(), workers=self._connections)

@@ -50,6 +50,7 @@ from dmlc_tpu.utils.logging import check
 
 READ_MAX_RETRY = 50
 READ_RETRY_SLEEP_S = 0.1
+WRITE_MAX_RETRY = 3  # idempotent CREATE only; APPEND is single-shot
 DEFAULT_WRITE_BUFFER_MB = 64
 DEFAULT_HTTP_PORT = 9870  # namenode web UI / WebHDFS default
 
@@ -228,11 +229,14 @@ class WebHDFSFileSystem(FileSystem):
 
 class _WebHDFSWriteStream(ObjectWriteStream):
     """Buffered CREATE-then-APPEND writer: the object stores' part-upload
-    base with HDFS's two REST steps. No per-call retry — WebHDFS APPEND is
-    not idempotent, so a blind resend could duplicate bytes; pipeline
-    recovery is HDFS's job. The base's close() marks the stream closed
-    BEFORE the final flush, so a failed close is not re-flushed from
-    __del__."""
+    base with HDFS's two REST steps. The retry split follows idempotency:
+    CREATE with ``overwrite=true`` replaces the whole file, so a resend
+    after an ambiguous failure converges on the same bytes and retries
+    under the shared policy; APPEND is NOT idempotent — if the datanode
+    committed the bytes but the ack was lost, a blind resend duplicates
+    them — so APPEND stays single-shot and pipeline recovery is HDFS's
+    job. The base's close() marks the stream closed BEFORE the final
+    flush, so a failed close is not re-flushed from __del__."""
 
     def __init__(self, fs: WebHDFSFileSystem, path: URI):
         super().__init__(fs._part_bytes)
@@ -241,12 +245,21 @@ class _WebHDFSWriteStream(ObjectWriteStream):
         self._created = False
 
     def _upload_part(self, data: bytes, last: bool) -> None:
+        from dmlc_tpu.resilience import RetryPolicy
+
         if not self._created:
-            self._fs._two_step_write(
-                "PUT", self._path.name, "CREATE", data, overwrite="true"
+            RetryPolicy(
+                max_attempts=WRITE_MAX_RETRY, base_s=READ_RETRY_SLEEP_S
+            ).call(
+                lambda: self._fs._two_step_write(
+                    "PUT", self._path.name, "CREATE", data, overwrite="true"
+                ),
+                "io.hdfs.create",
+                display=f"webhdfs CREATE {self._path.name}",
             )
             self._created = True
         elif data:
+            # single-shot on purpose: see the class docstring
             self._fs._two_step_write(
                 "POST", self._path.name, "APPEND", data
             )

@@ -28,6 +28,27 @@ The observability layer (dmlc_tpu/obs) adds three more:
   (empty = no file export, the default)
 - ``DMLC_TPU_HEARTBEAT_GAP`` — seconds without a worker heartbeat
   before the tracker logs it as a straggler (default 60)
+
+The resilience layer (dmlc_tpu/resilience) adds five more:
+
+- ``DMLC_TPU_RETRY_BUDGET`` — process-wide retry token bucket capacity
+  (0 = unlimited, the default; see resilience/retry.py)
+- ``DMLC_TPU_RETRY_DEADLINE_S`` — default wall-clock deadline per
+  retried logical call, seconds (0 = none, the default)
+- ``DMLC_TPU_FAULTS`` — deterministic fault-injection spec, e.g.
+  ``io.read:p=0.02:seed=7;collective.send:nth=3`` (empty = every
+  faultpoint is a shared no-op, the default)
+- ``DMLC_TPU_HEDGE_S`` — latency threshold in seconds after which the
+  readahead fetch path issues one hedged backup request (0 = hedging
+  off, the default)
+- ``DMLC_TPU_CKPT_FALLBACK_URI`` — secondary checkpoint directory that
+  ``CheckpointManager`` commits to when the primary URI exhausts its
+  retry budget (empty = no fallback, the default)
+
+``KNOWN_KNOBS`` below is the authoritative list of every
+``DMLC_TPU_*`` variable the tree reads; ``scripts/check_faultpoints.py``
+fails CI when a knob is referenced anywhere without being registered
+here.
 """
 
 from __future__ import annotations
@@ -87,3 +108,81 @@ def heartbeat_gap() -> float:
     """Straggler threshold in seconds for tracker heartbeats
     (``DMLC_TPU_HEARTBEAT_GAP``, default 60)."""
     return float(get_env("DMLC_TPU_HEARTBEAT_GAP", 60.0))
+
+
+def retry_budget_tokens() -> int:
+    """Process-wide retry token-bucket capacity
+    (``DMLC_TPU_RETRY_BUDGET``; 0 = unlimited, the default)."""
+    return max(0, get_env("DMLC_TPU_RETRY_BUDGET", 0))
+
+
+def retry_deadline_s() -> float:
+    """Default wall-clock deadline per retried logical call in seconds
+    (``DMLC_TPU_RETRY_DEADLINE_S``; 0 = no deadline, the default)."""
+    return max(0.0, float(get_env("DMLC_TPU_RETRY_DEADLINE_S", 0.0)))
+
+
+def faults_spec() -> str:
+    """The deterministic fault-injection spec (``DMLC_TPU_FAULTS``;
+    empty = faultpoints are a shared no-op, the default). Grammar in
+    resilience/faults.py and docs/robustness.md."""
+    return get_env("DMLC_TPU_FAULTS", "")
+
+
+def hedge_threshold_s() -> float:
+    """Latency threshold after which the readahead fetch path issues a
+    single hedged backup request (``DMLC_TPU_HEDGE_S``; 0 = hedging
+    off, the default)."""
+    return max(0.0, float(get_env("DMLC_TPU_HEDGE_S", 0.0)))
+
+
+def ckpt_fallback_uri() -> str:
+    """Secondary checkpoint directory used when commits to the primary
+    URI exhaust their retry budget (``DMLC_TPU_CKPT_FALLBACK_URI``;
+    empty = no fallback, the default)."""
+    return get_env("DMLC_TPU_CKPT_FALLBACK_URI", "")
+
+
+# Every DMLC_TPU_* env var the tree reads, in one place. The faultpoint
+# lint (scripts/check_faultpoints.py) greps the source for DMLC_TPU_*
+# literals and fails when one is missing from this registry, so a new
+# knob cannot ship undocumented.
+KNOWN_KNOBS = (
+    # ingest pipeline
+    "DMLC_TPU_NTHREAD",
+    "DMLC_TPU_PREFETCH",
+    "DMLC_TPU_HOST_PREFETCH",
+    "DMLC_TPU_READAHEAD_MB",
+    "DMLC_TPU_READAHEAD_CONNS",
+    "DMLC_TPU_FEED_PUT",
+    # native bridge
+    "DMLC_TPU_NATIVE",
+    "DMLC_TPU_NATIVE_LIB",
+    "DMLC_TPU_ABI_VERSION",
+    "DMLC_TPU_PALLAS",
+    # observability
+    "DMLC_TPU_METRICS",
+    "DMLC_TPU_TRACE",
+    "DMLC_TPU_TRACE_JAX",
+    "DMLC_TPU_METRICS_EXPORT",
+    "DMLC_TPU_HEARTBEAT_GAP",
+    # collective / distributed bootstrap
+    "DMLC_TPU_RECOVER_TIMEOUT",
+    "DMLC_TPU_RING_THRESHOLD_BYTES",
+    "DMLC_TPU_COORDINATOR",
+    "DMLC_TPU_NUM_PROC",
+    "DMLC_TPU_PROC_ID",
+    # resilience
+    "DMLC_TPU_RETRY_BUDGET",
+    "DMLC_TPU_RETRY_DEADLINE_S",
+    "DMLC_TPU_FAULTS",
+    "DMLC_TPU_HEDGE_S",
+    "DMLC_TPU_CKPT_FALLBACK_URI",
+    # bench harness
+    "DMLC_TPU_BENCH_DETAIL",
+    "DMLC_TPU_BENCH_DIR",
+    "DMLC_TPU_BENCH_PROBE_ATTEMPTS",
+    "DMLC_TPU_BENCH_PROBE_TIMEOUT",
+    "DMLC_TPU_BENCH_SOCKET_WORLD",
+    "DMLC_TPU_HARVEST_DIR",
+)

@@ -1,0 +1,61 @@
+"""dmlc_tpu.resilience — the unified fault-handling layer.
+
+Three pieces, one contract:
+
+- :mod:`~dmlc_tpu.resilience.retry` — :class:`RetryPolicy` (decorrelated
+  jitter, per-call deadline, process-wide budget, transient/fatal
+  classifier) behind every remote retry loop, with per-site
+  ``dmlc_retry_*`` metrics.
+- :mod:`~dmlc_tpu.resilience.faults` — deterministic
+  :func:`faultpoint` hooks armed by ``DMLC_TPU_FAULTS``; a shared no-op
+  when disabled.
+- :mod:`~dmlc_tpu.resilience.hedge` — :func:`hedged_call` backup
+  requests for tail-latency degradation (``DMLC_TPU_HEDGE_S``).
+
+See ``docs/robustness.md`` for the fault model, the faultpoint catalog,
+and the chaos-suite how-to.
+"""
+
+from dmlc_tpu.resilience.faults import (
+    FaultSpecError,
+    InjectedFault,
+    NOOP,
+    configure,
+    faultpoint,
+    injector,
+    parse_spec,
+    reset,
+)
+from dmlc_tpu.resilience.hedge import hedged_call
+from dmlc_tpu.resilience.retry import (
+    RetryBudget,
+    RetryPolicy,
+    RetryState,
+    TRANSIENT_HTTP_CODES,
+    backoff_sleep,
+    classify_transient,
+    global_budget,
+    reset_global_budget,
+    retry_call,
+)
+
+__all__ = [
+    "FaultSpecError",
+    "InjectedFault",
+    "NOOP",
+    "RetryBudget",
+    "RetryPolicy",
+    "RetryState",
+    "TRANSIENT_HTTP_CODES",
+    "backoff_sleep",
+    "classify_transient",
+    "configure",
+    "faultpoint",
+    "global_budget",
+    "hedged_call",
+    "injector",
+    "parse_spec",
+    "reset",
+    "reset_global_budget",
+    "retry_call",
+]

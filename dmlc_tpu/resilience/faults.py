@@ -1,0 +1,220 @@
+"""Deterministic fault injection: `faultpoint("site")` hooks that are a
+shared no-op until `DMLC_TPU_FAULTS` arms them.
+
+The recovery plane (tracker `cmd='recover'`, checkpoint-replay in
+`collective.run_with_recovery`) is only trustworthy if it is *exercised*,
+and monkeypatching internals from tests is both fragile and impossible
+across the `dmlc-submit` process boundary. Instead, the production code
+carries named faultpoints at every failure surface (catalog in
+`docs/robustness.md`, enforced by `scripts/check_faultpoints.py`) and an
+env spec arms them — so a chaos test is just an environment variable on
+a real training run.
+
+Spec grammar (``;``-separated site clauses, ``:``-separated options)::
+
+    DMLC_TPU_FAULTS="io.read:p=0.02:seed=7;collective.send:nth=3"
+
+- ``p=<float>``   — fire with probability p per pass, drawn from a
+  per-site ``random.Random(crc32(site) ^ seed)``. Same spec + seed ⇒
+  the same ops fault, run after run, regardless of which *other* sites
+  are armed (per-site streams don't perturb each other).
+- ``seed=<int>``  — seed for that site's stream (default 0).
+- ``nth=<int>``   — scripted: fire exactly on the Nth pass through the
+  site (1-based), once. ``times=<k>`` repeats it for the next k-1
+  passes too (``nth=3:times=2`` → passes 3 and 4).
+
+A fired faultpoint raises :class:`InjectedFault` — an ``OSError``
+subclass, so the retry classifier treats it as transient and the
+collective plane treats it as a peer failure, exactly like the real
+faults it stands in for.
+
+Disabled path: with ``DMLC_TPU_FAULTS`` unset, every ``faultpoint()``
+call dispatches to the module-level shared :data:`NOOP` injector whose
+``check`` is ``pass`` — no allocation, no branching on spec state —
+mirroring the ``DMLC_TPU_METRICS=0`` no-op-child pattern in
+``obs/metrics.py``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from dmlc_tpu.params.knobs import faults_spec
+from dmlc_tpu.utils.logging import DMLCError
+
+
+class InjectedFault(OSError):
+    """The error a fired faultpoint raises (transient + peer-failure)."""
+
+
+class FaultSpecError(DMLCError):
+    """A malformed ``DMLC_TPU_FAULTS`` spec (fail loud, not silently)."""
+
+
+class _SiteRule:
+    """One armed site: either probabilistic (p/seed) or scripted (nth)."""
+
+    __slots__ = ("site", "p", "nth", "times", "_rng", "_passes", "_lock")
+
+    def __init__(self, site: str, p: float, seed: int, nth: int, times: int):
+        self.site = site
+        self.p = p
+        self.nth = nth
+        self.times = times
+        # per-site stream: crc32(site) decorrelates sites sharing a seed,
+        # and keeps each site's draw sequence independent of which other
+        # sites are armed (the determinism the chaos tests rely on)
+        self._rng = random.Random(zlib.crc32(site.encode()) ^ seed)
+        self._passes = 0
+        self._lock = threading.Lock()
+
+    def should_fire(self) -> bool:
+        with self._lock:
+            self._passes += 1
+            n = self._passes
+            if self.nth > 0:
+                return self.nth <= n < self.nth + self.times
+            return self._rng.random() < self.p
+
+
+class FaultInjector:
+    """The armed implementation behind :func:`faultpoint`."""
+
+    def __init__(self, rules: Dict[str, _SiteRule]):
+        self._rules = rules
+        self.fired: List[Tuple[str, int]] = []  # (site, pass#) log
+        self._lock = threading.Lock()
+
+    def check(self, site: str) -> None:
+        rule = self._rules.get(site)
+        if rule is None or not rule.should_fire():
+            return
+        with self._lock:
+            self.fired.append((site, rule._passes))
+        self._count(site)
+        raise InjectedFault(f"injected fault at {site} "
+                            f"(pass {rule._passes})")
+
+    @staticmethod
+    def _count(site: str) -> None:
+        from dmlc_tpu import obs  # deferred; only on the (rare) fire path
+
+        obs.registry().counter(
+            "dmlc_fault_injected_total",
+            "faults fired by the injection harness", site=site).inc()
+
+    def sites(self) -> List[str]:
+        return sorted(self._rules)
+
+
+class _NoopInjector:
+    """Shared disabled-path injector: ``check`` must stay allocation-free."""
+
+    __slots__ = ()
+
+    def check(self, site: str) -> None:
+        pass
+
+    def sites(self) -> List[str]:
+        return []
+
+
+NOOP = _NoopInjector()
+
+
+def parse_spec(spec: str) -> Dict[str, _SiteRule]:
+    """Parse a ``DMLC_TPU_FAULTS`` string into per-site rules."""
+    rules: Dict[str, _SiteRule] = {}
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        site = parts[0].strip()
+        if not site:
+            raise FaultSpecError(f"empty site in fault spec clause "
+                                 f"{clause!r}")
+        p, seed, nth, times = 0.0, 0, 0, 1
+        for opt in parts[1:]:
+            if "=" not in opt:
+                raise FaultSpecError(
+                    f"fault option {opt!r} at {site!r} is not key=value")
+            key, _, val = opt.partition("=")
+            try:
+                if key == "p":
+                    p = float(val)
+                elif key == "seed":
+                    seed = int(val)
+                elif key == "nth":
+                    nth = int(val)
+                elif key == "times":
+                    times = int(val)
+                else:
+                    raise FaultSpecError(
+                        f"unknown fault option {key!r} at {site!r} "
+                        f"(want p/seed/nth/times)")
+            except ValueError as err:
+                raise FaultSpecError(
+                    f"bad value for {key!r} at {site!r}: {val!r}") from err
+        if nth <= 0 and not (0.0 < p <= 1.0):
+            raise FaultSpecError(
+                f"site {site!r} needs nth=<N> or p in (0, 1], got "
+                f"p={p} nth={nth}")
+        rules[site] = _SiteRule(site, p=p, seed=seed, nth=nth,
+                                times=max(1, times))
+    return rules
+
+
+_INJECTOR = NOOP
+_INIT_LOCK = threading.Lock()
+_INITIALIZED = False
+
+
+def _ensure_init() -> None:
+    global _INJECTOR, _INITIALIZED
+    if _INITIALIZED:
+        return
+    with _INIT_LOCK:
+        if _INITIALIZED:
+            return
+        spec = faults_spec()
+        if spec:
+            _INJECTOR = FaultInjector(parse_spec(spec))
+        _INITIALIZED = True
+
+
+def configure(spec: Optional[str]) -> None:
+    """(Re)arm the injector from an explicit spec — the in-process test
+    hook; production arms via ``DMLC_TPU_FAULTS`` at first use."""
+    global _INJECTOR, _INITIALIZED
+    with _INIT_LOCK:
+        _INJECTOR = FaultInjector(parse_spec(spec)) if spec else NOOP
+        _INITIALIZED = True
+
+
+def reset() -> None:
+    """Disarm and forget: the next :func:`faultpoint` re-reads the env."""
+    global _INJECTOR, _INITIALIZED
+    with _INIT_LOCK:
+        _INJECTOR = NOOP
+        _INITIALIZED = False
+
+
+def injector():
+    """The live injector (NOOP when disabled) — for tests/introspection."""
+    _ensure_init()
+    return _INJECTOR
+
+
+def faultpoint(site: str) -> None:
+    """Maybe raise :class:`InjectedFault` at ``site``.
+
+    The disabled fast path is one global load, one cheap ``_INITIALIZED``
+    check, and a no-op method call — safe to leave on hot paths.
+    """
+    if not _INITIALIZED:
+        _ensure_init()
+    _INJECTOR.check(site)

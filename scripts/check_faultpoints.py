@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Lint faultpoint sites and DMLC_TPU_* knobs against their registries.
+
+The resilience layer's contract is that every fault-injection site is
+discoverable: a chaos author reads the catalog in docs/robustness.md and
+writes a ``DMLC_TPU_FAULTS`` spec from it. A faultpoint that exists only
+in source silently weakens that contract, and a documented site that no
+longer exists makes specs silently inert. Same story for env knobs: a
+``DMLC_TPU_*`` variable read anywhere in the tree must be registered in
+``params.knobs.KNOWN_KNOBS`` (and thereby documented), or deployments
+cannot know it exists.
+
+Mirrors scripts/check_metric_names.py: walks dmlc_tpu/ + bench.py, and
+fails when
+
+- a ``faultpoint("...")`` site is not documented (backticked) in
+  docs/robustness.md, or is documented but no longer planted (stale
+  catalog), or does not follow the ``area.name`` site grammar
+  (lowercase dotted segments), or
+- a ``DMLC_TPU_*`` literal appears in source without being listed in
+  ``KNOWN_KNOBS``, or is listed there but never referenced anywhere
+  (dead registry entry).
+
+Run directly (exit code 0/1) or via tests/test_faultpoint_lint.py.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+DOC = ROOT / "docs" / "robustness.md"
+KNOBS = ROOT / "dmlc_tpu" / "params" / "knobs.py"
+
+# faultpoint("site") with a literal site — a computed site is invisible
+# to this lint and to chaos-spec authors, so sites stay literal
+SITE_CALL_RE = re.compile(r"\bfaultpoint\(\s*[\"']([^\"']+)[\"']")
+SITE_GRAMMAR_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+# sites appear backticked in the docs catalog table
+DOC_SITE_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
+KNOB_RE = re.compile(r"\bDMLC_TPU_[A-Z0-9_]+\b")
+
+
+def _walk():
+    files = sorted(ROOT.glob("dmlc_tpu/**/*.py")) + [ROOT / "bench.py"]
+    return [p for p in files if "tests" not in p.parts]
+
+
+def planted_sites() -> dict:
+    """site -> list of relative paths where faultpoint(site) is planted."""
+    out: dict = {}
+    for path in _walk():
+        if "resilience" in path.parts:
+            continue  # the harness itself defines, not plants, the hook
+        text = path.read_text()
+        for site in SITE_CALL_RE.findall(text):
+            out.setdefault(site, []).append(str(path.relative_to(ROOT)))
+    return out
+
+
+def documented_sites() -> set:
+    """Sites listed in the doc's "Faultpoint catalog" table.
+
+    Scoped to that section's table rows on purpose: the rest of the doc
+    backticks retry-site labels and module paths that are not
+    faultpoints."""
+    if not DOC.exists():
+        return set()
+    text = DOC.read_text()
+    marker = "Faultpoint catalog"
+    start = text.find(marker)
+    if start < 0:
+        return set()
+    section = text[start:]
+    nxt = section.find("\n#", 1)
+    if nxt > 0:
+        section = section[:nxt]
+    out = set()
+    for line in section.splitlines():
+        if line.lstrip().startswith("|"):
+            first_cell = line.split("|")[1] if "|" in line else ""
+            out.update(DOC_SITE_RE.findall(first_cell))
+    return out
+
+
+def referenced_knobs() -> dict:
+    """knob -> list of relative paths referencing it (knobs.py excluded)."""
+    out: dict = {}
+    for path in _walk():
+        if path == KNOBS:
+            continue
+        for knob in KNOB_RE.findall(path.read_text()):
+            out.setdefault(knob, []).append(str(path.relative_to(ROOT)))
+    return out
+
+
+def known_knobs() -> set:
+    # read KNOWN_KNOBS from source text, not by import: the lint must
+    # not depend on the package being importable to report on it
+    if not KNOBS.exists():
+        return set()
+    return set(KNOB_RE.findall(KNOBS.read_text()))
+
+
+def lint() -> list:
+    errors = []
+    sites = planted_sites()
+    documented = documented_sites()
+    if not sites:
+        errors.append(
+            "no faultpoint() sites found under dmlc_tpu/ — the lint's "
+            "call-site regex is probably out of sync with the faults API"
+        )
+    if not DOC.exists():
+        errors.append(f"missing {DOC.relative_to(ROOT)}")
+    for site, paths in sorted(sites.items()):
+        where = ", ".join(paths[:3])
+        if not SITE_GRAMMAR_RE.match(site):
+            errors.append(
+                f"{site}: faultpoint sites are lowercase dotted "
+                f"<area>.<name> segments  [{where}]"
+            )
+        if documented and site not in documented:
+            errors.append(
+                f"{site}: not documented in docs/robustness.md  [{where}]"
+            )
+    for site in sorted(documented - set(sites)):
+        errors.append(
+            f"{site}: documented in docs/robustness.md but never planted "
+            "in source"
+        )
+    knobs = referenced_knobs()
+    known = known_knobs()
+    if not known:
+        errors.append(
+            "no DMLC_TPU_* knobs found in params/knobs.py — KNOWN_KNOBS "
+            "is missing or the knob regex is out of sync"
+        )
+    for knob, paths in sorted(knobs.items()):
+        where = ", ".join(sorted(set(paths))[:3])
+        if knob not in known:
+            errors.append(
+                f"{knob}: referenced in source but not registered in "
+                f"params/knobs.py KNOWN_KNOBS  [{where}]"
+            )
+    for knob in sorted(known - set(knobs)):
+        errors.append(
+            f"{knob}: registered in params/knobs.py but never referenced "
+            "anywhere else in the tree"
+        )
+    return errors
+
+
+def main() -> int:
+    errors = lint()
+    for err in errors:
+        print(f"check_faultpoints: {err}")
+    if errors:
+        print(f"check_faultpoints: {len(errors)} error(s)")
+        return 1
+    print(
+        f"check_faultpoints: {len(planted_sites())} faultpoint site(s), "
+        f"{len(known_knobs())} knob(s) OK"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,3 +33,11 @@ jax.config.update("jax_platforms", "cpu")
 os.environ.setdefault("DMLC_LOG_STACK_TRACE", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running variants excluded from the tier-1 run "
+        "(-m 'not slow')",
+    )

@@ -129,6 +129,105 @@ def test_empty_latest_treated_as_no_checkpoint(tmp_path):
     assert CheckpointManager(str(uri)).load_checkpoint() == (0, None)
 
 
+def test_torn_state_write_not_visible(tmp_path):
+    """Torn-write safety: LATEST commits only after the state file is
+    fully written, so a crash mid-state-write must leave the previous
+    commit intact and loadable."""
+    from dmlc_tpu import resilience
+
+    uri = tmp_path / "ckpt"
+    mgr = CheckpointManager(str(uri), keep=3)
+    mgr.checkpoint({"step": 1})
+    # crash during the v2 state write (before LATEST moves): the commit
+    # faultpoint sits ahead of both writes in _commit
+    resilience.configure("ckpt.commit:nth=1")
+    try:
+        with pytest.raises(OSError):
+            mgr.checkpoint({"step": 2})
+    finally:
+        resilience.reset()
+    assert (uri / "LATEST").read_bytes().strip() == b"1"
+    recovered = CheckpointManager(str(uri))
+    version, state = recovered.load_checkpoint()
+    assert (version, state["step"]) == (1, 1)
+    # a half-written v2 file (torn write after the fault) is also
+    # invisible: LATEST still points at v1
+    (uri / "ckpt_v2.bin").write_bytes(b"\x00garbage")
+    version, state = CheckpointManager(str(uri)).load_checkpoint()
+    assert (version, state["step"]) == (1, 1)
+
+
+def test_prune_never_removes_latest_pointed_version(tmp_path):
+    """Retention must keep every version load_checkpoint can reach —
+    including the per_rank fallback window behind LATEST."""
+    uri = tmp_path / "ckpt"
+    mgr = CheckpointManager(str(uri), per_rank=True, keep=3)
+    for step in range(8):
+        mgr.checkpoint({"step": step})
+    latest = int((uri / "LATEST").read_bytes())
+    kept = {n for n in (p.name for p in uri.iterdir())
+            if n.startswith("ckpt_v")}
+    for version in range(latest - mgr.keep + 1, latest + 1):
+        assert f"ckpt_v{version}.rank0.bin" in kept
+    version, state = CheckpointManager(
+        str(uri), per_rank=True, keep=3).load_checkpoint()
+    assert (version, state["step"]) == (8, 7)
+
+
+def test_fallback_uri_commit_and_recover(tmp_path):
+    """Graceful degradation: a primary commit that fails lands on the
+    fallback URI, and a restarted manager resumes from it."""
+    from dmlc_tpu import resilience
+
+    primary = str(tmp_path / "primary")
+    fallback = str(tmp_path / "fallback")
+    mgr = CheckpointManager(primary, fallback_uri=fallback)
+    mgr.checkpoint({"step": 1})
+    resilience.configure("ckpt.commit:nth=1")  # primary commit fails
+    try:
+        assert mgr.checkpoint({"step": 2}) == 2
+    finally:
+        resilience.reset()
+    # v2 landed on the fallback; the primary still says v1
+    assert (tmp_path / "fallback" / "ckpt_v2.bin").exists()
+    assert (tmp_path / "primary" / "LATEST").read_bytes().strip() == b"1"
+    restarted = CheckpointManager(primary, fallback_uri=fallback)
+    version, state = restarted.load_checkpoint()
+    assert (version, state["step"]) == (2, 2)
+    # without the fallback configured, recovery sees only the primary
+    version, state = CheckpointManager(primary).load_checkpoint()
+    assert (version, state["step"]) == (1, 1)
+
+
+def test_fallback_env_knob(tmp_path, monkeypatch):
+    from dmlc_tpu import resilience
+
+    primary = str(tmp_path / "primary")
+    monkeypatch.setenv(
+        "DMLC_TPU_CKPT_FALLBACK_URI", str(tmp_path / "fb"))
+    mgr = CheckpointManager(primary)
+    resilience.configure("ckpt.commit:nth=1")
+    try:
+        assert mgr.checkpoint({"step": 1}) == 1
+    finally:
+        resilience.reset()
+    assert (tmp_path / "fb" / "ckpt_v1.bin").exists()
+
+
+def test_fallback_config_errors_not_degraded(tmp_path):
+    """A misconfigured primary (missing parent, permission wall) must
+    surface, not silently divert every checkpoint to the fallback."""
+    primary = tmp_path / "primary"
+    fallback = str(tmp_path / "fallback")
+    mgr = CheckpointManager(str(primary), fallback_uri=fallback)
+    import shutil
+
+    shutil.rmtree(primary)  # commit will now fail with FileNotFoundError
+    with pytest.raises(FileNotFoundError):
+        mgr.checkpoint({"step": 1})
+    assert not (tmp_path / "fallback" / "ckpt_v1.bin").exists()
+
+
 def test_jax_arrays_become_numpy(tmp_path):
     jax = pytest.importorskip("jax")
     mgr = CheckpointManager(str(tmp_path / "ckpt"))

@@ -1,0 +1,100 @@
+"""Faultpoint/knob lint (scripts/check_faultpoints.py) wired into the
+test suite: every planted faultpoint site must be documented in
+docs/robustness.md and every DMLC_TPU_* knob registered in
+params/knobs.py KNOWN_KNOBS."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_faultpoints.py")
+
+
+def test_faultpoints_lint():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.fixture()
+def lint_mod():
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import check_faultpoints
+        yield check_faultpoints
+    finally:
+        sys.path.pop(0)
+
+
+def _quiet_knobs(lint_mod, monkeypatch):
+    monkeypatch.setattr(
+        lint_mod, "referenced_knobs",
+        lambda: {"DMLC_TPU_GOOD": ["a.py"]})
+    monkeypatch.setattr(lint_mod, "known_knobs", lambda: {"DMLC_TPU_GOOD"})
+
+
+def test_lint_catches_site_violations(lint_mod, monkeypatch):
+    """The lint fires on undocumented/stale/malformed sites (guards
+    against the call-site regex or the rules rotting)."""
+    _quiet_knobs(lint_mod, monkeypatch)
+    monkeypatch.setattr(lint_mod, "planted_sites", lambda: {
+        "io.read": ["a.py"],
+        "io.undocumented": ["b.py"],
+        "BadSite": ["c.py"],
+    })
+    monkeypatch.setattr(
+        lint_mod, "documented_sites", lambda: {"io.read", "io.stale"})
+    errors = "\n".join(lint_mod.lint())
+    assert "io.undocumented: not documented" in errors
+    assert "BadSite: faultpoint sites are lowercase dotted" in errors
+    assert "io.stale: documented in docs/robustness.md but never planted" \
+        in errors
+    assert "io.read:" not in errors
+
+
+def test_lint_catches_knob_violations(lint_mod, monkeypatch):
+    monkeypatch.setattr(
+        lint_mod, "planted_sites", lambda: {"io.read": ["a.py"]})
+    monkeypatch.setattr(lint_mod, "documented_sites", lambda: {"io.read"})
+    monkeypatch.setattr(lint_mod, "referenced_knobs", lambda: {
+        "DMLC_TPU_KNOWN": ["a.py"],
+        "DMLC_TPU_ROGUE": ["b.py"],
+    })
+    monkeypatch.setattr(
+        lint_mod, "known_knobs", lambda: {"DMLC_TPU_KNOWN",
+                                          "DMLC_TPU_DEAD"})
+    errors = "\n".join(lint_mod.lint())
+    assert "DMLC_TPU_ROGUE: referenced in source but not registered" \
+        in errors
+    assert "DMLC_TPU_DEAD: registered in params/knobs.py but never " \
+        "referenced" in errors
+    assert "DMLC_TPU_KNOWN:" not in errors
+
+
+def test_lint_clean_set_passes(lint_mod, monkeypatch):
+    _quiet_knobs(lint_mod, monkeypatch)
+    monkeypatch.setattr(
+        lint_mod, "planted_sites", lambda: {"io.read": ["a.py"]})
+    monkeypatch.setattr(lint_mod, "documented_sites", lambda: {"io.read"})
+    assert lint_mod.lint() == []
+
+
+def test_catalog_sections_parse():
+    """The real doc/real tree parse to non-empty, consistent sets (the
+    subprocess test proves rc=0; this pins the parsers themselves)."""
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import check_faultpoints as cf
+        planted = cf.planted_sites()
+        documented = cf.documented_sites()
+        assert "io.read" in planted
+        assert "collective.send" in planted
+        assert set(planted) == documented
+        assert "DMLC_TPU_FAULTS" in cf.known_knobs()
+    finally:
+        sys.path.pop(0)
