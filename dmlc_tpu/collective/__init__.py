@@ -75,7 +75,14 @@ class _LocalEngine:
 
 
 def init(engine: str = "auto", **kwargs) -> None:
-    """Initialize the collective engine (rabit.init equivalent)."""
+    """Initialize the collective engine (rabit.init equivalent).
+
+    Also arms the crash flight recorder (obs/flight.py) when
+    ``DMLC_TPU_FLIGHTREC`` is set — worker entry runs through here, so
+    it is the natural per-process install point."""
+    from dmlc_tpu.obs import flight
+
+    flight.install_if_armed()
     global _engine
     with _engine_lock:
         if _engine is not None:
@@ -367,6 +374,7 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
     not supported; the restarted process must come back with the same
     jobid/rank).
     """
+    from dmlc_tpu.obs import flight
     from dmlc_tpu.resilience import backoff_sleep
 
     attempt = 0
@@ -391,7 +399,12 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
                 else:
                     recoverable = False
             if not recoverable or attempt >= max_attempts:
+                flight.record_event("collective.recover", attempt=attempt,
+                                    outcome="giveup", error=str(err))
+                flight.dump_if_injected(err)
                 raise
+            flight.record_event("collective.recover", attempt=attempt,
+                                outcome="retry", error=str(err))
             log_info(
                 "collective failure (%s); recovering, attempt %d/%d",
                 err, attempt, max_attempts,

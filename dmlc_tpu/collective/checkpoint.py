@@ -130,6 +130,10 @@ class CheckpointManager:
                 "checkpoint v%d commit to %s failed (%s); degrading to "
                 "fallback %s", version, self.uri, err, fb.uri,
             )
+            from dmlc_tpu.obs import flight
+
+            flight.record_event("ckpt.fallback", version=version,
+                                uri=self.uri, error=str(err))
             fb._version = version - 1  # keep version numbering aligned
             fb._commit(version, state)
         self._version = version

@@ -13,6 +13,14 @@ bottleneck diagnosis and auto-tuning):
   epoch boundaries by :func:`export_epoch` via ``DMLC_TPU_METRICS_EXPORT``
 - :func:`cross_host_snapshot` / :func:`report_skew` — per-host
   min/median/max over a ``collective.DeviceEngine`` allreduce
+- ``obs.plane`` — the job-wide observability plane: workers piggyback
+  metric/span payloads on tracker heartbeats; the tracker serves
+  ``/healthz /workers /metrics /trace`` over HTTP when
+  ``DMLC_TPU_STATUS_PORT`` is set (see obs/plane.py)
+- ``obs.flight`` — crash flight recorder: a bounded ring of recent
+  spans/metric deltas/resilience events dumped to
+  ``flightrec-rank<k>.json`` on fatal error when ``DMLC_TPU_FLIGHTREC``
+  names a directory (see obs/flight.py)
 
 Metric names follow ``dmlc_<area>_<name>_<unit>`` and every registered
 name is documented in docs/observability.md (enforced by
@@ -24,6 +32,7 @@ from dmlc_tpu.obs.exporters import (
     export_epoch,
     export_jsonl,
     export_prometheus,
+    prometheus_lines,
     summary_line,
 )
 from dmlc_tpu.obs.metrics import (
@@ -55,6 +64,7 @@ __all__ = [
     "export_epoch",
     "export_jsonl",
     "export_prometheus",
+    "prometheus_lines",
     "summary_line",
     "cross_host_snapshot",
     "report_skew",

@@ -5,15 +5,22 @@ Three sinks, all fed from :meth:`Registry.families` / ``snapshot()``:
 - **JSONL** (:func:`export_jsonl`) — appends one ``{"ts_unix": ...,
   "metrics": {...}}`` line per export; the machine-readable epoch trail.
 - **Prometheus textfile** (:func:`export_prometheus`) — the node-exporter
-  textfile-collector format, written atomically (tmp + rename) so a
-  scraper never reads a torn file.
+  textfile-collector format.
 - **Log sink** (:func:`summary_line`) — one compact ``k=v`` line through
-  ``utils.logging`` for epoch-boundary fit-loop logs.
+  ``utils.logging`` for epoch-boundary fit-loop logs; histograms render
+  as ``p50~<quantile>/<count>`` via :meth:`Histogram.quantile`.
+
+Both file sinks write atomically (tmp file + ``os.replace``) so a
+scraper — or the tracker status server's ``/metrics`` handler — never
+reads a torn file; JSONL preserves append semantics by rewriting the
+file with the new line attached.
 
 :func:`export_epoch` is the fit loops' single call: it honors the
 ``DMLC_TPU_METRICS_EXPORT`` knob (``*.prom`` → Prometheus, else JSONL),
-flushes any active trace, and returns the summary line for the caller to
-log. With the knob unset and no metrics, it is a cheap no-op.
+flushes any active trace, publishes an obs heartbeat to the tracker
+(when the worker runs under one — see obs/plane.py), and returns the
+summary line for the caller to log. With the knobs unset and no
+metrics, it is a cheap no-op.
 """
 
 from __future__ import annotations
@@ -21,18 +28,35 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Optional
+from typing import List, Optional
 
 from dmlc_tpu.obs import trace
 from dmlc_tpu.obs.metrics import Registry, format_name, registry
 from dmlc_tpu.params.knobs import metrics_export_path
 
 
+def _atomic_write(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
 def export_jsonl(path: str, reg: Optional[Registry] = None) -> None:
+    """Append one snapshot line, atomically: the previous content plus
+    the new line land via tmp + ``os.replace``, so a concurrent reader
+    sees either the old file or the new one — never a torn tail."""
     reg = reg or registry()
     line = json.dumps({"ts_unix": time.time(), "metrics": reg.snapshot()})
-    with open(path, "a") as fh:
-        fh.write(line + "\n")
+    prev = ""
+    try:
+        with open(path) as fh:
+            prev = fh.read()
+    except FileNotFoundError:
+        pass
+    if prev and not prev.endswith("\n"):
+        prev += "\n"
+    _atomic_write(path, prev + line + "\n")
 
 
 def _prom_labels(labelkey) -> str:
@@ -41,11 +65,12 @@ def _prom_labels(labelkey) -> str:
     return "{%s}" % ",".join('%s="%s"' % (k, v) for k, v in labelkey)
 
 
-def export_prometheus(path: str, reg: Optional[Registry] = None) -> None:
-    """Write the whole registry in Prometheus textfile format (cumulative
-    ``le`` buckets for histograms), atomically."""
+def prometheus_lines(reg: Optional[Registry] = None) -> List[str]:
+    """The registry rendered as Prometheus exposition lines (cumulative
+    ``le`` buckets for histograms) — shared by the textfile exporter and
+    the tracker status server's merged ``/metrics`` handler."""
     reg = reg or registry()
-    lines = []
+    lines: List[str] = []
     for name, (kind, help_, children) in sorted(reg.families().items()):
         if help_:
             lines.append("# HELP %s %s" % (name, help_))
@@ -63,17 +88,20 @@ def export_prometheus(path: str, reg: Optional[Registry] = None) -> None:
             else:
                 lines.append("%s%s %s"
                              % (name, _prom_labels(key), child.value))
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        fh.write("\n".join(lines) + "\n")
-    os.replace(tmp, path)
+    return lines
+
+
+def export_prometheus(path: str, reg: Optional[Registry] = None) -> None:
+    """Write the whole registry in Prometheus textfile format, atomically."""
+    _atomic_write(path, "\n".join(prometheus_lines(reg)) + "\n")
 
 
 def summary_line(prefix: Optional[str] = None,
                  reg: Optional[Registry] = None) -> str:
     """Compact one-line ``name=value`` summary (histograms as
-    ``sum/count``), optionally filtered to names starting with ``prefix``
-    — the log-sink form for epoch boundaries."""
+    ``p50~<median>/<count>`` — a typical value beats a raw sum for
+    eyeballing a log line), optionally filtered to names starting with
+    ``prefix`` — the log-sink form for epoch boundaries."""
     reg = reg or registry()
     parts = []
     for name, (kind, _help, children) in sorted(reg.families().items()):
@@ -82,7 +110,8 @@ def summary_line(prefix: Optional[str] = None,
         for key, child in sorted(children.items()):
             flat = format_name(name, key)
             if kind == "histogram":
-                parts.append("%s=%.0f/%d" % (flat, child.sum, child.count))
+                parts.append("%s=p50~%g/%d"
+                             % (flat, child.quantile(0.5), child.count))
             else:
                 v = child.value
                 parts.append("%s=%g" % (flat, v))
@@ -92,7 +121,8 @@ def summary_line(prefix: Optional[str] = None,
 def export_epoch(reg: Optional[Registry] = None,
                  log_prefix: Optional[str] = None) -> str:
     """Epoch-boundary export: write the ``DMLC_TPU_METRICS_EXPORT`` file
-    (if configured), flush the active trace (if any), and return the
+    (if configured), flush the active trace (if any), publish an obs
+    heartbeat to the tracker (if running under one), and return the
     log-sink summary line (callers decide whether/at what level to log
     it). Export failures degrade to a summary-only return — telemetry
     must never fail a fit loop."""
@@ -110,4 +140,10 @@ def export_epoch(reg: Optional[Registry] = None,
         trace.flush()
     except OSError:
         pass
+    # the worker side of the job observability plane: piggyback metrics +
+    # spans onto a tracker heartbeat. Cheap no-op outside a tracker job.
+    from dmlc_tpu.obs import flight, plane
+
+    plane.publish_epoch()
+    flight.recorder().note_metrics(reg)
     return summary_line(prefix=log_prefix, reg=reg)

@@ -172,6 +172,34 @@ class Histogram:
         out.append(("+Inf", acc + counts[-1]))
         return out
 
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (Prometheus
+        ``histogram_quantile`` semantics).
+
+        Linear interpolation inside the bucket holding the q-th
+        observation; the first bucket interpolates from 0, and anything
+        in the overflow bucket reports the last finite bound (the
+        distribution above it is unknown). Empty histogram → 0.0;
+        q clamps to [0, 1]."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return 0.0
+        q = min(1.0, max(0.0, float(q)))
+        target = q * total
+        acc = 0
+        for i, n in enumerate(counts[:-1]):
+            if n == 0:
+                continue
+            if acc + n >= target:
+                lo = float(self.bounds[i - 1]) if i > 0 else 0.0
+                hi = float(self.bounds[i])
+                frac = (target - acc) / n
+                return lo + (hi - lo) * max(0.0, min(1.0, frac))
+            acc += n
+        return float(self.bounds[-1])  # overflow bucket
+
 
 class _Noop:
     """Shared do-nothing child handed out when DMLC_TPU_METRICS=0. Every
@@ -204,6 +232,9 @@ class _Noop:
 
     def cumulative(self):
         return []
+
+    def quantile(self, q):
+        return 0.0
 
 
 NOOP = _Noop()

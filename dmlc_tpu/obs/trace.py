@@ -11,8 +11,24 @@ shared no-op context manager (two empty method calls per span). The env
 var is re-read per ``span()`` call — one dict lookup — so tests and
 long-lived processes can turn tracing on/off without re-imports.
 
+Timestamps are anchored to ``time.monotonic_ns()`` with a one-shot
+wall-clock anchor (``anchor_unix_ns``) captured at module import and
+recorded in the trace ``metadata`` block: span ``ts`` values are µs since
+the monotonic epoch, so an NTP step mid-job cannot fold or reorder the
+timeline, and consumers that need absolute time (the tracker's merged
+job trace, obs/plane.py) recover it as ``anchor_unix_ns + ts·1000``.
+The emitted JSON stays Perfetto-compatible — extra top-level keys next
+to ``traceEvents`` are part of the Chrome trace object format.
+
 Span durations are measured by :class:`dmlc_tpu.utils.timer.Timer` (the
 repo's one stopwatch — obs reuses it rather than growing a second one).
+
+Listeners: :func:`add_listener` registers a callback invoked with each
+completed span event. While any listener is registered, spans are
+recorded even without ``DMLC_TPU_TRACE`` (the listener IS the consumer —
+the flight recorder and the heartbeat span publisher both attach this
+way), but the in-process buffer only grows when a trace *file* is
+configured, so a listener alone cannot leak memory.
 
 Optional jax bridging: with ``DMLC_TPU_TRACE_JAX=1`` each span also enters
 a ``jax.profiler.TraceAnnotation`` (and ``step_span`` a
@@ -27,20 +43,31 @@ import atexit
 import json
 import os
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
-from dmlc_tpu.utils.timer import Timer, get_time
+from dmlc_tpu.utils.timer import Timer
 
 _lock = threading.Lock()
 _events: List[Dict] = []
+_listeners: List[Callable[[Dict], None]] = []
 _atexit_registered = False
-_EPOCH = get_time()  # trace timestamps are µs since process trace epoch
+# one-shot anchor pair: span ts are µs since _EPOCH_MONO_NS (NTP-immune);
+# _ANCHOR_UNIX_NS is the wall clock at that same instant, published in the
+# trace metadata so merged/absolute timelines can be reconstructed
+_EPOCH_MONO_NS = time.monotonic_ns()
+_ANCHOR_UNIX_NS = time.time_ns()
 
 _PID = os.getpid()
 
 
 def _now_us() -> float:
-    return (get_time() - _EPOCH) * 1e6
+    return (time.monotonic_ns() - _EPOCH_MONO_NS) / 1e3
+
+
+def anchor_unix_ns() -> int:
+    """Wall-clock ns at the trace epoch (span ``ts`` zero point)."""
+    return _ANCHOR_UNIX_NS
 
 
 def _jax_annotation_cls(step: bool = False):
@@ -101,8 +128,16 @@ class _Span:
         }
         if self.args:
             event["args"] = self.args
-        with _lock:
-            _events.append(event)
+        # the buffer backs the DMLC_TPU_TRACE file; listeners keep their
+        # own (bounded) state, so listener-only tracing cannot leak
+        if _active_path() is not None:
+            with _lock:
+                _events.append(event)
+        for fn in list(_listeners):
+            try:
+                fn(event)
+            except Exception:
+                pass  # telemetry consumers must never break the traced code
         return False
 
 
@@ -119,13 +154,32 @@ def _ensure_atexit() -> None:
         atexit.register(flush)
 
 
+def add_listener(fn: Callable[[Dict], None]) -> None:
+    """Register ``fn(event)`` to be called with every completed span.
+
+    Registering a listener also arms span recording (``span()`` returns a
+    live span while any listener exists, trace file or not)."""
+    with _lock:
+        if fn not in _listeners:
+            _listeners.append(fn)
+
+
+def remove_listener(fn: Callable[[Dict], None]) -> None:
+    with _lock:
+        try:
+            _listeners.remove(fn)
+        except ValueError:
+            pass
+
+
 def span(name: str, **args):
     """Context manager timing one pipeline stage as a named trace span.
 
     No-op (a shared inert object) unless ``DMLC_TPU_TRACE`` names an
-    output file. Keyword args become the event's ``args`` payload —
-    keep them small and JSON-serializable (chunk/batch indices)."""
-    if _active_path() is None:
+    output file or a listener is registered. Keyword args become the
+    event's ``args`` payload — keep them small and JSON-serializable
+    (chunk/batch indices)."""
+    if _active_path() is None and not _listeners:
         return NOOP_SPAN
     _ensure_atexit()
     cls = _jax_annotation_cls()
@@ -136,7 +190,7 @@ def span(name: str, **args):
 def step_span(step_num: int, name: str = "step", **args):
     """Like :func:`span` but bridges to ``jax.profiler.StepTraceAnnotation``
     (the profiler's step marker) when available — for fit-loop epochs."""
-    if _active_path() is None:
+    if _active_path() is None and not _listeners:
         return NOOP_SPAN
     _ensure_atexit()
     cls = _jax_annotation_cls(step=True)
@@ -150,9 +204,25 @@ def events() -> List[Dict]:
         return list(_events)
 
 
+def events_after(cursor: int) -> Tuple[List[Dict], int]:
+    """Buffered events past ``cursor`` plus the new cursor — the
+    incremental read the heartbeat span publisher batches from."""
+    with _lock:
+        return list(_events[cursor:]), len(_events)
+
+
 def clear() -> None:
     with _lock:
         _events.clear()
+
+
+def metadata() -> Dict:
+    """The trace-file metadata block (clock anchor + process identity)."""
+    return {
+        "clock": "monotonic_ns",
+        "anchor_unix_ns": _ANCHOR_UNIX_NS,
+        "pid": _PID,
+    }
 
 
 def flush(path: Optional[str] = None) -> Optional[str]:
@@ -164,7 +234,11 @@ def flush(path: Optional[str] = None) -> Optional[str]:
     if path is None:
         return None
     with _lock:
-        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        payload = {
+            "traceEvents": list(_events),
+            "displayTimeUnit": "ms",
+            "metadata": metadata(),
+        }
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(payload, fh)

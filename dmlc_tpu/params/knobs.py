@@ -29,6 +29,24 @@ The observability layer (dmlc_tpu/obs) adds three more:
 - ``DMLC_TPU_HEARTBEAT_GAP`` — seconds without a worker heartbeat
   before the tracker logs it as a straggler (default 60)
 
+The job observability plane (obs/plane.py + obs/flight.py) adds:
+
+- ``DMLC_TPU_STATUS_PORT`` — port for the tracker's HTTP status server
+  (0 = ephemeral; unset = no server, no thread, no socket — the default)
+- ``DMLC_TPU_STATUS_URI`` — ``host:port`` of the running status server,
+  exported *by* the tracker to workers (informational; never set it
+  yourself)
+- ``DMLC_TPU_OBS_PUBLISH`` — workers piggyback obs payloads on tracker
+  heartbeats when 1; exported by the tracker when its status plane is
+  armed (default off — a worker never surprises a reference tracker)
+- ``DMLC_TPU_OBS_PAYLOAD_MAX`` — byte cap for one heartbeat obs payload
+  (default 65536; oldest spans shed first, counted in
+  ``dmlc_obs_spans_dropped_total``)
+- ``DMLC_TPU_FLIGHTREC`` — directory the crash flight recorder dumps
+  ``flightrec-rank<k>.json`` into (empty = recorder off, the default)
+- ``DMLC_TPU_FLIGHTREC_CAP`` — flight-recorder ring capacity in records
+  (default 256)
+
 The resilience layer (dmlc_tpu/resilience) adds five more:
 
 - ``DMLC_TPU_RETRY_BUDGET`` — process-wide retry token bucket capacity
@@ -110,6 +128,40 @@ def heartbeat_gap() -> float:
     return float(get_env("DMLC_TPU_HEARTBEAT_GAP", 60.0))
 
 
+def status_port() -> Optional[int]:
+    """Tracker status-server port (``DMLC_TPU_STATUS_PORT``; 0 =
+    ephemeral). None — the default — means no server at all: the tracker
+    keeps the shared no-op plane, binds nothing, starts no thread."""
+    val = get_env("DMLC_TPU_STATUS_PORT", -1)
+    return None if val < 0 else val
+
+
+def obs_publish_enabled() -> bool:
+    """Whether this worker piggybacks obs payloads onto tracker
+    heartbeats (``DMLC_TPU_OBS_PUBLISH``; exported by the tracker when
+    its status plane is armed, default off)."""
+    return get_env("DMLC_TPU_OBS_PUBLISH", False)
+
+
+def obs_payload_max() -> int:
+    """Byte cap for one heartbeat obs payload
+    (``DMLC_TPU_OBS_PAYLOAD_MAX``, default 64 KiB, floor 1 KiB so the
+    liveness + clock-probe core always fits)."""
+    return max(1024, get_env("DMLC_TPU_OBS_PAYLOAD_MAX", 65536))
+
+
+def flightrec_dir() -> str:
+    """Crash flight-recorder dump directory (``DMLC_TPU_FLIGHTREC``;
+    empty = recorder off, the default)."""
+    return get_env("DMLC_TPU_FLIGHTREC", "")
+
+
+def flightrec_capacity() -> int:
+    """Flight-recorder ring capacity in records
+    (``DMLC_TPU_FLIGHTREC_CAP``, default 256, floor 16)."""
+    return max(16, get_env("DMLC_TPU_FLIGHTREC_CAP", 256))
+
+
 def retry_budget_tokens() -> int:
     """Process-wide retry token-bucket capacity
     (``DMLC_TPU_RETRY_BUDGET``; 0 = unlimited, the default)."""
@@ -166,6 +218,13 @@ KNOWN_KNOBS = (
     "DMLC_TPU_TRACE_JAX",
     "DMLC_TPU_METRICS_EXPORT",
     "DMLC_TPU_HEARTBEAT_GAP",
+    # job observability plane
+    "DMLC_TPU_STATUS_PORT",
+    "DMLC_TPU_STATUS_URI",
+    "DMLC_TPU_OBS_PUBLISH",
+    "DMLC_TPU_OBS_PAYLOAD_MAX",
+    "DMLC_TPU_FLIGHTREC",
+    "DMLC_TPU_FLIGHTREC_CAP",
     # collective / distributed bootstrap
     "DMLC_TPU_RECOVER_TIMEOUT",
     "DMLC_TPU_RING_THRESHOLD_BYTES",

@@ -95,6 +95,7 @@ class FaultInjector:
         with self._lock:
             self.fired.append((site, rule._passes))
         self._count(site)
+        self._record(site, rule._passes)
         raise InjectedFault(f"injected fault at {site} "
                             f"(pass {rule._passes})")
 
@@ -105,6 +106,12 @@ class FaultInjector:
         obs.registry().counter(
             "dmlc_fault_injected_total",
             "faults fired by the injection harness", site=site).inc()
+
+    @staticmethod
+    def _record(site: str, passes: int) -> None:
+        from dmlc_tpu.obs import flight  # deferred; only on the fire path
+
+        flight.record_event("fault.injected", site=site, n=passes)
 
     def sites(self) -> List[str]:
         return sorted(self._rules)

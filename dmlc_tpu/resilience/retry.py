@@ -177,6 +177,14 @@ class RetryState:
 
     def _give_up(self, err: BaseException, why: str):
         self._m_giveups.inc()
+        # flight-recorder tail: the give-up is exactly the moment whose
+        # preceding seconds a post-mortem wants; injected faults also
+        # trigger the chaos-suite dump
+        from dmlc_tpu.obs import flight
+
+        flight.record_event("retry.giveup", site=self.site, why=why,
+                            error=str(err))
+        flight.dump_if_injected(err)
         raise DMLCError(
             f"{self.display}: gave up after {self.total_attempts} "
             f"attempt(s) ({why}): {err}"

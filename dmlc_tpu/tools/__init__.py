@@ -41,6 +41,7 @@ _COMMANDS = {
     "rowrec": "dmlc_tpu.tools.rowrec",
     "serve": "dmlc_tpu.tools.serve",
     "parity": "dmlc_tpu.tools.parity",
+    "obs-report": "dmlc_tpu.tools.obs_report",
 }
 
 

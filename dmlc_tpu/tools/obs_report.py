@@ -1,0 +1,170 @@
+"""``python -m dmlc_tpu.tools obs-report`` — post-run job report.
+
+Renders a human-readable summary of a job's observability artifacts:
+
+- ``--flightrec DIR`` — scan ``flightrec-rank*.json`` crash dumps
+  (obs/flight.py): per-rank dump reason, resilience-event totals
+  (faults injected, retry give-ups, recoveries, checkpoint fallbacks),
+  and the tail of recorded spans.
+- ``--trace FILE`` — a merged job trace (the status server's ``/trace``
+  download, or any Chrome-trace JSON): per-stage time by rank and the
+  cross-rank slack table, widest stage first — the critical-path view.
+- ``--status HOST:PORT`` — fetch ``/workers`` and ``/trace`` from a
+  *live* tracker status server instead of files.
+
+Exit 0 with a report, 2 when no artifact source yields anything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_RESILIENCE_KINDS = ("fault.injected", "retry.giveup", "collective.recover",
+                     "ckpt.fallback", "uncaught")
+
+
+def _load_flightrecs(dirpath: str) -> List[Dict]:
+    dumps = []
+    for path in sorted(glob.glob(os.path.join(dirpath,
+                                              "flightrec-rank*.json"))):
+        try:
+            with open(path) as fh:
+                obj = json.load(fh)
+        except (OSError, ValueError) as err:
+            print(f"obs-report: skipping unreadable {path}: {err}",
+                  file=sys.stderr)
+            continue
+        obj["_path"] = path
+        dumps.append(obj)
+    return dumps
+
+
+def _report_flightrecs(dumps: List[Dict]) -> None:
+    print("== flight recorder dumps ==")
+    for obj in dumps:
+        records = obj.get("records", [])
+        kinds: Dict[str, int] = {}
+        for rec in records:
+            kinds[rec.get("kind", "?")] = kinds.get(rec.get("kind", "?"),
+                                                    0) + 1
+        print(f"rank {obj.get('rank', '?')}: reason={obj.get('reason')} "
+              f"records={len(records)} ({obj['_path']})")
+        resil = {k: v for k, v in kinds.items() if k in _RESILIENCE_KINDS}
+        if resil:
+            print("  resilience events: " + " ".join(
+                f"{k}={v}" for k, v in sorted(resil.items())))
+        tail = [r for r in records if r.get("kind") == "span"][-5:]
+        if tail:
+            print("  last spans: " + " ".join(
+                str(r.get("name")) for r in tail))
+        for rec in records:
+            if rec.get("kind") == "uncaught":
+                print(f"  uncaught: {rec.get('error')}: "
+                      f"{rec.get('message')}")
+
+
+def _stage_table(events: List[Dict]) -> Dict[str, Dict[int, float]]:
+    per_stage: Dict[str, Dict[int, float]] = {}
+    for e in events:
+        if e.get("ph") not in (None, "X"):
+            continue
+        name = e.get("name", "?")
+        rank = int(e.get("pid", 0))
+        per_stage.setdefault(name, {}).setdefault(rank, 0.0)
+        per_stage[name][rank] += float(e.get("dur", 0.0))
+    return per_stage
+
+
+def _report_trace(trace_obj: Dict) -> bool:
+    events = trace_obj.get("traceEvents", [])
+    per_stage = _stage_table(events)
+    if not per_stage:
+        print("== trace: no complete spans ==")
+        return False
+    print(f"== critical path ({len(events)} spans) ==")
+    rows = []
+    for name, per_rank in per_stage.items():
+        slack = max(per_rank.values()) - min(per_rank.values())
+        rows.append((slack, name, per_rank))
+    rows.sort(reverse=True)
+    print(f"{'stage':<28} {'slack_ms':>10} {'max_rank':>8}  per-rank ms")
+    for slack, name, per_rank in rows[:15]:
+        mx_rank = max(per_rank, key=lambda r: per_rank[r])
+        per = " ".join(f"{r}:{v / 1e3:.1f}"
+                       for r, v in sorted(per_rank.items()))
+        print(f"{name:<28} {slack / 1e3:>10.1f} {mx_rank:>8}  {per}")
+    return True
+
+
+def _report_workers(workers: Dict[str, Dict]) -> None:
+    print("== workers ==")
+    print(f"{'rank':>4} {'lag_s':>8} {'straggler':>9} {'epoch':>6} "
+          f"{'spans':>6} {'dropped':>7}")
+    for rank, info in sorted(workers.items(), key=lambda kv: int(kv[0])):
+        print(f"{rank:>4} {str(info.get('lag_s')):>8} "
+              f"{str(info.get('straggler')):>9} "
+              f"{str(info.get('epoch')):>6} {str(info.get('spans')):>6} "
+              f"{str(info.get('spans_dropped')):>7}")
+
+
+def _fetch(status: str, endpoint: str) -> Optional[Dict]:
+    from urllib.request import urlopen
+
+    url = f"http://{status}{endpoint}"
+    try:
+        with urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+    except (OSError, ValueError) as err:
+        print(f"obs-report: fetching {url} failed: {err}", file=sys.stderr)
+        return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="obs-report", description="Render a post-run job report from "
+        "observability artifacts.")
+    parser.add_argument("--flightrec", default=None,
+                        help="Directory holding flightrec-rank*.json dumps.")
+    parser.add_argument("--trace", default=None,
+                        help="Merged Chrome-trace JSON (the /trace "
+                        "download).")
+    parser.add_argument("--status", default=None,
+                        help="host:port of a live tracker status server.")
+    args = parser.parse_args(argv)
+    reported = False
+    if args.status:
+        workers = _fetch(args.status, "/workers")
+        if workers is not None:
+            _report_workers(workers)
+            reported = True
+        trace_obj = _fetch(args.status, "/trace")
+        if trace_obj is not None:
+            reported = _report_trace(trace_obj) or reported
+    if args.flightrec:
+        dumps = _load_flightrecs(args.flightrec)
+        if dumps:
+            _report_flightrecs(dumps)
+            reported = True
+    if args.trace:
+        try:
+            with open(args.trace) as fh:
+                trace_obj = json.load(fh)
+        except (OSError, ValueError) as err:
+            print(f"obs-report: cannot read trace {args.trace}: {err}",
+                  file=sys.stderr)
+        else:
+            reported = _report_trace(trace_obj) or reported
+    if not reported:
+        print("obs-report: nothing to report (pass --flightrec, --trace, "
+              "or --status)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

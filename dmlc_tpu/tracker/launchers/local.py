@@ -32,6 +32,11 @@ def submit(args) -> None:
             code = subprocess.Popen(cmd, env=full, shell=True).wait()
             if code == 0:
                 return
+            flight_dir = full.get("DMLC_TPU_FLIGHTREC")
+            if flight_dir:
+                print(f"{role} {task_id} exited {code}; flight-recorder "
+                      f"dump (if any): "
+                      f"{flight_dir}/flightrec-rank{task_id}.json")
             attempts -= 1
             if attempts > 0:
                 print(f"{role} {task_id} exited {code}; retrying "

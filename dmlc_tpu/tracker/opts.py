@@ -190,6 +190,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="Per-task restart attempts (DMLC_NUM_ATTEMPT / DMLC_MAX_ATTEMPT).",
     )
     parser.add_argument(
+        "--status-port", default=None, type=int,
+        help="Start the tracker HTTP status server on this port "
+        "(0 = ephemeral; sets DMLC_TPU_STATUS_PORT). Serves /healthz, "
+        "/workers, /metrics, /trace.",
+    )
+    parser.add_argument(
         "command", nargs=argparse.REMAINDER,
         help="Command to launch on every task.",
     )

@@ -26,8 +26,20 @@ TPU-new on top of the reference protocol: a lightweight ``heartbeat``
 command (send_heartbeat) lets running workers report liveness plus an
 epoch/metrics summary line; the tracker records last_seen per rank and
 logs workers whose gap exceeds ``DMLC_TPU_HEARTBEAT_GAP`` as stragglers.
+A straggler that reports again is logged as recovered
+(``dmlc_tracker_straggler_recoveries_total``) and re-armed, so a rank
+that flaps is warned about every time, not once forever.
 Reference trackers ignore unknown jobids, and our tracker treats the
 command as fire-and-forget, so the extension stays wire-compatible.
+
+The job observability plane (obs/plane.py) rides the same command: when
+``DMLC_TPU_STATUS_PORT`` is set the tracker starts an HTTP status server
+(/healthz, /workers, /metrics, /trace), advertises
+``DMLC_TPU_OBS_PUBLISH``/``DMLC_TPU_STATUS_URI`` to workers, and parses
+the optional ``\\nOBS1 <json>`` suffix workers then append to their
+heartbeat payloads (metric snapshot + span batch + clock probe). With
+the knob unset none of this exists: no socket, no thread, and heartbeat
+ingestion goes to the shared no-op plane.
 
 On TPU this socket machinery is only the *control* plane (CPU-parity runs and
 process bootstrap); the data plane is XLA collectives over ICI — see
@@ -36,6 +48,7 @@ dmlc_tpu.collective.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import socket
@@ -46,7 +59,8 @@ import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from dmlc_tpu import obs
-from dmlc_tpu.params.knobs import heartbeat_gap
+from dmlc_tpu.obs import plane as obs_plane
+from dmlc_tpu.params.knobs import heartbeat_gap, status_port
 from dmlc_tpu.utils.logging import DMLCError
 
 MAGIC = 0xFF99
@@ -322,20 +336,59 @@ class RabitTracker:
         self._hb_flagged: Set[int] = set()
         self._m_heartbeats = obs.registry().counter(
             "dmlc_tracker_heartbeats_total", "worker heartbeats received")
+        self._m_straggler_recoveries = obs.registry().counter(
+            "dmlc_tracker_straggler_recoveries_total",
+            "flagged stragglers that resumed heartbeating")
+        # job observability plane: live only when DMLC_TPU_STATUS_PORT is
+        # set; otherwise the shared no-op plane and no HTTP server at all
+        sp = status_port()
+        if sp is None:
+            self.plane = obs_plane.NOOP_PLANE
+            self.status: Optional[obs_plane.StatusServer] = None
+        else:
+            self.plane = obs_plane.StatusPlane(
+                num_workers=num_workers, heartbeat_gap=self.heartbeat_gap)
+            self.status = obs_plane.StatusServer(self.plane, port=sp)
+            self.status.start()
+            logger.info("status server on http://%s:%d (/healthz /workers "
+                        "/metrics /trace)", host_ip, self.status.port)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, object]:
-        """Env contract handed to workers (tracker.py:177-183)."""
-        return {"DMLC_TRACKER_URI": self.host_ip, "DMLC_TRACKER_PORT": self.port}
+        """Env contract handed to workers (tracker.py:177-183). When the
+        status plane is armed, workers are additionally told to publish
+        obs payloads and where the status server lives."""
+        envs: Dict[str, object] = {
+            "DMLC_TRACKER_URI": self.host_ip,
+            "DMLC_TRACKER_PORT": self.port,
+        }
+        if self.status is not None:
+            envs["DMLC_TPU_OBS_PUBLISH"] = 1
+            envs["DMLC_TPU_STATUS_URI"] = "%s:%d" % (
+                self.host_ip, self.status.port)
+        return envs
 
     # ---- heartbeat satellite -------------------------------------------
     def _note_heartbeat(self, rank: int, payload: str) -> None:
         """Record a worker's liveness report and flag stragglers: any
         other rank whose last report is older than ``heartbeat_gap``
-        seconds gets warned about once (re-flagged only after it
-        reports again)."""
-        now = time.time()
+        seconds gets warned about once per lapse. A flagged rank that
+        reports again is logged as recovered, counted, and re-armed —
+        a flapping worker is warned about every time it goes quiet.
+
+        The payload may carry an ``OBS1`` JSON suffix (obs/plane.py);
+        it is split off here and fed to the status plane."""
+        recv_unix_ns = time.time_ns()
+        now = recv_unix_ns / 1e9
+        obs_obj = None
+        if obs_plane.PAYLOAD_MARK in payload:
+            payload, _sep, blob = payload.partition(obs_plane.PAYLOAD_MARK)
+            try:
+                obs_obj = json.loads(blob)
+            except ValueError:
+                logger.warning("undecodable obs payload from rank %d", rank)
         with self._hb_lock:
+            recovered = rank in self._hb_flagged
             self._last_seen[rank] = now
             self._hb_info[rank] = payload
             self._hb_flagged.discard(rank)
@@ -346,6 +399,10 @@ class RabitTracker:
             ]
             self._hb_flagged.update(r for r, _ in stale)
         self._m_heartbeats.inc()
+        if recovered:
+            self._m_straggler_recoveries.inc()
+            logger.info("straggler recovered: rank %d is heartbeating "
+                        "again", rank)
         logger.debug("heartbeat from rank %d: %s", rank, payload)
         for r, gap in stale:
             logger.warning(
@@ -353,6 +410,9 @@ class RabitTracker:
                 "%.1fs); last report: %s",
                 r, gap, self.heartbeat_gap, self._hb_info.get(r, ""),
             )
+        self.plane.note_live(rank, now, payload)
+        if obs_obj is not None:
+            self.plane.note_payload(rank, obs_obj, recv_unix_ns)
 
     def heartbeats(self) -> Dict[int, Tuple[float, str]]:
         """Snapshot of rank → (last_seen unix time, last payload line)."""
@@ -388,8 +448,11 @@ class RabitTracker:
             if worker.cmd == "heartbeat":
                 try:
                     payload = worker.conn.recv_str()
-                    self._note_heartbeat(worker.rank, payload)
+                    # ack before processing: the worker measures this
+                    # round-trip as the RTT in its clock-skew probe, so
+                    # tracker-side parsing time must not inflate it
                     worker.conn.send_int(0)
+                    self._note_heartbeat(worker.rank, payload)
                 except (ConnectionError, OSError) as err:
                     logger.warning("heartbeat from %s failed: %s",
                                    worker.host, err)
@@ -497,6 +560,8 @@ class RabitTracker:
 
     def close(self) -> None:
         self.sock.close()
+        if self.status is not None:
+            self.status.close()
 
 
 def send_heartbeat(
@@ -506,11 +571,16 @@ def send_heartbeat(
     epoch: int = -1,
     metrics: str = "",
     timeout: float = 10.0,
+    obs_json: Optional[str] = None,
 ) -> None:
     """Worker-side heartbeat: one short-lived connection carrying the
     standard handshake with cmd="heartbeat" plus a free-form payload line
     (``epoch=N <metrics>`` — e.g. ``obs.summary_line()``). Waits for the
-    tracker's ack so a heartbeat observed by the caller is recorded."""
+    tracker's ack so a heartbeat observed by the caller is recorded.
+
+    ``obs_json`` (built by ``obs.plane.build_payload``) rides the same
+    string frame behind the ``OBS1`` marker — still one line of opaque
+    text to a tracker that does not know the extension."""
     sock = socket.create_connection((tracker_uri, tracker_port),
                                     timeout=timeout)
     conn = FramedSocket(sock)
@@ -526,6 +596,10 @@ def send_heartbeat(
         payload = f"epoch={epoch}"
         if metrics:
             payload += " " + metrics
+        if obs_json:
+            from dmlc_tpu.obs.plane import PAYLOAD_MARK
+
+            payload += PAYLOAD_MARK + obs_json
         conn.send_str(payload)
         conn.recv_int()  # ack
     finally:

@@ -7,6 +7,7 @@ per-cluster launcher's ``submit(args)`` (submit.py:43-56).
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 from dmlc_tpu.tracker.launchers import get_launcher
@@ -24,6 +25,10 @@ def config_logger(args) -> None:
 
 
 def submit(args) -> None:
+    # --status-port is sugar for the env knob the tracker actually reads
+    # (RabitTracker is constructed deep inside the launcher)
+    if getattr(args, "status_port", None) is not None:
+        os.environ["DMLC_TPU_STATUS_PORT"] = str(args.status_port)
     get_launcher(args.cluster).submit(args)
 
 

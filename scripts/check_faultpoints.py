@@ -19,7 +19,12 @@ fails when
   (lowercase dotted segments), or
 - a ``DMLC_TPU_*`` literal appears in source without being listed in
   ``KNOWN_KNOBS``, or is listed there but never referenced anywhere
-  (dead registry entry).
+  (dead registry entry), or
+- a flight-recorder hook ``record_event("kind", ...)`` (obs/flight.py)
+  uses an event kind not cataloged in docs/observability.md's
+  "Flight recorder event catalog" table, or the catalog lists a kind no
+  longer planted — the same discoverability contract as faultpoints,
+  since a post-mortem reader greps dumps by these kinds.
 
 Run directly (exit code 0/1) or via tests/test_faultpoint_lint.py.
 """
@@ -32,7 +37,9 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 DOC = ROOT / "docs" / "robustness.md"
+OBS_DOC = ROOT / "docs" / "observability.md"
 KNOBS = ROOT / "dmlc_tpu" / "params" / "knobs.py"
+FLIGHT = ROOT / "dmlc_tpu" / "obs" / "flight.py"
 
 # faultpoint("site") with a literal site — a computed site is invisible
 # to this lint and to chaos-spec authors, so sites stay literal
@@ -41,6 +48,8 @@ SITE_GRAMMAR_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 # sites appear backticked in the docs catalog table
 DOC_SITE_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
 KNOB_RE = re.compile(r"\bDMLC_TPU_[A-Z0-9_]+\b")
+# flight-recorder hooks: record_event("kind", ...) with a literal kind
+FLIGHT_CALL_RE = re.compile(r"\brecord_event\(\s*[\"']([^\"']+)[\"']")
 
 
 def _walk():
@@ -70,6 +79,39 @@ def documented_sites() -> set:
         return set()
     text = DOC.read_text()
     marker = "Faultpoint catalog"
+    start = text.find(marker)
+    if start < 0:
+        return set()
+    section = text[start:]
+    nxt = section.find("\n#", 1)
+    if nxt > 0:
+        section = section[:nxt]
+    out = set()
+    for line in section.splitlines():
+        if line.lstrip().startswith("|"):
+            first_cell = line.split("|")[1] if "|" in line else ""
+            out.update(DOC_SITE_RE.findall(first_cell))
+    return out
+
+
+def planted_flight_events() -> dict:
+    """event kind -> list of relative paths planting record_event(kind)."""
+    out: dict = {}
+    for path in _walk():
+        if path == FLIGHT:
+            continue  # the recorder defines the hook, plants carry kinds
+        for kind in FLIGHT_CALL_RE.findall(path.read_text()):
+            out.setdefault(kind, []).append(str(path.relative_to(ROOT)))
+    return out
+
+
+def documented_flight_events() -> set:
+    """Kinds listed in observability.md's "Flight recorder event catalog"
+    table (section-scoped like :func:`documented_sites`)."""
+    if not OBS_DOC.exists():
+        return set()
+    text = OBS_DOC.read_text()
+    marker = "Flight recorder event catalog"
     start = text.find(marker)
     if start < 0:
         return set()
@@ -131,6 +173,25 @@ def lint() -> list:
             f"{site}: documented in docs/robustness.md but never planted "
             "in source"
         )
+    events = planted_flight_events()
+    documented_events = documented_flight_events()
+    for kind, paths in sorted(events.items()):
+        where = ", ".join(paths[:3])
+        if not SITE_GRAMMAR_RE.match(kind):
+            errors.append(
+                f"{kind}: flight-recorder event kinds are lowercase "
+                f"dotted <area>.<name> segments  [{where}]"
+            )
+        if kind not in documented_events:
+            errors.append(
+                f"{kind}: flight-recorder event not cataloged in "
+                f"docs/observability.md  [{where}]"
+            )
+    for kind in sorted(documented_events - set(events)):
+        errors.append(
+            f"{kind}: cataloged in docs/observability.md but no "
+            "record_event() plants it"
+        )
     knobs = referenced_knobs()
     known = known_knobs()
     if not known:
@@ -162,6 +223,7 @@ def main() -> int:
         return 1
     print(
         f"check_faultpoints: {len(planted_sites())} faultpoint site(s), "
+        f"{len(planted_flight_events())} flight event kind(s), "
         f"{len(known_knobs())} knob(s) OK"
     )
     return 0

@@ -86,6 +86,32 @@ class TestHistogramBuckets:
         b = h.buckets()
         assert b["1"] == 1 and b["+Inf"] == 1
 
+    def test_quantile_interpolates_within_bucket(self):
+        h = Registry().histogram("dmlc_t_q_ns", buckets=(10, 100, 1000))
+        for v in (5, 5, 5, 50):  # 3 in (0,10], 1 in (10,100]
+            h.observe(v)
+        # p50 lands inside the first bucket: lo=0, hi=10, 2/3 through it
+        assert h.quantile(0.5) == pytest.approx(10 * (2 / 3))
+        # p100 lands in the second bucket at its upper edge
+        assert h.quantile(1.0) == pytest.approx(100)
+
+    def test_quantile_edges(self):
+        h = Registry().histogram("dmlc_t_qe_ns", buckets=(10, 100))
+        assert h.quantile(0.5) == 0.0          # empty histogram
+        h.observe(4)
+        assert h.quantile(0.0) == 0.0          # q=0 → bucket lower edge
+        assert h.quantile(-1.0) == 0.0         # q clamped up to 0
+        h.observe(10 ** 9)                      # overflow bucket
+        # overflow observations clamp to the last finite bound
+        assert h.quantile(1.0) == 100
+        assert h.quantile(2.0) == h.quantile(1.0)  # q clamped down
+
+    def test_quantile_noop_child(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_METRICS", "0")
+        h = Registry().histogram("dmlc_t_qn_ns")
+        h.observe(5)
+        assert h.quantile(0.5) == 0.0
+
 
 class TestDisabledPath:
     def test_disabled_returns_shared_noop(self, monkeypatch):
@@ -217,7 +243,7 @@ class TestExporters:
         reg = self._reg()
         line = obs.summary_line(reg=reg)
         assert 'dmlc_t_exp_total{k="v"}=7' in line
-        assert "dmlc_t_exp_ns=3/1" in line
+        assert "dmlc_t_exp_ns=p50~2.5/1" in line
         out = tmp_path / "epoch.prom"
         monkeypatch.setenv("DMLC_TPU_METRICS_EXPORT", str(out))
         got = obs.export_epoch(reg)
@@ -317,8 +343,24 @@ class TestHeartbeat:
             with caplog.at_level(_logging.WARNING, "dmlc_tpu.tracker"):
                 tracker._note_heartbeat(1, "epoch=1")
             assert not caplog.records
-            # rank 0 reporting again clears its flag
-            tracker._note_heartbeat(0, "epoch=1")
+            # rank 0 reporting again clears its flag, logs the recovery,
+            # and ticks the recovery counter
+            before = obs.registry().counter(
+                "dmlc_tracker_straggler_recoveries_total").value
+            with caplog.at_level(_logging.INFO, "dmlc_tpu.tracker"):
+                tracker._note_heartbeat(0, "epoch=1")
             assert 0 not in tracker._hb_flagged
+            assert any("straggler recovered: rank 0" in r.getMessage()
+                       for r in caplog.records)
+            assert obs.registry().counter(
+                "dmlc_tracker_straggler_recoveries_total"
+            ).value == before + 1
+            # re-armed: the same rank going quiet again re-warns
+            time.sleep(0.05)
+            caplog.clear()
+            with caplog.at_level(_logging.WARNING, "dmlc_tpu.tracker"):
+                tracker._note_heartbeat(1, "epoch=2")
+            assert any("straggler: rank 0" in r.getMessage()
+                       for r in caplog.records)
         finally:
             tracker.close()

@@ -1,0 +1,562 @@
+"""Job-wide observability plane (obs/plane.py + obs/flight.py): payload
+building under the size cap, skew-rebased merged traces, the tracker
+status server endpoints, the crash flight recorder, and obs-report.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dmlc_tpu import obs
+from dmlc_tpu.obs import flight, plane
+from dmlc_tpu.obs.metrics import Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(port, path, timeout=10):
+    url = "http://127.0.0.1:%d%s" % (port, path)
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _fake_span(name, ts_us, dur_us=5.0, tid=1):
+    return {"name": name, "ph": "X", "ts": float(ts_us),
+            "dur": float(dur_us), "pid": 0, "tid": tid}
+
+
+class TestBuildPayload:
+    def test_payload_shape_uncapped(self):
+        reg = Registry()
+        reg.counter("dmlc_t_pl_total").inc(3)
+        spans = [_fake_span("s", i) for i in range(4)]
+        blob, dropped = plane.build_payload(
+            rank=2, epoch=7, spans=spans, reg=reg, max_bytes=1 << 20)
+        assert dropped == 0
+        obj = json.loads(blob)
+        assert obj["v"] == 1 and obj["rank"] == 2 and obj["epoch"] == 7
+        assert obj["sent_unix_ns"] > 0 and obj["anchor_unix_ns"] > 0
+        assert obj["metrics"]["dmlc_t_pl_total"] == 3
+        assert [e["ts"] for e in obj["spans"]] == [0, 1, 2, 3]
+        assert obj["spans_dropped"] == 0
+
+    def test_cap_sheds_oldest_spans_first(self):
+        reg = Registry()
+        spans = [_fake_span("stage_%03d" % i, i) for i in range(128)]
+        before = obs.registry().counter(
+            "dmlc_obs_spans_dropped_total").value
+        blob, dropped = plane.build_payload(
+            rank=0, spans=spans, reg=reg, max_bytes=2048)
+        assert len(blob) <= 2048
+        obj = json.loads(blob)
+        kept = [e["ts"] for e in obj["spans"]]
+        assert dropped > 0 and dropped == obj["spans_dropped"]
+        assert dropped + len(kept) == 128
+        # newest survive: the kept list is the tail of the input
+        assert kept == list(range(128 - len(kept), 128))
+        assert obs.registry().counter(
+            "dmlc_obs_spans_dropped_total").value == before + dropped
+
+    def test_cap_drops_metrics_after_spans(self):
+        reg = Registry()
+        for i in range(64):
+            reg.counter("dmlc_t_fat_%02d_total" % i).inc(i)
+        spans = [_fake_span("s", i) for i in range(8)]
+        blob, dropped = plane.build_payload(
+            rank=0, spans=spans, reg=reg, max_bytes=256)
+        obj = json.loads(blob)
+        # everything sheddable is gone; the clock probe survives
+        assert obj["spans"] == [] and obj["metrics"] == {}
+        assert dropped == 8
+        assert obj["sent_unix_ns"] > 0
+
+
+class TestStatusPlane:
+    def _feed(self, sp, rank, anchor_ns, skew_ns, spans, rtt_ns=0):
+        """One payload from a worker whose clock runs ``skew_ns`` ahead
+        of the tracker's: anchor and send stamp both carry the skew, and
+        the tracker's receive stamp does not."""
+        true_send_ns = anchor_ns + 10 ** 9
+        sp.note_payload(rank, {
+            "v": 1, "rank": rank, "epoch": 1,
+            "anchor_unix_ns": anchor_ns + skew_ns,
+            "sent_unix_ns": true_send_ns + skew_ns,
+            "rtt_ns": rtt_ns,
+            "metrics": {}, "spans": spans, "spans_dropped": 0,
+        }, recv_unix_ns=true_send_ns)
+
+    def test_skew_rebase_merges_monotonically(self):
+        sp = plane.StatusPlane(num_workers=3, heartbeat_gap=60.0)
+        anchor = 1_700_000_000_000_000_000
+        skews = {0: 0, 1: 5_000_000_000, 2: -3_000_000_000}
+        # rank r's i-th span at TRUE time i*300 + r*100 µs: interleaved
+        # across ranks, so a correct rebase must interleave the merge
+        true_us = {}
+        for rank, skew in skews.items():
+            spans = []
+            for i in range(3):
+                t = i * 300 + rank * 100
+                true_us[(rank, i)] = t
+                spans.append(_fake_span("stage_a", t, dur_us=10))
+            self._feed(sp, rank, anchor, skew, spans)
+        doc = sp.merged_trace()
+        events = doc["traceEvents"]
+        assert len(events) == 9
+        # skew-rebased: per-rank constant clock error cancels out, so the
+        # merged order equals the TRUE wall order and ts gaps match it
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts)
+        expect = sorted(
+            (t, rank) for (rank, _i), t in true_us.items())
+        assert [(e["ts"], e["pid"]) for e in events] == [
+            (float(t - expect[0][0]), rank) for t, rank in expect]
+        assert doc["metadata"]["merged"] is True
+        assert doc["metadata"]["offsets_ns"] == {
+            str(r): -skew for r, skew in skews.items()}
+
+    def test_rtt_midpoint_in_offset(self):
+        sp = plane.StatusPlane(num_workers=1)
+        anchor = 10 ** 18
+        self._feed(sp, 0, anchor, skew_ns=1_000_000, spans=[],
+                   rtt_ns=400_000)
+        # offset = recv − sent − rtt/2 = −skew − rtt/2
+        assert sp.workers()["0"]["clock_offset_ns"] == -1_000_000 - 200_000
+
+    def test_stage_slack_and_straggler_gauges(self):
+        sp = plane.StatusPlane(num_workers=2, heartbeat_gap=60.0)
+        self._feed(sp, 0, 10 ** 18, 0,
+                   [_fake_span("step", 0, dur_us=1000)])
+        self._feed(sp, 1, 10 ** 18, 0,
+                   [_fake_span("step", 0, dur_us=4000)])
+        slack = sp.stage_slack()
+        assert slack["step"]["slack_us"] == 3000
+        assert slack["step"]["max_rank"] == 1
+        assert obs.registry().gauge(
+            "dmlc_job_stage_slack_ns", stage="step").value == 3000 * 1e3
+        assert obs.registry().gauge("dmlc_job_straggler_rank").value == 1
+
+    def test_lag_straggler_wins_over_slack(self):
+        sp = plane.StatusPlane(num_workers=2, heartbeat_gap=0.01)
+        # rank 1 is the span-slack straggler, but rank 0 went quiet —
+        # the heartbeat-lag candidate must win the gauge
+        self._feed(sp, 0, 10 ** 18, 0,
+                   [_fake_span("step", 0, dur_us=100)])
+        self._feed(sp, 1, 10 ** 18, 0,
+                   [_fake_span("step", 0, dur_us=9000)])
+        sp.note_live(0, time.time() - 5.0, "old")
+        sp.note_live(1, time.time(), "fresh")
+        sp.stage_slack()
+        assert obs.registry().gauge("dmlc_job_straggler_rank").value == 0
+        assert sp.workers()["0"]["straggler"] is True
+        assert sp.workers()["1"]["straggler"] is False
+
+    def test_merged_metrics_text_rank_labels(self):
+        sp = plane.StatusPlane(num_workers=1)
+        sp.note_payload(0, {
+            "sent_unix_ns": time.time_ns(), "anchor_unix_ns": 1,
+            "metrics": {'dmlc_w_x_total{k="v"}': 3.0,
+                        "dmlc_w_h_ns:sum": 5.0,
+                        "dmlc_w_h_ns:count": 2.0},
+            "spans": [],
+        }, recv_unix_ns=time.time_ns())
+        text = sp.merged_metrics_text(Registry())
+        assert 'dmlc_w_x_total{k="v",rank="0"} 3' in text
+        assert 'dmlc_w_h_ns_sum{rank="0"} 5' in text
+        assert 'dmlc_w_h_ns_count{rank="0"} 2' in text
+
+    def test_malformed_payload_ignored(self):
+        sp = plane.StatusPlane(num_workers=1)
+        sp.note_payload(0, "not a dict", recv_unix_ns=time.time_ns())
+        sp.note_payload(0, {"spans": "nope", "metrics": 3,
+                            "sent_unix_ns": 0}, time.time_ns())
+        assert sp.merged_trace()["traceEvents"] == []
+
+
+class TestStatusServer:
+    def test_endpoints_and_404(self):
+        sp = plane.StatusPlane(num_workers=1, heartbeat_gap=60.0)
+        sp.note_live(0, time.time(), "epoch=1")
+        sp.note_payload(0, {
+            "epoch": 1, "sent_unix_ns": time.time_ns(),
+            "anchor_unix_ns": time.time_ns(),
+            "metrics": {"dmlc_w_e_total": 1.0},
+            "spans": [_fake_span("srv_stage", 10)],
+        }, recv_unix_ns=time.time_ns())
+        srv = plane.StatusServer(sp, port=0)
+        srv.start()
+        try:
+            assert srv.port > 0
+            status, body = _get(srv.port, "/healthz")
+            health = json.loads(body)
+            assert status == 200 and health["status"] == "ok"
+            assert health["workers_seen"] == 1
+            assert health["workers_expected"] == 1
+            status, body = _get(srv.port, "/workers")
+            workers = json.loads(body)
+            assert workers["0"]["epoch"] == 1
+            assert workers["0"]["straggler"] is False
+            status, body = _get(srv.port, "/metrics")
+            text = body.decode()
+            assert 'dmlc_w_e_total{rank="0"} 1' in text
+            status, body = _get(srv.port, "/trace")
+            doc = json.loads(body)
+            assert [e["name"] for e in doc["traceEvents"]] == ["srv_stage"]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.port, "/nope")
+            assert err.value.code == 404
+        finally:
+            srv.close()
+
+
+class TestTrackerIntegration:
+    def test_armed_tracker_serves_worker_payloads(self, monkeypatch):
+        from dmlc_tpu.tracker.rendezvous import RabitTracker, send_heartbeat
+
+        monkeypatch.setenv("DMLC_TPU_STATUS_PORT", "0")
+        tracker = RabitTracker("127.0.0.1", num_workers=2)
+        try:
+            assert tracker.status is not None
+            envs = tracker.worker_envs()
+            assert envs["DMLC_TPU_OBS_PUBLISH"] == 1
+            assert envs["DMLC_TPU_STATUS_URI"] == (
+                "127.0.0.1:%d" % tracker.status.port)
+            tracker.start(2)
+            for rank in (0, 1):
+                reg = Registry()
+                reg.counter("dmlc_w_hb_total").inc(rank + 1)
+                blob, _ = plane.build_payload(
+                    rank=rank, epoch=1,
+                    spans=[_fake_span("hb_stage", 100 * rank)],
+                    reg=reg)
+                send_heartbeat("127.0.0.1", tracker.port, rank=rank,
+                               epoch=1, metrics="loss=0.5", obs_json=blob)
+            # the tracker acks before parsing (unbiased RTT), so poll
+            deadline = time.time() + 10
+            workers = {}
+            while time.time() < deadline:
+                workers = json.loads(
+                    _get(tracker.status.port, "/workers")[1])
+                if len(workers) == 2 and all(
+                        v["spans"] >= 1 for v in workers.values()):
+                    break
+                time.sleep(0.02)
+            assert set(workers) == {"0", "1"}
+            for v in workers.values():
+                assert v["payloads"] >= 1 and v["epoch"] == 1
+                assert "loss=0.5" in v["info"]
+            doc = json.loads(_get(tracker.status.port, "/trace")[1])
+            assert {e["pid"] for e in doc["traceEvents"]} == {0, 1}
+            text = _get(tracker.status.port, "/metrics")[1].decode()
+            assert "dmlc_tracker_heartbeats_total" in text
+            assert 'rank="1"' in text
+        finally:
+            tracker.close()
+
+    def test_unarmed_tracker_has_no_plane(self, monkeypatch):
+        from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+        monkeypatch.delenv("DMLC_TPU_STATUS_PORT", raising=False)
+        tracker = RabitTracker("127.0.0.1", num_workers=1)
+        try:
+            assert tracker.status is None
+            assert tracker.plane is plane.NOOP_PLANE
+            envs = tracker.worker_envs()
+            assert "DMLC_TPU_OBS_PUBLISH" not in envs
+            assert "DMLC_TPU_STATUS_URI" not in envs
+            assert not any(t.name == "dmlc-status-http"
+                           for t in threading.enumerate())
+        finally:
+            tracker.close()
+
+
+class TestPublisher:
+    def test_publisher_spans_reach_tracker(self, monkeypatch):
+        from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+        monkeypatch.setenv("DMLC_TPU_STATUS_PORT", "0")
+        tracker = RabitTracker("127.0.0.1", num_workers=1)
+        pub = None
+        try:
+            tracker.start(1)
+            pub = plane.ObsPublisher("127.0.0.1", tracker.port, rank=0,
+                                     reg=Registry())
+            # the publisher's listener arms span recording on its own
+            with obs.span("pub_stage"):
+                time.sleep(0.001)
+            assert pub.publish(epoch=4) is True
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                workers = tracker.plane.workers()
+                if workers.get("0", {}).get("spans", 0) >= 1:
+                    break
+                time.sleep(0.02)
+            assert workers["0"]["spans"] >= 1
+            assert workers["0"]["epoch"] == 4
+            # second publish carries the measured RTT as the skew probe
+            assert pub.publish(epoch=5) is True
+            assert pub._rtt_ns > 0
+        finally:
+            if pub is not None:
+                pub.close()
+            tracker.close()
+
+    def test_default_publisher_disabled_without_env(self, monkeypatch):
+        monkeypatch.delenv("DMLC_TPU_OBS_PUBLISH", raising=False)
+        monkeypatch.delenv("DMLC_TRACKER_URI", raising=False)
+        plane.reset_default_publisher()
+        try:
+            assert plane.default_publisher() is None
+            assert plane.publish_epoch() is False
+            # URI alone is not enough — the tracker must advertise
+            monkeypatch.setenv("DMLC_TRACKER_URI", "127.0.0.1")
+            plane.reset_default_publisher()
+            assert plane.default_publisher() is None
+        finally:
+            plane.reset_default_publisher()
+
+    def test_default_publisher_from_env_best_effort(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TRACKER_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_TRACKER_PORT", "1")  # nothing listens
+        monkeypatch.setenv("DMLC_TASK_ID", "5")
+        monkeypatch.setenv("DMLC_TPU_OBS_PUBLISH", "1")
+        plane.reset_default_publisher()
+        try:
+            pub = plane.default_publisher()
+            assert pub is not None and pub.rank == 5
+            # telemetry must never wedge the loop: failure returns False
+            assert pub.publish(epoch=1, timeout=2) is False
+        finally:
+            plane.reset_default_publisher()
+
+
+class TestFlightRecorder:
+    def test_ring_capacity_and_first_reason_wins(self, tmp_path):
+        rec = flight.FlightRecorder(str(tmp_path), capacity=4, rank=3)
+        for i in range(10):
+            rec.note("fault.injected", site="t", n=i)
+        records = rec.records()
+        assert len(records) == 4
+        assert [r["n"] for r in records] == [6, 7, 8, 9]
+        path = rec.dump("manual")
+        assert path == str(tmp_path / "flightrec-rank3.json")
+        assert rec.dump("later") == path  # duplicate-tolerant
+        obj = json.loads(open(path).read())
+        assert obj["reason"] == "manual" and obj["rank"] == 3
+        assert obj["capacity"] == 4 and len(obj["records"]) == 4
+
+    def test_span_listener_and_metric_deltas(self, tmp_path):
+        rec = flight.configure(str(tmp_path), capacity=32, rank=0)
+        try:
+            with obs.span("doomed_stage"):
+                pass
+            kinds = [r["kind"] for r in rec.records()]
+            assert "span" in kinds
+            assert any(r.get("name") == "doomed_stage"
+                       for r in rec.records())
+            reg = Registry()
+            reg.counter("dmlc_t_fr_total").inc(2)
+            rec.note_metrics(reg)
+            deltas = [r for r in rec.records() if r["kind"] == "metrics"]
+            assert deltas[-1]["delta"] == {"dmlc_t_fr_total": 2.0}
+            rec.note_metrics(reg)  # unchanged → no new record
+            assert len([r for r in rec.records()
+                        if r["kind"] == "metrics"]) == len(deltas)
+            flight.record_event("fault.injected", site="t.site", n=1)
+            assert rec.records()[-1]["kind"] == "fault.injected"
+        finally:
+            flight.reset()
+
+    def test_dump_if_injected_walks_cause_chain(self, tmp_path):
+        from dmlc_tpu.resilience.faults import InjectedFault
+        from dmlc_tpu.utils.logging import DMLCError
+
+        flight.configure(str(tmp_path), capacity=8, rank=1, install=False)
+        try:
+            assert flight.dump_if_injected(ValueError("real")) is None
+            try:
+                try:
+                    raise InjectedFault("injected: t.site")
+                except InjectedFault as fault:
+                    raise DMLCError("gave up") from fault
+            except DMLCError as err:
+                path = flight.dump_if_injected(err)
+            assert path is not None
+            obj = json.loads(open(path).read())
+            assert obj["reason"] == "injected_giveup"
+        finally:
+            flight.reset()
+
+    def test_uncaught_exception_dumps(self, tmp_path):
+        rec = flight.configure(str(tmp_path), capacity=8, rank=2)
+        try:
+            assert sys.excepthook == rec._on_uncaught
+            try:
+                raise RuntimeError("boom for test")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+            obj = json.loads(open(rec.path()).read())
+            assert obj["reason"] == "uncaught:RuntimeError"
+            last = obj["records"][-1]
+            assert last["kind"] == "uncaught"
+            assert last["message"] == "boom for test"
+        finally:
+            flight.reset()
+        assert sys.excepthook != rec._on_uncaught  # uninstall restored it
+
+    def test_disabled_is_shared_noop(self, monkeypatch):
+        monkeypatch.delenv("DMLC_TPU_FLIGHTREC", raising=False)
+        flight.reset()
+        try:
+            rec = flight.recorder()
+            assert rec is flight.NOOP_RECORDER
+            assert flight.install_if_armed() is False
+            flight.record_event("fault.injected", site="x")
+            assert rec.records() == [] and rec.dump() is None
+        finally:
+            flight.reset()
+
+    def test_worker_death_leaves_parseable_dump(self, tmp_path):
+        """A worker dying on an uncaught error leaves a flightrec dump
+        whose span tail names what it was doing, and obs-report renders
+        it — the chaos-suite post-mortem contract, end to end."""
+        script = tmp_path / "doomed.py"
+        script.write_text(textwrap.dedent(f"""
+            import sys, time
+            sys.path.insert(0, {REPO!r})
+            from dmlc_tpu import obs
+            from dmlc_tpu.obs import flight
+            assert flight.install_if_armed()
+            with obs.span("final_stage"):
+                time.sleep(0.001)
+            raise RuntimeError("fatal for test")
+        """))
+        out_dir = tmp_path / "rec"
+        proc = subprocess.run(
+            [sys.executable, str(script)], capture_output=True, text=True,
+            timeout=60,
+            env={**os.environ, "DMLC_TPU_FLIGHTREC": str(out_dir),
+                 "DMLC_TASK_ID": "2"},
+        )
+        assert proc.returncode != 0
+        assert "fatal for test" in proc.stderr  # kill semantics survive
+        dump = out_dir / "flightrec-rank2.json"
+        obj = json.loads(dump.read_text())
+        assert obj["reason"] == "uncaught:RuntimeError"
+        assert any(r.get("kind") == "span"
+                   and r.get("name") == "final_stage"
+                   for r in obj["records"])
+        report = subprocess.run(
+            [sys.executable, "-m", "dmlc_tpu.tools", "obs-report",
+             "--flightrec", str(out_dir)],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        assert report.returncode == 0, report.stderr
+        assert "rank 2" in report.stdout
+        assert "final_stage" in report.stdout
+        assert "uncaught: RuntimeError" in report.stdout
+
+
+class TestObsReport:
+    def test_trace_report_and_exit_codes(self, tmp_path, capsys):
+        from dmlc_tpu.tools import obs_report
+
+        doc = {"traceEvents": [
+            {"name": "step", "ph": "X", "ts": 0, "dur": 4000, "pid": 0},
+            {"name": "step", "ph": "X", "ts": 10, "dur": 1000, "pid": 1},
+        ]}
+        path = tmp_path / "trace.json"
+        path.write_text(json.dumps(doc))
+        assert obs_report.main(["--trace", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "critical path" in out and "step" in out
+        assert obs_report.main([]) == 2
+        assert obs_report.main(
+            ["--trace", str(tmp_path / "missing.json")]) == 2
+        assert obs_report.main(
+            ["--flightrec", str(tmp_path / "empty")]) == 2
+
+
+WORKER_SCRIPT = textwrap.dedent("""
+    import json, os, sys, time, urllib.request
+    sys.path.insert(0, {repo!r})
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    from dmlc_tpu import obs
+    from dmlc_tpu.obs import plane
+    from dmlc_tpu.collective.socket_engine import SocketEngine
+
+    eng = SocketEngine()
+    pub = plane.default_publisher()
+    assert pub is not None, "tracker did not advertise obs publish"
+    with obs.span("e2e_stage"):
+        time.sleep(0.01 * (eng.rank + 1))
+    assert plane.publish_epoch(), "obs publish failed"
+    eng.allreduce(np.ones(1, dtype=np.float32))  # everyone published
+    if eng.rank == 0:
+        status = os.environ["DMLC_TPU_STATUS_URI"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            workers = json.load(urllib.request.urlopen(
+                "http://%s/workers" % status, timeout=5))
+            if len(workers) == 3 and all(
+                    v["spans"] >= 1 for v in workers.values()):
+                break
+            time.sleep(0.1)
+        out = {{"workers": workers}}
+        out["healthz"] = json.load(urllib.request.urlopen(
+            "http://%s/healthz" % status, timeout=5))
+        out["trace"] = json.load(urllib.request.urlopen(
+            "http://%s/trace" % status, timeout=5))
+        out["metrics_text"] = urllib.request.urlopen(
+            "http://%s/metrics" % status, timeout=5).read().decode()
+        with open({outfile!r}, "w") as fh:
+            json.dump(out, fh)
+    eng.shutdown()
+""")
+
+
+class TestLocalEndToEndStatusPlane:
+    def test_dmlc_submit_serves_merged_job_trace(self, tmp_path):
+        """Acceptance: dmlc-submit --cluster=local -n 3 --status-port 0
+        serves all four endpoints while the job runs, and /trace holds
+        skew-rebased, monotonically consistent spans from all ranks."""
+        outfile = tmp_path / "endpoints.json"
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_SCRIPT.format(repo=REPO,
+                                               outfile=str(outfile)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "dmlc-submit"),
+             "--cluster", "local", "-n", "3", "--host-ip", "127.0.0.1",
+             "--status-port", "0", sys.executable, str(script)],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        got = json.loads(outfile.read_text())
+        assert got["healthz"]["status"] == "ok"
+        assert got["healthz"]["workers_seen"] == 3
+        workers = got["workers"]
+        assert set(workers) == {"0", "1", "2"}
+        for v in workers.values():
+            assert v["spans"] >= 1 and v["payloads"] >= 1
+        events = got["trace"]["traceEvents"]
+        assert {e["pid"] for e in events} == {0, 1, 2}
+        stages = {e["pid"]: e for e in events if e["name"] == "e2e_stage"}
+        assert set(stages) == {0, 1, 2}
+        # merged + skew-rebased: one global, monotone timeline
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts) and min(ts) == 0
+        assert got["trace"]["metadata"]["merged"] is True
+        assert set(got["trace"]["metadata"]["offsets_ns"]) == {
+            "0", "1", "2"}
+        text = got["metrics_text"]
+        assert "dmlc_tracker_heartbeats_total" in text
+        assert 'rank="2"' in text
