@@ -14,6 +14,16 @@ tracker_print``. Engines:
 
 ``init()`` picks automatically: DMLC_TRACKER_URI set → socket; multi-process
 JAX runtime → device; else local.
+
+Elastic membership (socket engine only; docs/robustness.md "Elastic
+membership"): with ``DMLC_TPU_ELASTIC`` set, a collective failure
+re-enters the tracker's *next* generation (``reenter_elastic``) instead
+of recovering into the fixed world, ``elastic_sync()`` polls for pending
+transitions at checkpoint boundaries, and ``broadcast_state()`` ships
+the model from rank 0 to freshly admitted ranks. A process launched with
+``DMLC_TPU_SPARE`` (the launcher's ``--spares`` tasks) parks in
+``init()`` on the tracker's ``join`` handshake until a transition
+activates it — or exits 0 when the job finishes without needing it.
 """
 
 from __future__ import annotations
@@ -40,6 +50,7 @@ from dmlc_tpu.collective.socket_engine import SocketEngine
 from dmlc_tpu.io.serializer import load_obj, save_obj
 from dmlc_tpu.io.stream import MemoryStream
 from dmlc_tpu.io.filesystem import create_stream
+from dmlc_tpu.params.knobs import elastic_enabled, is_spare
 from dmlc_tpu.utils.logging import DMLCError, check, log_info
 
 _engine = None
@@ -74,6 +85,29 @@ class _LocalEngine:
         pass
 
 
+def _spare_wait(kwargs) -> dict:
+    """Warm-spare bootstrap (DMLC_TPU_SPARE, set by the launcher's
+    ``--spares`` tasks): park on the tracker's ``join`` handshake until a
+    membership transition activates this process, then return the kwargs
+    overrides for a cmd='elastic' rendezvous into the new generation. If
+    the job finishes without ever needing the spare, the tracker closes
+    the parked connection and this process exits 0 — never being needed
+    is the clean outcome, not a failure."""
+    from dmlc_tpu.tracker.rendezvous import SpareUnused, request_join
+
+    uri = kwargs.get("tracker_uri") or os.environ.get("DMLC_TRACKER_URI")
+    port = int(kwargs.get("tracker_port")
+               or os.environ.get("DMLC_TRACKER_PORT", 0))
+    jobid = kwargs.get("jobid") or os.environ.get("DMLC_TASK_ID", "NULL")
+    try:
+        generation = request_join(uri, port, jobid=jobid, spare=True)
+    except SpareUnused:
+        log_info("warm spare %s never activated; exiting clean", jobid)
+        raise SystemExit(0)
+    log_info("warm spare %s called up into generation %d", jobid, generation)
+    return {"cmd": "elastic", "rank": -1, "world_size": -1}
+
+
 def init(engine: str = "auto", **kwargs) -> None:
     """Initialize the collective engine (rabit.init equivalent).
 
@@ -95,6 +129,8 @@ def init(engine: str = "auto", **kwargs) -> None:
 
                 engine = "device" if jax.process_count() > 1 else "local"
         if engine == "socket":
+            if is_spare():
+                kwargs = dict(kwargs, **_spare_wait(kwargs))
             _engine = SocketEngine(**kwargs)
         elif engine == "device":
             _engine = DeviceEngine(**kwargs)
@@ -332,6 +368,127 @@ def _reinit_device_engine() -> None:
         watchdog.cancel()
 
 
+# ---- elastic membership (socket engine; docs/robustness.md) ---------------
+
+
+_ELASTIC_TAG = "dmlc_elastic_state_v1"
+
+
+def _encode_state(state: Any, version: int) -> np.ndarray:
+    """Serialize (tag, version, state) into a uint8 array so model state
+    can travel over ``broadcast`` — the same Serializable building blocks
+    the checkpoint path uses."""
+    stream = MemoryStream()
+    save_obj(stream, (_ELASTIC_TAG, int(version), state))
+    return np.frombuffer(stream.getvalue(), dtype=np.uint8)
+
+
+def _decode_state(blob: np.ndarray):
+    """Inverse of ``_encode_state``: returns ``(version, state)``."""
+    payload = load_obj(MemoryStream(np.asarray(blob, dtype=np.uint8).tobytes()))
+    check(
+        isinstance(payload, tuple) and len(payload) == 3
+        and payload[0] == _ELASTIC_TAG,
+        "broadcast_state payload is not an elastic state frame",
+    )
+    return int(payload[1]), payload[2]
+
+
+def broadcast_state(state: Any = None, root: int = 0) -> Any:
+    """Ship model state (plus the checkpoint version) from ``root`` to
+    every rank — the scale-up bootstrap: a freshly admitted rank or warm
+    spare receives the current model from rank 0 instead of reading a
+    checkpoint it never took. Non-root ranks also adopt the root's
+    ``version_number()``, so version-gated loops agree across old and new
+    members. Returns the state on every rank; the root's own copy
+    round-trips through serialization, so all ranks hold bit-identical
+    state."""
+    global _version
+    eng = _get()
+    if eng.world_size == 1:
+        check(state is not None, "broadcast_state root must supply state")
+        return state
+    if eng.rank == root:
+        check(state is not None, "broadcast_state root must supply state")
+        blob = _encode_state(state, _version)
+    else:
+        blob = None
+    out = eng.broadcast(blob, root=root)
+    version, new_state = _decode_state(out)
+    _version = version
+    return new_state
+
+
+def reenter_elastic() -> int:
+    """Abort the engine and rendezvous into the tracker's *next*
+    membership generation (tracker cmd='elastic').
+
+    Unlike ``reinit_recover`` — which reclaims the same rank in the same
+    fixed world — the tracker reassigns rank and world size from whoever
+    shows up: survivors of a dead rank, grow joiners, and called-up warm
+    spares all meet in one transition and get a freshly built tree/ring.
+    The in-memory checkpoint blob is cleared because rank 0 of the new
+    generation may be a brand-new process: the shared checkpoint URI (or
+    a ``broadcast_state`` from a surviving rank) is the state every
+    member can agree on. Returns the committed generation number.
+    """
+    global _engine, _checkpoint_blob
+    from dmlc_tpu.obs import flight
+
+    with _engine_lock:
+        check(
+            isinstance(_engine, SocketEngine),
+            "elastic re-entry needs the socket engine (the jax.distributed "
+            "runtime pins its process count at initialize time)",
+        )
+        old = _engine
+        old.abort()
+        _checkpoint_blob = None
+        _engine = SocketEngine(
+            tracker_uri=old.tracker_uri,
+            tracker_port=old.tracker_port,
+            rank=-1,
+            world_size=-1,
+            jobid=old.jobid,
+            cmd="elastic",
+            connect_retry=old.connect_retry,
+        )
+        eng = _engine
+    flight.record_event("collective.elastic", generation=eng.generation,
+                        rank=eng.rank, world=eng.world_size)
+    log_info("elastic re-entry: generation %d, rank %d of %d",
+             eng.generation, eng.rank, eng.world_size)
+    return eng.generation
+
+
+def elastic_sync(timeout: float = 10.0) -> bool:
+    """Checkpoint-boundary membership poll. No-op (returns False) unless
+    DMLC_TPU_ELASTIC is set and the socket engine is active; otherwise
+    sends one heartbeat and, if the tracker's acked target world_version
+    is ahead of this engine's generation, re-rendezvouses into the new
+    world via ``reenter_elastic`` and returns True. Call it where a
+    checkpoint boundary is — the one place rank/world may legally change.
+    Pre-elastic trackers ack 0, which never triggers re-entry."""
+    from dmlc_tpu.parallel import distributed as _dist
+    from dmlc_tpu.tracker.rendezvous import send_heartbeat
+
+    eng = _engine
+    if (not elastic_enabled() or not isinstance(eng, SocketEngine)
+            or not _dist.elastic_capable()):
+        return False
+    try:
+        acked = send_heartbeat(eng.tracker_uri, eng.tracker_port, eng.rank,
+                               epoch=_version, timeout=timeout)
+    except (OSError, ValueError):
+        return False  # liveness probe stays best-effort
+    if acked <= eng.generation:
+        return False
+    log_info("membership transition pending (generation %d -> %d)",
+             eng.generation, acked)
+    reenter_elastic()
+    return True
+
+
 # configuration mistakes that must surface immediately, never trigger a
 # world-wide recovery cascade (they are OSError subclasses, but a bad
 # checkpoint URI is not a peer failure)
@@ -370,9 +527,12 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
 
     Failure cascades by construction: ``abort()`` closes all of this
     worker's links, so every neighbor's in-flight collective errors too and
-    the whole world re-enters rendezvous together (world-size changes are
-    not supported; the restarted process must come back with the same
-    jobid/rank).
+    the whole world re-enters rendezvous together. By default the world is
+    fixed — the restarted process must come back with the same jobid/rank.
+    With DMLC_TPU_ELASTIC set (socket engine), the re-entry goes through
+    ``reenter_elastic`` instead: a dead rank is drained rather than waited
+    for, warm spares are called up to backfill, and survivors get fresh
+    ranks in a rebuilt, possibly different-sized world.
     """
     from dmlc_tpu.obs import flight
     from dmlc_tpu.resilience import backoff_sleep
@@ -390,8 +550,10 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
                 raise
             attempt += 1
             with _engine_lock:
+                elastic = False
                 if isinstance(_engine, SocketEngine):
                     recoverable = True
+                    elastic = elastic_enabled()
                 elif isinstance(_engine, DeviceEngine):
                     from dmlc_tpu.parallel.distributed import multiprocess_env
 
@@ -410,7 +572,10 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
                 err, attempt, max_attempts,
             )
             try:
-                reinit_recover()
+                if elastic:
+                    reenter_elastic()
+                else:
+                    reinit_recover()
             except (DMLCError, OSError) as rerr:
                 # rendezvous failed (e.g. tracker unreachable): the aborted
                 # engine fails fast on the next round_fn, which brings us
@@ -436,6 +601,9 @@ __all__ = [
     "version_number",
     "reinit_recover",
     "run_with_recovery",
+    "broadcast_state",
+    "reenter_elastic",
+    "elastic_sync",
     "psum",
     "pmean",
     "pmax",

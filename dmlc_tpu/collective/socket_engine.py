@@ -16,6 +16,12 @@ tracker (dmlc_tpu.tracker.rendezvous) and the reference's tracker.py:
   Allgather via per-rank broadcast rounds
 - cmd='recover' re-entry with the old rank, and 'print'/'shutdown' control
   messages
+- cmd='elastic' re-entry into the tracker's *next* generation: the tracker
+  prefixes the standard assignment frame with the generation being joined
+  (−1 = refused, e.g. this worker was evicted), rank and world size are
+  assigned fresh, and the engine records the generation in
+  :attr:`SocketEngine.generation` so heartbeat acks can be compared
+  against it (see docs/robustness.md "Elastic membership")
 
 On TPU this engine is the CPU-parity/control path; the data plane for
 gradients is XLA collectives (dmlc_tpu.collective.device). The public
@@ -74,6 +80,10 @@ class SocketEngine:
         self.rank = rank
         self.world_size = world_size
         self._aborted = False
+        # membership generation this engine rendezvoused into; a static
+        # (non-elastic) world is generation 1, cmd='elastic' overwrites
+        # it with the tracker's committed world_version
+        self.generation = 1
         env_thresh = os.environ.get("DMLC_TPU_RING_THRESHOLD_BYTES")
         if env_thresh is not None:
             try:
@@ -120,6 +130,16 @@ class SocketEngine:
         ).call(dial, "collective.connect",
                display=f"tracker {self.tracker_uri}:{self.tracker_port}")
 
+        if cmd == "elastic":
+            # the elastic admission ack precedes the standard frame: the
+            # generation this entrant will join, or −1 for a refusal
+            # (evicted rank / banned jobid) — which redialing cannot fix
+            generation = conn.recv_int()
+            if generation < 0:
+                conn.close()
+                raise DMLCError(
+                    "tracker refused elastic re-entry (evicted or banned)")
+            self.generation = generation
         self.rank = conn.recv_int()
         self.parent_rank = conn.recv_int()
         self.world_size = conn.recv_int()

@@ -26,7 +26,8 @@ from dmlc_tpu.data.row_iter import (
     DiskRowIter,
     create_row_block_iter,
 )
-from dmlc_tpu.data.service import BlockService, RemoteBlockParser
+from dmlc_tpu.data.service import (BlockService, RemoteBlockParser,
+                                   reshard_split)
 from dmlc_tpu.data.rowrec import (
     RecordIORowParser,
     convert_to_recordio,
@@ -59,4 +60,5 @@ __all__ = [
     "write_recordio_rows",
     "BlockService",
     "RemoteBlockParser",
+    "reshard_split",
 ]

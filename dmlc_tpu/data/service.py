@@ -459,3 +459,28 @@ class RemoteBlockParser:
         except OSError:
             pass
         self._sock.close()
+
+
+def reshard_split(split, rank: Optional[int] = None,
+                  world: Optional[int] = None):
+    """Recompute an ``InputSplit``'s partition for a new membership
+    generation (docs/robustness.md "Elastic membership").
+
+    After ``collective.reenter_elastic`` reassigns rank/world, each worker
+    calls this at its next epoch boundary so the input partitions tile the
+    new world exactly once. ``reset_partition`` is a pure function of
+    ``(rank, world)`` — it recomputes the same aligned boundaries a static
+    launch at that world size would produce, which is what makes a
+    shrink-then-regrow run bit-identical to a static run at the same
+    world. Defaults read the live collective; returns the split."""
+    from dmlc_tpu import collective, obs
+
+    if rank is None:
+        rank = collective.rank()
+    if world is None:
+        world = collective.world_size()
+    split.reset_partition(rank, world)
+    obs.registry().counter(
+        "dmlc_data_reshards_total",
+        "input partitions recomputed after a membership change").inc()
+    return split

@@ -18,7 +18,8 @@ timelines — so:
 - **Tracker side** — :class:`StatusPlane` accumulates per-rank state and
   :class:`StatusServer` (stdlib ``http.server``, opt-in via
   ``DMLC_TPU_STATUS_PORT``) serves it: ``/healthz``, ``/workers``
-  (rank → last-seen/lag/straggler), ``/metrics`` (Prometheus text merged
+  (membership ``world_version`` + event log + rank →
+  last-seen/lag/straggler), ``/metrics`` (Prometheus text merged
   across ranks), and ``/trace`` (job-wide Chrome-trace JSON).
 - **Clock skew** — each payload carries the worker's send wall-time and
   its last measured heartbeat RTT; the tracker estimates per-rank offset
@@ -278,6 +279,13 @@ class StatusPlane:
             "dmlc_job_straggler_rank",
             "rank currently flagged as the job straggler (-1 = none)")
         self._g_straggler.set(-1)
+        # elastic membership (PR 6): generation counter + transition log
+        self.world_version = 0
+        self._events: Deque[Dict] = collections.deque(maxlen=512)
+        self._g_world = registry().gauge(
+            "dmlc_tracker_world_version",
+            "current membership generation committed by the tracker")
+        self._g_world.set(0)
 
     def _view(self, rank: int) -> _WorkerView:
         view = self._views.get(rank)
@@ -318,6 +326,27 @@ class StatusPlane:
                     e for e in spans if isinstance(e, dict) and "ts" in e)
             view.spans_dropped += int(obj.get("spans_dropped", 0) or 0)
         self.stage_slack()  # refresh straggler/slack gauges as data lands
+
+    def note_membership(self, kind: str, **fields) -> None:
+        """Record one membership transition (``join`` / ``evict`` /
+        ``rebuild``) for the ``/workers`` event log; a ``world_version``
+        field also advances the generation gauge."""
+        event = dict(fields, kind=kind, unix=round(time.time(), 3))
+        with self._lock:
+            self._events.append(event)
+            if "world_version" in fields:
+                self.world_version = int(fields["world_version"])
+        if "world_version" in fields:
+            self._g_world.set(int(fields["world_version"]))
+
+    def membership(self) -> Dict:
+        """``{"world_version": N, "events": [...]}`` — the elastic half of
+        the ``/workers`` response."""
+        with self._lock:
+            return {
+                "world_version": self.world_version,
+                "events": list(self._events),
+            }
 
     # ---- read side (HTTP handlers, obs-report) -------------------------
     def health(self) -> Dict:
@@ -466,6 +495,9 @@ class _NoopPlane:
     def note_payload(self, rank, obj, recv_unix_ns):
         pass
 
+    def note_membership(self, kind, **fields):
+        pass
+
 
 NOOP_PLANE = _NoopPlane()
 
@@ -486,7 +518,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 body = json.dumps(plane.health()).encode()
                 ctype = "application/json"
             elif path == "/workers":
-                body = json.dumps(plane.workers()).encode()
+                body = json.dumps(
+                    dict(plane.membership(), workers=plane.workers())
+                ).encode()
                 ctype = "application/json"
             elif path == "/metrics":
                 body = plane.merged_metrics_text().encode()

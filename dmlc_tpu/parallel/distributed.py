@@ -41,6 +41,15 @@ def multiprocess_env() -> bool:
     return info is not None and info["num_processes"] > 1
 
 
+def elastic_capable() -> bool:
+    """True when this process's collective bootstrap can change world size
+    mid-run. The jax.distributed runtime pins ``num_processes`` at
+    initialize time, so a multi-process device job cannot rebuild into a
+    different-sized world without a full relaunch; only the socket-engine
+    path re-rendezvouses elastically (tracker cmd='elastic')."""
+    return not multiprocess_env()
+
+
 def initialize_from_env(force: bool = False) -> bool:
     """Call jax.distributed.initialize from the DMLC_TPU_* env contract.
 

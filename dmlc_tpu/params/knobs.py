@@ -63,6 +63,25 @@ The resilience layer (dmlc_tpu/resilience) adds five more:
   ``CheckpointManager`` commits to when the primary URI exhausts its
   retry budget (empty = no fallback, the default)
 
+Elastic membership (tracker/rendezvous.py + collective, see
+docs/robustness.md "Elastic membership") adds four more:
+
+- ``DMLC_TPU_ELASTIC`` — workers opt into generation re-rendezvous: a
+  collective failure (or a bumped heartbeat ack) re-enters the tracker
+  with ``cmd='elastic'`` into a rebuilt world instead of ``recover``
+  into the old one (default off — fixed-world rabit semantics)
+- ``DMLC_TPU_ELASTIC_WINDOW_S`` — tracker-side quiescence window for a
+  membership transition: the generation commits this many seconds after
+  the last entrant arrived (default 3)
+- ``DMLC_TPU_EVICT_AFTER_S`` — tracker-side eviction policy: a rank
+  whose last heartbeat is older than this is marked evicted and the
+  survivors drain into a smaller world via ``run_with_recovery``
+  (0 = eviction off, the default; requires workers that heartbeat)
+- ``DMLC_TPU_SPARE`` — set by the launcher (``--spares N``) on warm
+  spare tasks: ``collective.init`` registers via the tracker ``join``
+  handshake and blocks until a transition activates the spare (or
+  exits 0 if the job finishes without needing it)
+
 ``KNOWN_KNOBS`` below is the authoritative list of every
 ``DMLC_TPU_*`` variable the tree reads; ``scripts/check_faultpoints.py``
 fails CI when a knob is referenced anywhere without being registered
@@ -195,6 +214,38 @@ def ckpt_fallback_uri() -> str:
     return get_env("DMLC_TPU_CKPT_FALLBACK_URI", "")
 
 
+def elastic_enabled() -> bool:
+    """Whether this worker participates in elastic membership
+    (``DMLC_TPU_ELASTIC``, default off): collective failures and bumped
+    heartbeat acks re-rendezvous into the tracker's next generation
+    (``cmd='elastic'``) instead of recovering into the fixed world."""
+    return get_env("DMLC_TPU_ELASTIC", False)
+
+
+def elastic_window_s() -> float:
+    """Tracker-side quiescence window in seconds for one membership
+    transition (``DMLC_TPU_ELASTIC_WINDOW_S``, default 3): the new
+    generation commits once no new entrant has arrived for this long,
+    floor 0.1 so the accept loop always gets a tick to batch entrants."""
+    return max(0.1, float(get_env("DMLC_TPU_ELASTIC_WINDOW_S", 3.0)))
+
+
+def evict_after_s() -> float:
+    """Tracker-side straggler eviction threshold in seconds
+    (``DMLC_TPU_EVICT_AFTER_S``; 0 = eviction off, the default). A rank
+    whose last heartbeat is older than this is marked evicted: its next
+    elastic re-entry is refused and the survivors rebuild without it."""
+    return max(0.0, float(get_env("DMLC_TPU_EVICT_AFTER_S", 0.0)))
+
+
+def is_spare() -> bool:
+    """Whether this process was launched as a warm spare
+    (``DMLC_TPU_SPARE``, set by the launcher's ``--spares`` tasks).
+    ``collective.init`` then registers through the tracker ``join``
+    handshake and blocks until a membership transition activates it."""
+    return get_env("DMLC_TPU_SPARE", False)
+
+
 # Every DMLC_TPU_* env var the tree reads, in one place. The faultpoint
 # lint (scripts/check_faultpoints.py) greps the source for DMLC_TPU_*
 # literals and fails when one is missing from this registry, so a new
@@ -237,6 +288,11 @@ KNOWN_KNOBS = (
     "DMLC_TPU_FAULTS",
     "DMLC_TPU_HEDGE_S",
     "DMLC_TPU_CKPT_FALLBACK_URI",
+    # elastic membership
+    "DMLC_TPU_ELASTIC",
+    "DMLC_TPU_ELASTIC_WINDOW_S",
+    "DMLC_TPU_EVICT_AFTER_S",
+    "DMLC_TPU_SPARE",
     # bench harness
     "DMLC_TPU_BENCH_DETAIL",
     "DMLC_TPU_BENCH_DIR",

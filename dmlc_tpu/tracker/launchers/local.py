@@ -22,8 +22,14 @@ def submit(args) -> None:
     cmd = " ".join(args.command)
     threads: List[threading.Thread] = []
 
-    def run_task(task_id: int, role: str, envs: Dict[str, object]) -> None:
-        env = task_env(envs, task_id, role, "local", extra=args.env_map)
+    def run_task(task_id: int, role: str, envs: Dict[str, object],
+                 spare: bool = False) -> None:
+        extra = dict(args.env_map)
+        if spare:
+            # DMLC_TPU_SPARE makes collective.init() park on the tracker's
+            # join handshake instead of rendezvousing immediately
+            extra["DMLC_TPU_SPARE"] = "1"
+        env = task_env(envs, task_id, role, "local", extra=extra)
         attempts = max(1, nrepeat)
         while attempts > 0:
             full = os.environ.copy()
@@ -48,6 +54,15 @@ def submit(args) -> None:
             tid = i if i < nworker else i - nworker
             t = threading.Thread(
                 target=run_task, args=(tid, role, envs), daemon=True
+            )
+            t.start()
+            threads.append(t)
+        # warm spares: worker-role tasks beyond the base world, with task
+        # ids (= rabit jobids) that can never collide with real workers
+        for j in range(max(0, getattr(args, "spares", 0) or 0)):
+            t = threading.Thread(
+                target=run_task, args=(nworker + j, "worker", envs),
+                kwargs={"spare": True}, daemon=True,
             )
             t.start()
             threads.append(t)

@@ -196,6 +196,18 @@ def build_parser() -> argparse.ArgumentParser:
         "/workers, /metrics, /trace.",
     )
     parser.add_argument(
+        "--elastic", action="store_true", default=False,
+        help="Enable elastic membership (sets DMLC_TPU_ELASTIC): workers "
+        "may join, be evicted, and be replaced mid-run at rendezvous "
+        "boundaries instead of failing the job.",
+    )
+    parser.add_argument(
+        "--spares", default=0, type=int,
+        help="Warm-spare worker tasks to launch beyond --num-workers; they "
+        "park on the tracker's join handshake until a membership "
+        "transition calls them up (local cluster only).",
+    )
+    parser.add_argument(
         "command", nargs=argparse.REMAINDER,
         help="Command to launch on every task.",
     )

@@ -32,6 +32,24 @@ that flaps is warned about every time, not once forever.
 Reference trackers ignore unknown jobids, and our tracker treats the
 command as fire-and-forget, so the extension stays wire-compatible.
 
+Elastic membership (also TPU-new; docs/robustness.md "Elastic
+membership"): the tracker owns a monotonically increasing
+``world_version`` — the generation of the currently assigned world. Two
+more commands extend the handshake: ``join`` registers a warm spare
+(world_size −1) or a scale-up request (world_size 0) and parks the
+connection until a transition activates it; ``elastic`` re-enters the
+job into the *next* generation — the tracker acks the target version
+(−1 = refused, e.g. an evicted worker), batches entrants over a
+quiescence window (``DMLC_TPU_ELASTIC_WINDOW_S``), backfills missing
+ranks from parked spares, then rebuilds tree/ring for the new world and
+assigns fresh ranks. Running workers learn a transition is pending from
+the heartbeat ack (it carries the target version; pre-elastic workers
+ignored the ack value, so the wire stays compatible) and re-enter at
+their next checkpoint boundary. ``DMLC_TPU_EVICT_AFTER_S`` adds an
+eviction policy on top of straggler detection: a rank silent for that
+long is refused re-entry and the survivors drain into a smaller world
+instead of failing the job.
+
 The job observability plane (obs/plane.py) rides the same command: when
 ``DMLC_TPU_STATUS_PORT`` is set the tracker starts an HTTP status server
 (/healthz, /workers, /metrics, /trace), advertises
@@ -59,11 +77,22 @@ import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from dmlc_tpu import obs
+from dmlc_tpu.obs import flight
 from dmlc_tpu.obs import plane as obs_plane
-from dmlc_tpu.params.knobs import heartbeat_gap, status_port
+from dmlc_tpu.params.knobs import (
+    elastic_window_s,
+    evict_after_s,
+    heartbeat_gap,
+    status_port,
+)
 from dmlc_tpu.utils.logging import DMLCError
 
 MAGIC = 0xFF99
+
+
+class SpareUnused(DMLCError):
+    """The job finished without this warm spare being activated — the
+    clean 'never needed' outcome, not a failure."""
 
 logger = logging.getLogger("dmlc_tpu.tracker")
 
@@ -334,6 +363,19 @@ class RabitTracker:
         self._last_seen: Dict[int, float] = {}
         self._hb_info: Dict[int, str] = {}
         self._hb_flagged: Set[int] = set()
+        # elastic membership state: world_version is the generation of the
+        # currently assigned world (1 after the first rendezvous); the
+        # target version is what heartbeat acks advertise — it runs one
+        # ahead while a membership transition is pending. Evicted ranks
+        # (and their jobids, which survive the rank reshuffle at commit)
+        # are refused elastic re-entry.
+        self.world_version = 0
+        self._target_version = 0
+        self.elastic_window = elastic_window_s()
+        self.evict_after = evict_after_s()
+        self._evicted_ranks: Set[int] = set()
+        self._evicted_jobids: Set[str] = set()
+        self._rank_jobids: Dict[int, str] = {}
         self._m_heartbeats = obs.registry().counter(
             "dmlc_tracker_heartbeats_total", "worker heartbeats received")
         self._m_straggler_recoveries = obs.registry().counter(
@@ -422,6 +464,57 @@ class RabitTracker:
                 for r, seen in self._last_seen.items()
             }
 
+    # ---- elastic membership --------------------------------------------
+    def _evict_scan(self, now: float) -> List[int]:
+        """Eviction policy (``DMLC_TPU_EVICT_AFTER_S``): a rank whose
+        last heartbeat is older than the threshold is marked evicted —
+        its jobid is banned from elastic re-entry and the bumped
+        heartbeat ack tells survivors to drain into a new generation at
+        their next checkpoint boundary (run_with_recovery's elastic
+        path). Returns the ranks newly evicted by this scan. A fired
+        ``tracker.evict`` faultpoint defers that rank's eviction to the
+        next scan, so eviction storms are chaos-testable."""
+        if self.evict_after <= 0:
+            return []
+        from dmlc_tpu.resilience import InjectedFault, faultpoint
+
+        with self._hb_lock:
+            stale = [
+                r for r, seen in self._last_seen.items()
+                if now - seen > self.evict_after
+                and r not in self._evicted_ranks
+            ]
+        evicted = []
+        for rank in sorted(stale):
+            try:
+                faultpoint("tracker.evict")
+            except InjectedFault as err:
+                logger.warning("eviction of rank %d deferred by injected "
+                               "fault: %s", rank, err)
+                continue
+            self._evicted_ranks.add(rank)
+            jobid = self._rank_jobids.get(rank)
+            if jobid and jobid != "NULL":
+                self._evicted_jobids.add(jobid)
+            evicted.append(rank)
+            logger.warning("evicting rank %d: no heartbeat for more than "
+                           "%.1fs", rank, self.evict_after)
+            self.plane.note_membership("evict", rank=rank)
+            flight.record_event("member.evict", rank=rank,
+                                after_s=self.evict_after)
+        if evicted and self._target_version == self.world_version:
+            self._target_version = self.world_version + 1
+        return evicted
+
+    @staticmethod
+    def _release_joiners(joiners: List[Tuple["_Worker", bool]]) -> None:
+        """Close parked joiner conns: a closed activation socket is the
+        'job finished without needing you' signal (request_join raises
+        SpareUnused and the spare process exits cleanly)."""
+        for w, _is_spare in joiners:
+            w.conn.close()
+        joiners.clear()
+
     def _accept_loop(self, num_workers: int) -> None:
         shutdown: Dict[int, _Worker] = {}
         wait_conn: Dict[int, _Worker] = {}
@@ -429,76 +522,258 @@ class RabitTracker:
         pending: List[_Worker] = []
         todo: List[int] = []
         tree = parent = ring = None
-        while len(shutdown) != num_workers:
+        # elastic membership state for the open transition: parked joiner
+        # conns (warm spares / grow requests) awaiting activation,
+        # entrants mid-rendezvous into the next generation, the
+        # quiescence deadline, how many joiners were woken into this
+        # transition, and whether the spare-backfill pass already ran.
+        joiners: List[Tuple[_Worker, bool]] = []  # (conn, is_spare)
+        entrants: List[_Worker] = []
+        deadline: Optional[float] = None
+        activated = 0
+        backfilled = False
+
+        def activate(w: _Worker) -> bool:
+            """Wake a parked joiner into the pending generation."""
+            try:
+                w.conn.send_int(self._target_version)
+                return True
+            except OSError:
+                w.conn.close()
+                return False
+
+        def call_up(want_spares: int) -> int:
+            """Activate every parked grow joiner plus up to
+            ``want_spares`` warm spares; dead conns are dropped."""
+            nonlocal joiners
+            woken = 0
+            keep: List[Tuple[_Worker, bool]] = []
+            for w, is_spare in joiners:
+                if is_spare and want_spares <= 0:
+                    keep.append((w, is_spare))
+                    continue
+                if activate(w):
+                    woken += 1
+                    if is_spare:
+                        want_spares -= 1
+            joiners = keep
+            return woken
+
+        def commit_generation() -> None:
+            """Rebuild the world from the collected entrants: new link
+            maps, fresh batch rank assignment, bumped world_version."""
+            nonlocal tree, parent, ring, todo, wait_conn, job_map
+            nonlocal entrants, deadline, activated, backfilled, num_workers
+            new_world = len(entrants)
+            self.world_version += 1
+            self._target_version = self.world_version
+            num_workers = self.num_workers = new_world
+            tree, parent, ring = build_link_maps(new_world)
+            todo = list(range(new_world))
+            wait_conn = {}
+            job_map = {}
+            batch = sorted(entrants, key=lambda w: w.host)
+            entrants = []
+            deadline = None
+            activated = 0
+            backfilled = False
+            self._rank_jobids = {}
+            for w in batch:
+                r = todo.pop(0)
+                if w.jobid != "NULL":
+                    job_map[w.jobid] = r
+                self._rank_jobids[r] = w.jobid
+                w.assign_rank(r, wait_conn, tree, parent, ring)
+                if w.wait_accept > 0:
+                    wait_conn[r] = w
+            with self._hb_lock:
+                # the rank space was reassigned: stale last-seen entries
+                # would flag phantom stragglers in the new generation
+                self._last_seen.clear()
+                self._hb_info.clear()
+                self._hb_flagged.clear()
+            self._evicted_ranks.clear()
+            self.plane.note_membership(
+                "rebuild", world_version=self.world_version, world=new_world)
+            flight.record_event("member.rebuild",
+                                world_version=self.world_version,
+                                world=new_world)
+            logger.info("@tracker generation %d committed: world=%d",
+                        self.world_version, new_world)
+
+        # the accept timeout is the tracker's clock: transition deadlines
+        # and eviction scans must run even when no connection arrives
+        self.sock.settimeout(0.25)
+        while len(shutdown) < num_workers:
             try:
                 fd, addr = self.sock.accept()
+            except socket.timeout:
+                fd = None
             except OSError:
                 # close() pulled the listening socket out from under us:
                 # a deliberate stop, not a protocol failure
+                self._release_joiners(joiners)
                 return
-            try:
-                worker = _Worker(fd, addr)
-            except ConnectionError as err:
-                logger.warning("rejected connection: %s", err)
-                fd.close()
-                continue
-            if worker.cmd == "print":
+            worker = None
+            if fd is not None:
+                fd.settimeout(None)  # protocol recvs must block as before
+                try:
+                    worker = _Worker(fd, addr)
+                except ConnectionError as err:
+                    logger.warning("rejected connection: %s", err)
+                    fd.close()
+                    worker = None
+            now = time.time()
+            if worker is not None and worker.cmd == "print":
                 logger.info(worker.conn.recv_str().strip())
-                continue
-            if worker.cmd == "heartbeat":
+                worker = None
+            elif worker is not None and worker.cmd == "heartbeat":
                 try:
                     payload = worker.conn.recv_str()
                     # ack before processing: the worker measures this
                     # round-trip as the RTT in its clock-skew probe, so
-                    # tracker-side parsing time must not inflate it
-                    worker.conn.send_int(0)
+                    # tracker-side parsing time must not inflate it. The
+                    # ack value is the target world_version — a worker on
+                    # an older generation knows to re-enter at its next
+                    # checkpoint boundary (pre-elastic workers ignored
+                    # the ack value, so the wire stays compatible).
+                    worker.conn.send_int(self._target_version)
                     self._note_heartbeat(worker.rank, payload)
                 except (ConnectionError, OSError) as err:
                     logger.warning("heartbeat from %s failed: %s",
                                    worker.host, err)
                 finally:
                     worker.conn.close()
-                continue
-            if worker.cmd == "shutdown":
+                worker = None
+            elif worker is not None and worker.cmd == "shutdown":
                 assert worker.rank >= 0 and worker.rank not in shutdown
                 shutdown[worker.rank] = worker
                 logger.debug("shutdown from rank %d", worker.rank)
-                continue
-            assert worker.cmd in ("start", "recover"), worker.cmd
-            if tree is None:
-                assert worker.cmd == "start"
-                if worker.world_size > 0:
-                    num_workers = worker.world_size
-                    self.num_workers = num_workers
-                tree, parent, ring = build_link_maps(num_workers)
-                todo = list(range(num_workers))
-            else:
-                assert worker.world_size in (-1, num_workers)
-            if worker.cmd == "recover":
-                assert worker.rank >= 0
-            rank = worker.decide_rank(job_map)
-            if rank == -1:
-                assert todo, "no unassigned ranks left"
-                pending.append(worker)
-                if len(pending) == len(todo):
-                    pending.sort(key=lambda w: w.host)
-                    for w in pending:
-                        r = todo.pop(0)
-                        if w.jobid != "NULL":
-                            job_map[w.jobid] = r
-                        w.assign_rank(r, wait_conn, tree, parent, ring)
-                        if w.wait_accept > 0:
-                            wait_conn[r] = w
-                        logger.debug("assigned rank %d to %s", r, w.host)
-                    pending = []
-                if not todo:
-                    logger.info("@tracker all %d workers started", num_workers)
-                    self.start_time = time.time()
-            else:
-                worker.assign_rank(rank, wait_conn, tree, parent, ring)
-                if worker.wait_accept > 0:
-                    wait_conn[rank] = worker
-                logger.debug("%s from rank %d", worker.cmd, rank)
+                worker = None
+            elif worker is not None and worker.cmd == "join":
+                # warm spare (world_size −1) or scale-up request: ack
+                # with the current generation and park the conn until a
+                # transition activates it
+                is_spare = worker.world_size < 0
+                try:
+                    worker.conn.send_int(self.world_version)
+                except OSError:
+                    worker.conn.close()
+                else:
+                    joiners.append((worker, is_spare))
+                    if (not is_spare and tree is not None
+                            and self._target_version == self.world_version):
+                        # a grow request opens a pending transition;
+                        # running workers learn from the heartbeat ack
+                        self._target_version = self.world_version + 1
+                    self.plane.note_membership(
+                        "join", jobid=worker.jobid, spare=is_spare)
+                    flight.record_event("member.join", jobid=worker.jobid,
+                                        spare=is_spare)
+                    logger.info("parked %s joiner %s",
+                                "spare" if is_spare else "grow",
+                                worker.jobid)
+                worker = None
+            elif worker is not None and worker.cmd == "elastic":
+                refused = (
+                    tree is None  # no world to re-enter yet
+                    or (worker.jobid != "NULL"
+                        and worker.jobid in self._evicted_jobids)
+                    or (worker.rank >= 0
+                        and worker.rank in self._evicted_ranks)
+                )
+                if refused:
+                    logger.info("refused elastic re-entry from %s (rank %d)",
+                                worker.jobid, worker.rank)
+                    try:
+                        worker.conn.send_int(-1)
+                    except OSError:
+                        pass
+                    worker.conn.close()
+                    worker = None
+                else:
+                    if self._target_version == self.world_version:
+                        self._target_version = self.world_version + 1
+                    if deadline is None:
+                        backfilled = False
+                        activated = call_up(0)  # grow joiners ride along
+                    try:
+                        worker.conn.send_int(self._target_version)
+                    except OSError:
+                        worker.conn.close()
+                    else:
+                        entrants.append(worker)
+                        deadline = now + self.elastic_window
+                    worker = None
+            if worker is not None:
+                assert worker.cmd in ("start", "recover"), worker.cmd
+                if tree is None:
+                    assert worker.cmd == "start"
+                    if worker.world_size > 0:
+                        num_workers = worker.world_size
+                        self.num_workers = num_workers
+                    tree, parent, ring = build_link_maps(num_workers)
+                    todo = list(range(num_workers))
+                else:
+                    assert worker.world_size in (-1, num_workers)
+                if worker.cmd == "recover":
+                    assert worker.rank >= 0
+                rank = worker.decide_rank(job_map)
+                if rank == -1:
+                    assert todo, "no unassigned ranks left"
+                    pending.append(worker)
+                    if len(pending) == len(todo):
+                        pending.sort(key=lambda w: w.host)
+                        for w in pending:
+                            r = todo.pop(0)
+                            if w.jobid != "NULL":
+                                job_map[w.jobid] = r
+                            self._rank_jobids[r] = w.jobid
+                            w.assign_rank(r, wait_conn, tree, parent, ring)
+                            if w.wait_accept > 0:
+                                wait_conn[r] = w
+                            logger.debug("assigned rank %d to %s", r, w.host)
+                        pending = []
+                    if not todo:
+                        logger.info("@tracker all %d workers started",
+                                    num_workers)
+                        if self.start_time is None:
+                            self.start_time = time.time()
+                        self.world_version += 1  # generation 1
+                        self._target_version = self.world_version
+                        if any(not s for _, s in joiners):
+                            # a grow request parked before the first world
+                            # formed: open a transition right away
+                            self._target_version = self.world_version + 1
+                        self.plane.note_membership(
+                            "rebuild", world_version=self.world_version,
+                            world=num_workers)
+                else:
+                    worker.assign_rank(rank, wait_conn, tree, parent, ring)
+                    self._rank_jobids[rank] = worker.jobid
+                    if worker.wait_accept > 0:
+                        wait_conn[rank] = worker
+                    logger.debug("%s from rank %d", worker.cmd, rank)
+            # ---- elastic bookkeeping: runs on every pass (conn or tick)
+            if tree is not None:
+                self._evict_scan(now)
+            if deadline is not None and entrants:
+                expected = num_workers - len(self._evicted_ranks) + activated
+                if len(entrants) >= expected:
+                    commit_generation()
+                elif now >= deadline:
+                    if not backfilled:
+                        backfilled = True
+                        woken = call_up(expected - len(entrants))
+                        activated += woken
+                        if woken:
+                            # give the backfill one window to arrive
+                            deadline = now + self.elastic_window
+                        else:
+                            commit_generation()
+                    else:
+                        commit_generation()
+        self._release_joiners(joiners)
         self.end_time = time.time()
         if self.start_time is not None:
             logger.info(
@@ -572,11 +847,18 @@ def send_heartbeat(
     metrics: str = "",
     timeout: float = 10.0,
     obs_json: Optional[str] = None,
-) -> None:
+) -> int:
     """Worker-side heartbeat: one short-lived connection carrying the
     standard handshake with cmd="heartbeat" plus a free-form payload line
     (``epoch=N <metrics>`` — e.g. ``obs.summary_line()``). Waits for the
     tracker's ack so a heartbeat observed by the caller is recorded.
+
+    Returns the ack value: the tracker's *target* ``world_version``. An
+    elastic worker compares it to its engine generation — a larger value
+    means a membership transition is pending and it should re-enter at
+    its next checkpoint boundary (``collective.elastic_sync``).
+    Pre-elastic trackers acked a literal 0; treat ``<= generation`` as
+    'no change'.
 
     ``obs_json`` (built by ``obs.plane.build_payload``) rides the same
     string frame behind the ``OBS1`` marker — still one line of opaque
@@ -601,9 +883,72 @@ def send_heartbeat(
 
             payload += PAYLOAD_MARK + obs_json
         conn.send_str(payload)
-        conn.recv_int()  # ack
+        return conn.recv_int()  # ack: the tracker's target world_version
     finally:
         conn.close()
+
+
+def request_join(
+    tracker_uri: str,
+    tracker_port: int,
+    jobid: str = "NULL",
+    spare: bool = True,
+    timeout: Optional[float] = None,
+) -> int:
+    """Worker-side ``join`` handshake: register as a warm spare (or, with
+    ``spare=False``, a scale-up request) and block until the tracker
+    activates us into a membership transition.
+
+    Returns the generation to enter — the caller then re-dials with
+    ``cmd='elastic'`` (``SocketEngine(cmd="elastic")``) to rendezvous
+    into that world. Raises :class:`SpareUnused` when the tracker closes
+    the parked connection without activating us: the job finished and
+    the spare was never needed, a clean exit rather than a failure.
+    ``timeout`` bounds the activation wait (None = as long as the job
+    runs). The dial carries a ``tracker.join`` faultpoint so membership
+    transitions are chaos-testable end to end."""
+    from dmlc_tpu.resilience import RetryPolicy, faultpoint
+
+    def dial() -> FramedSocket:
+        faultpoint("tracker.join")
+        sock = socket.create_connection((tracker_uri, tracker_port),
+                                        timeout=30)
+        conn = FramedSocket(sock)
+        try:
+            conn.send_int(MAGIC)
+            got = conn.recv_int()
+            if got != MAGIC:
+                raise DMLCError(f"invalid tracker magic {got:#x}")
+            conn.send_int(-1)
+            conn.send_int(-1 if spare else 0)
+            conn.send_str(jobid)
+            conn.send_str("join")
+            conn.recv_int()  # registration ack: the current generation
+        except BaseException:
+            conn.close()
+            raise
+        return conn
+
+    # same narrowed classifier as the collective dial: a bad-magic
+    # DMLCError means the wrong service, retrying cannot fix it
+    conn = RetryPolicy(
+        max_attempts=5, base_s=0.2, cap_s=2.0,
+        classify=lambda err: isinstance(err, (ConnectionError, OSError)),
+    ).call(dial, "tracker.join",
+           display=f"tracker {tracker_uri}:{tracker_port}")
+    try:
+        conn.sock.settimeout(timeout)
+        try:
+            generation = conn.recv_int()
+        except ConnectionError as err:
+            raise SpareUnused(
+                "tracker closed before activation — the job finished "
+                "without needing this joiner") from err
+    finally:
+        conn.close()
+    if generation < 0:
+        raise DMLCError("tracker refused the join request")
+    return generation
 
 
 class PSTracker:
@@ -654,10 +999,33 @@ class PSTracker:
     def alive(self) -> bool:
         return self.cmd is not None and self.thread.is_alive()
 
-    def join(self) -> None:
-        if self.cmd is not None:
-            while self.thread.is_alive():
-                self.thread.join(0.1)
+    def join(self, tasks_alive: Optional[Callable[[], bool]] = None,
+             grace_s: float = 5.0) -> None:
+        """Wait for the scheduler to finish.
+
+        Mirrors :meth:`RabitTracker.join`'s liveness contract: a
+        scheduler whose worker processes have all died can never finish,
+        so once ``tasks_alive`` reports no live tasks for ``grace_s``
+        seconds, fail fast with a diagnostic instead of hanging on the
+        scheduler thread forever (the old behavior joined
+        unconditionally, so one dead PS worker wedged the submit)."""
+        if self.cmd is None:
+            return
+        deadline = None
+        while self.thread.is_alive():
+            self.thread.join(0.1)
+            if tasks_alive is None or tasks_alive():
+                deadline = None
+                continue
+            now = time.time()
+            if deadline is None:
+                deadline = now + grace_s  # let in-flight exits drain
+            elif now > deadline:
+                raise DMLCError(
+                    "all PS worker processes exited but the scheduler is "
+                    "still running — workers likely died before "
+                    "registering (check their logs)"
+                )
 
 
 def submit_with_tracker(
@@ -689,4 +1057,4 @@ def submit_with_tracker(
         envs.update(ps.worker_envs())
         if ps.alive() or pscmd is None:
             fun_submit(nworker, nserver, envs)
-        ps.join()
+        ps.join(tasks_alive=tasks_alive)

@@ -29,6 +29,10 @@ def submit(args) -> None:
     # (RabitTracker is constructed deep inside the launcher)
     if getattr(args, "status_port", None) is not None:
         os.environ["DMLC_TPU_STATUS_PORT"] = str(args.status_port)
+    # --elastic likewise maps onto DMLC_TPU_ELASTIC so the tracker's
+    # accept loop and every worker (env is inherited) see one switch
+    if getattr(args, "elastic", False):
+        os.environ["DMLC_TPU_ELASTIC"] = "1"
     get_launcher(args.cluster).submit(args)
 
 

@@ -23,7 +23,7 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 DOC = ROOT / "docs" / "observability.md"
 
-UNITS = {"total", "ns", "bytes", "rows", "value", "count", "rank"}
+UNITS = {"total", "ns", "bytes", "rows", "value", "count", "rank", "version"}
 
 # ".counter(" / ".gauge(" / ".histogram(" followed by a string literal —
 # matches across the line break of a wrapped call
@@ -33,7 +33,7 @@ CALL_RE = re.compile(
 # require a unit suffix so prose mentions of e.g. `dmlc_tpu.obs` don't
 # read as metric names
 DOC_NAME_RE = re.compile(
-    r"`(dmlc_[a-z0-9_]+_(?:total|ns|bytes|rows|value|count|rank))"
+    r"`(dmlc_[a-z0-9_]+_(?:total|ns|bytes|rows|value|count|rank|version))"
 )
 
 

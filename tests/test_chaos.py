@@ -151,6 +151,148 @@ def test_chaos_multi_site_three_workers(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# elastic membership chaos: kill one worker, backfill a warm spare,
+# finish bit-identical to a static run (PR 6 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+ELASTIC_WORKER = textwrap.dedent("""
+    import hashlib, json, os, sys, urllib.request
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from dmlc_tpu import collective as rabit
+    from dmlc_tpu import resilience
+
+    CKPT = sys.argv[1]
+    SIZE = int(sys.argv[2])
+    EPOCHS = 4
+
+    rabit.init()  # a warm spare parks here until called up (or exits 0)
+
+    def round_fn():
+        state = rabit.load_checkpoint(CKPT)
+        if state is None:
+            state = (0, np.zeros(SIZE))
+        epoch, w = state
+        if epoch >= EPOCHS:
+            return state
+        g = rabit.allreduce(
+            np.full(SIZE, (rabit.rank() + 1) * (epoch + 1),
+                    dtype=np.float64))
+        w = w + g
+        if rabit.rank() == 0:
+            rabit.checkpoint((epoch + 1, w), CKPT)
+        else:
+            rabit.checkpoint((epoch + 1, w))
+        return (epoch + 1, w)
+
+    state = (0, None)
+    while state[0] < EPOCHS:
+        # victim selection: rank r passes worker.step (r+1) times per
+        # outer iteration, so an nth= schedule kills exactly one chosen
+        # rank at a chosen epoch. The death is OUTSIDE run_with_recovery
+        # (os._exit) — a hard worker loss, not a recoverable collective
+        # error; survivors drain through elastic re-entry instead.
+        for _ in range(rabit.rank() + 1):
+            try:
+                resilience.faultpoint("worker.step")
+            except resilience.InjectedFault:
+                os._exit(1)
+        state = rabit.run_with_recovery(round_fn, max_attempts=6)
+    epoch, w = state
+    digest = hashlib.sha256(np.ascontiguousarray(w).tobytes()).hexdigest()
+    line = (f"RESULT rank={{rabit.rank()}} digest={{digest[:16]}} "
+            f"v={{rabit.version_number()}}")
+    if rabit.rank() == 0 and os.environ.get("DMLC_TPU_STATUS_URI"):
+        url = "http://" + os.environ["DMLC_TPU_STATUS_URI"] + "/workers"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            info = json.loads(resp.read().decode())
+        kinds = ",".join(sorted({{e["kind"] for e in info["events"]}}))
+        line += f" wv={{info['world_version']}} kinds={{kinds or '-'}}"
+    rabit.tracker_print(line)
+    rabit.finalize()
+""")
+
+
+def _run_elastic_job(tmp_path, world: int, spares: int, faults: str,
+                     tag: str, elastic: bool = True, size: int = 8):
+    """One dmlc-submit local run of the elastic worker; returns
+    ({rank: digest}, membership info scraped from /workers by rank 0)."""
+    script = tmp_path / "eworker.py"
+    script.write_text(ELASTIC_WORKER.format(repo=REPO))
+    ckpt = tmp_path / f"ckpt_{tag}.bin"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "DMLC_TPU_ELASTIC_WINDOW_S": "1.0"}
+    for k in ("DMLC_TPU_FAULTS", "DMLC_TPU_ELASTIC", "DMLC_TPU_SPARE",
+              "DMLC_TPU_STATUS_PORT"):
+        env.pop(k, None)
+    if faults:
+        env["DMLC_TPU_FAULTS"] = faults
+    argv = [sys.executable, os.path.join(REPO, "dmlc-submit"),
+            "--cluster", "local", "-n", str(world), "--max-attempts", "1",
+            "--host-ip", "127.0.0.1", "--status-port", "0"]
+    if elastic:
+        argv.append("--elastic")
+    if spares:
+        argv += ["--spares", str(spares)]
+    argv += [sys.executable, str(script), str(ckpt), str(size)]
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=240, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout + proc.stderr
+    digests, member = {}, {}
+    for line in out.splitlines():
+        if "RESULT" in line:
+            kv = dict(p.split("=") for p in line.split("RESULT", 1)[1].split())
+            digests[int(kv["rank"])] = kv["digest"]
+            assert int(kv["v"]) == 4, out
+            if "wv" in kv:
+                member = {"world_version": int(kv["wv"]),
+                          "kinds": set(kv["kinds"].split(","))}
+    assert sorted(digests) == list(range(world)), out
+    assert len(set(digests.values())) == 1, digests
+    return digests, member
+
+
+def test_chaos_elastic_kill_one_spare_backfills_bit_identical(tmp_path):
+    """The acceptance criterion: a 2-worker run loses one worker to an
+    injected fault mid-training, a warm spare joins through the tracker's
+    handshake and backfills the dead rank, the job finishes — and the
+    final weights are bit-identical to a static crash-free run. The
+    /workers plane reflects the membership transitions with a bumped
+    ``world_version``."""
+    clean, m_clean = _run_elastic_job(
+        tmp_path, world=2, spares=0, faults="", tag="static",
+        elastic=False)
+    assert m_clean["world_version"] == 1  # the start-of-job generation
+    # rank 1 passes worker.step twice per epoch, rank 0 once, the spare
+    # (activated for the last epoch at most) at most twice: nth=8 kills
+    # exactly rank 1 at epoch 4, after three committed checkpoints
+    chaos, m = _run_elastic_job(
+        tmp_path, world=2, spares=1, faults="worker.step:nth=8",
+        tag="elastic")
+    assert chaos[0] == clean[0]
+    assert m["world_version"] == 2, m
+    assert {"join", "rebuild"} <= m["kinds"], m
+
+
+@pytest.mark.slow
+def test_chaos_elastic_storm_three_workers(tmp_path):
+    """Heavier storm: 3 workers + 1 spare, the highest rank is killed at
+    epoch 4 (nth=12: ranks pass worker.step 1/2/3 times per epoch), the
+    spare backfills, and the regrown world converges bit-identically to
+    the static 3-worker run."""
+    clean, _ = _run_elastic_job(
+        tmp_path, world=3, spares=0, faults="", tag="static3",
+        elastic=False, size=64)
+    chaos, m = _run_elastic_job(
+        tmp_path, world=3, spares=1, faults="worker.step:nth=12",
+        tag="elastic3", size=64)
+    assert chaos[0] == clean[0]
+    assert m["world_version"] == 2, m
+    assert {"join", "rebuild"} <= m["kinds"], m
+
+
+# ---------------------------------------------------------------------------
 # io.read chaos: ranged reads under probabilistic faults stay byte-exact
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,356 @@
+"""Elastic membership: generation protocol, topology rebuilds, eviction,
+warm-spare join, and deterministic resharding (docs/robustness.md
+"Elastic membership").
+
+The end-to-end kill-one-add-one storms live in test_chaos.py; this file
+covers the pieces: ``build_tree``/``build_ring`` reconstruction across
+changing world sizes, the tracker's eviction scan (including the
+``tracker.evict`` faultpoint deferring it), a real world-1→2 grow through
+``request_join`` + ``cmd='elastic'``, the ``broadcast_state`` frame, the
+``PSTracker.join`` liveness fix, and ``data.reshard_split`` determinism.
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import obs, resilience
+from dmlc_tpu.collective.socket_engine import SocketEngine
+from dmlc_tpu.io import MemoryStream, create_input_split
+from dmlc_tpu.io.filesystem import MemoryFileSystem
+from dmlc_tpu.io.serializer import save_obj
+from dmlc_tpu.obs import plane as obs_plane
+from dmlc_tpu.tracker import rendezvous as rz
+from dmlc_tpu.utils.logging import DMLCError
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    resilience.reset()
+    MemoryFileSystem.reset()
+    yield
+    resilience.reset()
+    MemoryFileSystem.reset()
+
+
+# ---------------------------------------------------------------------------
+# link-map reconstruction across changing world sizes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", range(1, 8))
+def test_link_maps_invariants(world):
+    tree, parent, ring = rz.build_link_maps(world)
+    assert set(tree) == set(parent) == set(ring) == set(range(world))
+    assert parent[0] == -1
+    for r, nbrs in tree.items():
+        for n in nbrs:
+            assert r in tree[n], "tree edges must be symmetric"
+    # a connected acyclic tree has exactly world-1 undirected edges
+    edges = {tuple(sorted((r, n))) for r, nbrs in tree.items() for n in nbrs}
+    assert len(edges) == world - 1
+    # relabeling makes ring order contiguous: successor of r is r+1 mod w
+    for r in range(world):
+        prev, nxt = ring[r]
+        assert nxt == (r + 1) % world
+        assert prev == (r - 1) % world
+
+
+def test_link_maps_shrink_grow_round_trips():
+    """Rebuilding for any world size is deterministic and independent of
+    the sequence of previous worlds — the property elastic commits rely
+    on (a shrink-then-regrow run must land on the same topology a static
+    run at that size uses)."""
+    first = {w: rz.build_link_maps(w) for w in (1, 4, 7)}
+    # interleave shrinks and grows, then rebuild the original sizes
+    for w in (7, 2, 5, 1, 6, 3):
+        rz.build_link_maps(w)
+    for w in (1, 4, 7):
+        assert rz.build_link_maps(w) == first[w]
+
+
+def test_tree_neighbors_match_parent_child():
+    for world in range(1, 8):
+        tree, parent = rz.build_tree(world)
+        for r in range(world):
+            nbrs = set(rz.tree_neighbors(r, world))
+            assert set(tree[r]) == nbrs
+            if r != 0:
+                assert parent[r] in nbrs
+
+
+# ---------------------------------------------------------------------------
+# eviction policy
+# ---------------------------------------------------------------------------
+
+
+def _mk_tracker(monkeypatch, num_workers=2, **env):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    return rz.RabitTracker("127.0.0.1", num_workers,
+                           port=19800, port_end=19990)
+
+
+def test_evict_scan_bans_stale_rank(monkeypatch):
+    tracker = _mk_tracker(monkeypatch, DMLC_TPU_EVICT_AFTER_S="0.5")
+    try:
+        tracker.world_version = tracker._target_version = 1
+        now = time.time()
+        with tracker._hb_lock:
+            tracker._last_seen.update({0: now, 1: now - 5.0})
+        tracker._rank_jobids = {0: "w0", 1: "w1"}
+        assert tracker._evict_scan(now) == [1]
+        assert 1 in tracker._evicted_ranks
+        assert "w1" in tracker._evicted_jobids
+        # the bumped target is what heartbeat acks advertise: survivors
+        # learn to drain into the next generation
+        assert tracker._target_version == 2
+        # already-evicted ranks are not re-evicted
+        assert tracker._evict_scan(now) == []
+        assert tracker._target_version == 2
+    finally:
+        tracker.close()
+
+
+def test_evict_scan_off_by_default(monkeypatch):
+    tracker = _mk_tracker(monkeypatch)
+    try:
+        assert tracker.evict_after == 0.0
+        with tracker._hb_lock:
+            tracker._last_seen[0] = time.time() - 1e6
+        assert tracker._evict_scan(time.time()) == []
+        assert not tracker._evicted_ranks
+    finally:
+        tracker.close()
+
+
+def test_evict_deferred_by_injected_fault(monkeypatch):
+    """A fired ``tracker.evict`` faultpoint defers that rank's eviction
+    to the next scan — eviction storms are chaos-testable without losing
+    the rank for good."""
+    tracker = _mk_tracker(monkeypatch, DMLC_TPU_EVICT_AFTER_S="0.5")
+    try:
+        tracker.world_version = tracker._target_version = 1
+        now = time.time()
+        with tracker._hb_lock:
+            tracker._last_seen[1] = now - 5.0
+        tracker._rank_jobids = {1: "w1"}
+        resilience.configure("tracker.evict:nth=1")
+        assert tracker._evict_scan(now) == []
+        assert tracker._target_version == 1
+        assert tracker._evict_scan(now) == [1]
+        assert tracker._target_version == 2
+    finally:
+        tracker.close()
+
+
+# ---------------------------------------------------------------------------
+# grow: join handshake + elastic re-entry rebuild a bigger world
+# ---------------------------------------------------------------------------
+
+
+def test_grow_world_one_to_two(monkeypatch):
+    """A running world-1 job admits a grow joiner: the parked ``join``
+    bumps the advertised target (heartbeat ack), the first ``elastic``
+    entrant calls the joiner up, and the committed generation 2 is a
+    working world-2 tree."""
+    monkeypatch.setenv("DMLC_TPU_ELASTIC_WINDOW_S", "0.5")
+    tracker = rz.RabitTracker("127.0.0.1", 1, port=19800, port_end=19990)
+    tracker.start(1)
+    uri, port = "127.0.0.1", tracker.port
+    engines, errors = {}, []
+
+    engines["a"] = SocketEngine(tracker_uri=uri, tracker_port=port, jobid="a")
+    assert engines["a"].world_size == 1
+    assert engines["a"].generation == 1
+
+    def do_join():
+        try:
+            gen = rz.request_join(uri, port, jobid="g", spare=False)
+            assert gen >= 2
+            engines["g"] = SocketEngine(
+                tracker_uri=uri, tracker_port=port, jobid="g", cmd="elastic")
+        except Exception as err:  # surfaced in the main thread
+            errors.append(err)
+
+    tj = threading.Thread(target=do_join, daemon=True)
+    tj.start()
+
+    # the parked grow request opens a pending transition: the heartbeat
+    # ack runs ahead of the engine's generation
+    ack, deadline = 0, time.time() + 10
+    while time.time() < deadline:
+        ack = rz.send_heartbeat(uri, port, 0)
+        if ack > engines["a"].generation:
+            break
+        time.sleep(0.05)
+    assert ack == 2
+
+    def do_reenter():
+        try:
+            engines["a"].abort()
+            engines["a2"] = SocketEngine(
+                tracker_uri=uri, tracker_port=port, jobid="a", cmd="elastic")
+        except Exception as err:
+            errors.append(err)
+
+    ta = threading.Thread(target=do_reenter, daemon=True)
+    ta.start()
+    ta.join(30)
+    tj.join(30)
+    assert not ta.is_alive() and not tj.is_alive(), "rendezvous hung"
+    assert not errors, errors
+
+    a2, g = engines["a2"], engines["g"]
+    assert {a2.rank, g.rank} == {0, 1}
+    assert a2.world_size == g.world_size == 2
+    assert a2.generation == g.generation == 2 == tracker.world_version
+    # the rebuilt world actually computes: allreduce across both members
+    results = {}
+    ts = [
+        threading.Thread(
+            target=lambda k, e: results.setdefault(
+                k, e.allreduce(np.ones(4, dtype=np.float64))),
+            args=(k, e), daemon=True)
+        for k, e in (("a", a2), ("g", g))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    np.testing.assert_array_equal(results["a"], np.full(4, 2.0))
+    np.testing.assert_array_equal(results["g"], np.full(4, 2.0))
+    a2.shutdown()
+    g.shutdown()
+    tracker.join()
+
+
+# ---------------------------------------------------------------------------
+# broadcast_state frame
+# ---------------------------------------------------------------------------
+
+
+def test_encode_decode_state_round_trip():
+    from dmlc_tpu.collective import _decode_state, _encode_state
+
+    state = {"w": np.arange(5, dtype=np.float64), "step": 3}
+    blob = _encode_state(state, 7)
+    assert blob.dtype == np.uint8
+    version, out = _decode_state(blob)
+    assert version == 7
+    assert out["step"] == 3
+    np.testing.assert_array_equal(out["w"], state["w"])
+
+
+def test_decode_state_rejects_foreign_blob():
+    from dmlc_tpu.collective import _decode_state
+
+    stream = MemoryStream()
+    save_obj(stream, ("something_else", 1, None))
+    with pytest.raises(DMLCError):
+        _decode_state(np.frombuffer(stream.getvalue(), dtype=np.uint8))
+
+
+def test_broadcast_state_world_one(monkeypatch):
+    from dmlc_tpu import collective
+
+    monkeypatch.setattr(collective, "_engine", collective._LocalEngine())
+    assert collective.broadcast_state({"a": 1}) == {"a": 1}
+    with pytest.raises(DMLCError):
+        collective.broadcast_state(None)
+
+
+# ---------------------------------------------------------------------------
+# PSTracker.join liveness (satellite: no longer hangs on dead workers)
+# ---------------------------------------------------------------------------
+
+
+def test_pstracker_join_fails_fast_when_tasks_dead():
+    ps = rz.PSTracker(
+        "127.0.0.1",
+        cmd=f'"{sys.executable}" -c "import time; time.sleep(6)"',
+        port=19800, port_end=19990,
+    )
+    t0 = time.time()
+    with pytest.raises(DMLCError):
+        ps.join(tasks_alive=lambda: False, grace_s=0.3)
+    assert time.time() - t0 < 5.0, "join must fail fast, not ride out cmd"
+
+
+def test_pstracker_join_noop_without_cmd():
+    rz.PSTracker("127.0.0.1", cmd=None).join(tasks_alive=lambda: False)
+
+
+# ---------------------------------------------------------------------------
+# status plane membership surface
+# ---------------------------------------------------------------------------
+
+
+def test_status_plane_membership_events():
+    plane = obs_plane.StatusPlane(num_workers=2)
+    plane.note_membership("join", jobid="s0", spare=True)
+    plane.note_membership("rebuild", world_version=1, world=2)
+    plane.note_membership("evict", rank=1)
+    plane.note_membership("rebuild", world_version=2, world=2)
+    m = plane.membership()
+    assert m["world_version"] == 2
+    assert [e["kind"] for e in m["events"]] == [
+        "join", "rebuild", "evict", "rebuild"]
+    assert m["events"][0]["spare"] is True
+    assert plane._g_world.value == 2
+
+
+def test_noop_plane_membership_is_noop():
+    obs_plane.NOOP_PLANE.note_membership("join", jobid="x", spare=True)
+
+
+# ---------------------------------------------------------------------------
+# deterministic input resharding
+# ---------------------------------------------------------------------------
+
+
+def _make_lines(n=101):
+    lines = [f"row-{i}" for i in range(n)]
+    MemoryFileSystem.put(
+        "elastic/data.txt", b"".join(s.encode() + b"\n" for s in lines))
+    return "mem://elastic/data.txt", lines
+
+
+def test_reshard_split_covers_new_world_exactly_once():
+    from dmlc_tpu.data import reshard_split
+
+    uri, lines = _make_lines()
+    reshards = obs.registry().counter(
+        "dmlc_data_reshards_total",
+        "input partitions recomputed after a membership change")
+    before = reshards.value
+    seen = []
+    for rank in range(3):
+        # every member starts from an OLD-world partition (part 0 of 2)
+        # and reshards into the new world of 3
+        split = create_input_split(uri, 0, 2, "text", threaded=False)
+        reshard_split(split, rank=rank, world=3)
+        seen.extend(r.decode() for r in split.records())
+        split.close()
+    assert seen == lines
+    assert reshards.value - before == 3
+
+
+def test_reshard_split_matches_static_partition():
+    """The determinism contract: resharding to (rank, world) yields the
+    exact records a static launch at that world would read."""
+    from dmlc_tpu.data import reshard_split
+
+    uri, _lines = _make_lines()
+    for rank, world in ((0, 3), (1, 3), (2, 3), (1, 2), (0, 1)):
+        split = create_input_split(uri, 0, 2, "text", threaded=False)
+        reshard_split(split, rank=rank, world=world)
+        resharded = [r.decode() for r in split.records()]
+        split.close()
+        static = create_input_split(uri, rank, world, "text", threaded=False)
+        expect = [r.decode() for r in static.records()]
+        static.close()
+        assert resharded == expect, (rank, world)

@@ -200,7 +200,11 @@ class TestStatusServer:
             assert health["workers_seen"] == 1
             assert health["workers_expected"] == 1
             status, body = _get(srv.port, "/workers")
-            workers = json.loads(body)
+            payload = json.loads(body)
+            # elastic wrapper: membership generation + event log + workers
+            assert payload["world_version"] == 0
+            assert payload["events"] == []
+            workers = payload["workers"]
             assert workers["0"]["epoch"] == 1
             assert workers["0"]["straggler"] is False
             status, body = _get(srv.port, "/metrics")
@@ -243,7 +247,7 @@ class TestTrackerIntegration:
             workers = {}
             while time.time() < deadline:
                 workers = json.loads(
-                    _get(tracker.status.port, "/workers")[1])
+                    _get(tracker.status.port, "/workers")[1])["workers"]
                 if len(workers) == 2 and all(
                         v["spans"] >= 1 for v in workers.values()):
                     break
@@ -505,7 +509,7 @@ WORKER_SCRIPT = textwrap.dedent("""
         deadline = time.time() + 30
         while time.time() < deadline:
             workers = json.load(urllib.request.urlopen(
-                "http://%s/workers" % status, timeout=5))
+                "http://%s/workers" % status, timeout=5))["workers"]
             if len(workers) == 3 and all(
                     v["spans"] >= 1 for v in workers.values()):
                 break
