@@ -975,6 +975,9 @@ def _compact_summary(headline: float, extra: dict) -> dict:
         compact["parity"] = extra["parity"]
     probe = extra.get("device_probe", {}).get("attempts", [])
     compact["device_probe_ok"] = bool(probe) and probe[-1].get("ok", False)
+    if isinstance(extra.get("sentry"), dict):
+        compact["sentry_regressions"] = len(
+            extra["sentry"].get("regressions", []))
     if "device_unavailable" in extra:
         compact["device_unavailable"] = extra["device_unavailable"][:120]
     for key, val in extra.items():
@@ -1170,6 +1173,32 @@ def main() -> None:
         extra["metrics"] = obs.registry().snapshot()
     except Exception as err:
         extra["metrics_error"] = str(err)[:120]
+
+    try:
+        # advisory perf-sentry pass (report-only — the blocking gate is
+        # `dmlc_tpu.tools bench-gate` in scripts/ci_checks.sh): gate this
+        # run against the committed round history so the regression
+        # verdict rides the artifact itself
+        import glob as _glob
+
+        from dmlc_tpu.obs import sentry
+
+        hist = sentry.load_records(sorted(_glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json"))))
+        if hist:
+            fresh_rec = {"metric": "higgs_libsvm_ingest",
+                         "value": round(headline, 1), "extra": extra}
+            regs = sentry.gate(sentry.record_values(fresh_rec),
+                               sentry.metric_series(hist))
+            extra["sentry"] = {
+                "history_records": len(hist),
+                "regressions": [
+                    {k: r[k] for k in ("metric", "value", "baseline",
+                                       "severity")} for r in regs[:5]
+                ],
+            }
+    except Exception as err:
+        extra["sentry_error"] = str(err)[:120]
 
     # full record to the detail file; COMPACT summary (≤2 KB) to stdout
     detail_path = os.environ.get(

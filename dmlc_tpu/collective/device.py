@@ -202,6 +202,9 @@ class DeviceEngine:
                 sharding, arr[None], (self.world_size,) + arr.shape
             )
             with obs.span("allreduce", op=op, nbytes=int(arr.nbytes)):
+                # mark the in-flight chunk (set by DeviceFeed around the
+                # consume yield) so the op slice joins its arrow chain
+                obs.flow_step(obs.current_flow(), "chunk")
                 out = self._reduce_fn(op)(garr)
             res = np.asarray(out)
             self._record("allreduce", int(arr.nbytes), t0)
@@ -286,6 +289,7 @@ class DeviceEngine:
                 shape = tuple(int(d) for d in header[1 : 1 + ndim])
                 arr = np.zeros(shape, dtype=self._DTYPE_BY_NUM[int(header[-1])])
             with obs.span("broadcast", root=root, nbytes=int(arr.nbytes)):
+                obs.flow_step(obs.current_flow(), "chunk")
                 out = np.asarray(
                     multihost_utils.broadcast_one_to_all(arr, is_source=is_root)
                 )
@@ -306,6 +310,7 @@ class DeviceEngine:
         if self.world_size > 1:
             try:
                 with obs.span("barrier"):
+                    obs.flow_step(obs.current_flow(), "chunk")
                     multihost_utils.sync_global_devices("dmlc_tpu_barrier")
             except Exception as err:  # noqa: BLE001 — backend translation
                 raise self._translate(err, "barrier") from err

@@ -24,6 +24,12 @@ Stage accounting mirrors the native pipeline's counters: ``stats()``
 reports worker parse time, consumer wait on the queue head, and chunk
 count — surfaced by ``DeviceFeed.stats()["pipeline"]`` next to the
 feed's own host/dispatch/wait split.
+
+When tracing is armed each chunk also gets a flow id (``obs.new_flow``)
+at read time: the ``io_read`` span starts the flow, ``parse`` steps it,
+and the id rides the emitted :class:`RowBlock` (``block.flow_id``) so
+downstream stages (DeviceFeed, BlockService) can extend the arrow chain
+— see docs/observability.md "Flow tracing".
 """
 
 from __future__ import annotations
@@ -95,11 +101,14 @@ class PipelinedParser:
         self._eof = False
 
     def _parse_timed(self, task):
-        seq, chunk = task
+        seq, fid, chunk = task
         t0 = time.monotonic_ns()
         try:
-            with obs.span("parse", chunk=seq):
-                return self._base.parse_chunk(chunk)
+            with obs.span("parse", chunk=seq, flow=fid):
+                obs.flow_step(fid, "chunk")
+                container = self._base.parse_chunk(chunk)
+            container.flow_id = fid
+            return container
         finally:
             self._h_parse.observe(time.monotonic_ns() - t0)
 
@@ -108,12 +117,16 @@ class PipelinedParser:
         the consumer thread, so a full window — backpressure — simply
         stops the chunk reads)."""
         while not self._eof and self._win.free_slots > 0:
-            chunk = self._base.next_chunk()
+            fid = obs.new_flow()
+            with obs.span("io_read", chunk=self._seq, flow=fid):
+                chunk = self._base.next_chunk()
+                if chunk is not None:
+                    obs.flow_start(fid, "chunk")
             if chunk is None:
                 self._eof = True
                 return
             self._m_chunks.inc()
-            self._win.submit((self._seq, chunk))
+            self._win.submit((self._seq, fid, chunk))
             self._seq += 1
 
     # ---- Parser surface -------------------------------------------------
@@ -133,7 +146,11 @@ class PipelinedParser:
             finally:
                 self._h_wait.observe(time.monotonic_ns() - t0)
             if len(container):
-                return container.to_block()
+                block = container.to_block()
+                fid = getattr(container, "flow_id", 0)
+                if fid:
+                    block.flow_id = fid
+                return block
             # empty chunk (blank lines): keep pulling
 
     def __iter__(self) -> Iterator[RowBlock]:

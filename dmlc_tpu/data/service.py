@@ -39,6 +39,7 @@ from typing import Dict, Optional, Tuple, Union
 
 import numpy as np
 
+from dmlc_tpu import obs
 from dmlc_tpu.data.parsers import Parser, create_parser
 from dmlc_tpu.data.row_block import RowBlock
 from dmlc_tpu.utils.logging import DMLCError, check, log_warning
@@ -150,8 +151,6 @@ class BlockService:
         self._sock.listen(64)
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
         # obs metrics, labeled by bound port (one label set per service)
-        from dmlc_tpu import obs
-
         svc = str(self.address[1])
         reg = obs.registry()
         self._m_served = reg.counter(
@@ -217,6 +216,12 @@ class BlockService:
             arr = getattr(block, name)
             if arr is not None:
                 out[name] = np.asarray(arr)
+        # flow context crosses the wire as one extra named field; clients
+        # that predate it simply don't .get() it (the format is
+        # name-addressed), so the frame stays wire-compatible
+        fid = getattr(block, "flow_id", 0)
+        if fid:
+            out["flow"] = np.asarray([fid], dtype=np.int64)
         return out
 
     def _stash_undelivered(self, arrays: Dict[str, np.ndarray]) -> None:
@@ -255,7 +260,19 @@ class BlockService:
                         except OSError:
                             pass
                         return
-                    self._send_response(conn, _pack_arrays(undelivered or {}))
+                    flow = (undelivered or {}).get("flow")
+                    fid = int(flow[0]) if flow is not None and len(flow) \
+                        else 0
+                    if fid:
+                        # the send slice joins the chunk's arrow chain so a
+                        # merged trace shows which rank served which chunk
+                        with obs.span("service_send", flow=fid):
+                            obs.flow_step(fid, "chunk")
+                            self._send_response(
+                                conn, _pack_arrays(undelivered))
+                    else:
+                        self._send_response(
+                            conn, _pack_arrays(undelivered or {}))
                     if undelivered is None:
                         return
                     undelivered = None
@@ -384,7 +401,6 @@ class RemoteBlockParser:
     """
 
     def __init__(self, address: Tuple[str, int], timeout: float = 60.0):
-        from dmlc_tpu import obs
         from dmlc_tpu.resilience import RetryPolicy, faultpoint
 
         def dial():
@@ -426,7 +442,9 @@ class RemoteBlockParser:
         nbytes = sum(a.nbytes for a in arrays.values())
         self.bytes_read += nbytes
         self._m_read.inc(nbytes)
-        return RowBlock(
+        flow = arrays.pop("flow", None)
+        fid = int(flow[0]) if flow is not None and len(flow) else 0
+        block = RowBlock(
             offset=arrays["offset"],
             label=arrays["label"],
             index=arrays["index"],
@@ -435,6 +453,14 @@ class RemoteBlockParser:
             qid=arrays.get("qid"),
             field=arrays.get("field"),
         )
+        if fid:
+            # continue the server's flow on this rank: after the plane
+            # merges traces, the arrow crosses from the serving rank's
+            # service_send slice into this receive
+            block.flow_id = fid
+            with obs.span("service_recv", nbytes=nbytes, flow=fid):
+                obs.flow_step(fid, "chunk")
+        return block
 
     def __iter__(self):
         while True:

@@ -407,7 +407,14 @@ class DeviceFeed:
     def _host_batches_python(self) -> Iterator:
         bs = self.spec.batch_size
         pending = RowBlockContainer()
+        # flow ids of parser chunks not yet represented in an emitted
+        # batch; rebatching is N:M, so each chunk's flow rides the first
+        # slice it contributes rows to
+        flows = []
         for block in self._parser:
+            fid = getattr(block, "flow_id", 0)
+            if fid:
+                flows.append(fid)
             pending.push_block(block)
             if len(pending) < bs:
                 continue
@@ -415,12 +422,19 @@ class DeviceFeed:
             whole = pending.to_block()
             nfull = len(whole) // bs
             for k in range(nfull):
-                yield whole.slice(k * bs, (k + 1) * bs)
+                piece = whole.slice(k * bs, (k + 1) * bs)
+                if flows:
+                    piece.flow_ids = tuple(flows)
+                    flows = []
+                yield piece
             pending = RowBlockContainer()
             if len(whole) > nfull * bs:
                 pending.push_block(whole.slice(nfull * bs, len(whole)))
         if len(pending) and not self.spec.drop_remainder:
-            yield pending.to_block()
+            tail = pending.to_block()
+            if flows:
+                tail.flow_ids = tuple(flows)
+            yield tail
 
     def _host_batches_native(self) -> Iterator:
         spec = self.spec
@@ -481,9 +495,12 @@ class DeviceFeed:
         shardings = {k: self._sharding(specs[k]) for k in arrays}
         return jax.device_put(arrays, shardings)
 
-    def _to_device(self, block):
+    def _to_device(self, block, flows=()):
         """→ (device batch, staging buffers to retire — () when the host
-        arrays came from the native pipeline or no pooled path)."""
+        arrays came from the native pipeline or no pooled path).
+        ``flows``: flow ids of the chunks in ``block`` — stepped inside
+        the ``stage`` span so the pool staging slice joins the arrow
+        chain (python paths only; native batches carry no flows)."""
         spec = self.spec
         if isinstance(block, tuple):  # native dense batch, pre-densified
             x, labels, weights, rows = block
@@ -498,9 +515,12 @@ class DeviceFeed:
             return self._put_csr(block), ()  # native COO batch, pre-padded
         if spec.layout == "dense":
             check(spec.num_features > 0, "dense layout requires num_features")
-            x, labels, weights = block_to_dense(
-                block, spec.batch_size, spec.num_features, pool=self.pool
-            )
+            with obs.span("stage", rows=len(block)):
+                for fid in flows:
+                    obs.flow_step(fid, "chunk")
+                x, labels, weights = block_to_dense(
+                    block, spec.batch_size, spec.num_features, pool=self.pool
+                )
             out = self._put_tree(
                 {"x": x, "label": labels, "weight": weights},
                 {"x": P(self._axis), "label": P(self._axis),
@@ -510,19 +530,22 @@ class DeviceFeed:
             return out, (x, labels, weights)
         if spec.layout == "csr":
             shards = self._shards
-            if shards > 1:
-                batch = pad_to_bucket_sharded(
-                    block, spec.batch_size, shards,
-                    nnz_bucket=spec.nnz_bucket,
-                )
-                bufs = ()
-            else:
-                batch = pad_to_bucket(
-                    block, spec.batch_size, nnz_bucket=spec.nnz_bucket,
-                    pool=self.pool,
-                )
-                bufs = (batch.labels, batch.weights, batch.indices,
-                        batch.values, batch.row_ids, batch.offsets)
+            with obs.span("stage", rows=len(block)):
+                for fid in flows:
+                    obs.flow_step(fid, "chunk")
+                if shards > 1:
+                    batch = pad_to_bucket_sharded(
+                        block, spec.batch_size, shards,
+                        nnz_bucket=spec.nnz_bucket,
+                    )
+                    bufs = ()
+                else:
+                    batch = pad_to_bucket(
+                        block, spec.batch_size, nnz_bucket=spec.nnz_bucket,
+                        pool=self.pool,
+                    )
+                    bufs = (batch.labels, batch.weights, batch.indices,
+                            batch.values, batch.row_ids, batch.offsets)
             return self._put_csr(batch), bufs
         raise ValueError(f"unknown layout {spec.layout!r}")
 
@@ -560,7 +583,7 @@ class DeviceFeed:
         """Retire a pending batch's staging buffers (guarded by its own
         device arrays: acquire() reuses them only once the async H2D copy
         is done) and hand the batch to the consumer."""
-        batch, bufs = entry
+        batch, bufs, _flows = entry
         if bufs:
             self.pool.retire(
                 bufs, [v for v in batch.values() if isinstance(v, jax.Array)]
@@ -582,11 +605,24 @@ class DeviceFeed:
         def _consume(entry):
             nonlocal ndelivered
             batch = self._deliver(entry)
+            flows = entry[2]
             t2 = time.monotonic_ns()
             # the consume span covers the yield: its duration IS the time
-            # the consumer held the batch (generator suspended)
+            # the consumer held the batch (generator suspended). The
+            # thread-local current flow is set for that same window so
+            # fit-loop spans (train_step, collective ops) can mark the
+            # in-flight chunk; flow_end fires inside the span, closing
+            # the arrow chain on the consume slice.
             with obs.span("consume", batch=ndelivered):
-                yield batch
+                if flows:
+                    obs.set_current_flow(flows[0])
+                try:
+                    yield batch
+                finally:
+                    if flows:
+                        obs.set_current_flow(0)
+                    for fid in flows:
+                        obs.flow_end(fid, "chunk")
             self._stage["consume_ns"].observe(time.monotonic_ns() - t2)
             ndelivered += 1
 
@@ -606,8 +642,12 @@ class DeviceFeed:
                         self._stage["host_wait_ns"].observe(
                             time.monotonic_ns() - t0)
                 t1 = time.monotonic_ns()
+                flows = getattr(block, "flow_ids", ())
                 with obs.span("dispatch", batch=nbatch):
-                    pending.append(self._to_device(block))  # async dispatch
+                    for fid in flows:
+                        obs.flow_step(fid, "chunk")
+                    batch_bufs = self._to_device(block, flows)
+                    pending.append(batch_bufs + (flows,))  # async dispatch
                 self._stage["dispatch_ns"].observe(time.monotonic_ns() - t1)
                 self._m_batches.inc()
                 nbatch += 1

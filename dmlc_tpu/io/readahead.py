@@ -35,6 +35,7 @@ import threading
 from collections import deque
 from typing import Callable, Iterable, Iterator, List, Sequence, Tuple
 
+from dmlc_tpu import obs
 from dmlc_tpu.io.filesystem import URI, FileSystem
 from dmlc_tpu.utils.logging import DMLCError, check
 
@@ -402,11 +403,28 @@ class RemotePartitionReader:
             )
             return data
 
-        def fetch(rng: Tuple[int, int, int]) -> bytes:
+        def fetch(rng: Tuple[int, int, int]):
             # hedging is only safe here: fetch_once allocates its own
             # buffer per attempt, so a duplicated request cannot race a
             # shared destination (the feed_into/into= path must never
             # hedge — two winners into one buffer is corruption)
-            return hedged_call(lambda: fetch_once(rng), hedge_s)
+            fid = obs.new_flow()
+            with obs.span("readahead_fetch", nbytes=rng[2], flow=fid):
+                data = hedged_call(lambda: fetch_once(rng), hedge_s)
+                obs.flow_start(fid, "range")
+            return fid, data
 
-        return fetch_ordered(fetch, self.ranges(), workers=self._connections)
+        def deliver() -> Iterator[bytes]:
+            # range-level flow arrows: fetch-worker slice → the consumer
+            # thread's pop. Chunk-level flows (PipelinedParser) start one
+            # layer up; these show which connection served which range.
+            for fid, data in fetch_ordered(
+                fetch, self.ranges(), workers=self._connections
+            ):
+                with obs.span(
+                    "readahead_deliver", nbytes=len(data), flow=fid
+                ):
+                    obs.flow_end(fid, "range")
+                yield data
+
+        return deliver()

@@ -190,9 +190,11 @@ class FMLearner:
             with obs.span("epoch", model="fm", epoch=epoch):
                 for batch in feed:
                     self._ensure(self.param.num_features)
-                    self.params, metrics = self._step(
-                        self.params, step_batch(batch, "csr")
-                    )
+                    with obs.span("train_step", model="fm", step=nstep):
+                        obs.flow_step(obs.current_flow(), "chunk")
+                        self.params, metrics = self._step(
+                            self.params, step_batch(batch, "csr")
+                        )
                     acc.add(metrics)
                     nstep += 1
             h_epoch.observe(time.monotonic_ns() - t0)

@@ -875,13 +875,20 @@ class GBDTLearner:
                                       p.num_bins)
             # pass 2: stream + bin on the host (no device chatter per
             # block)
+            from dmlc_tpu import obs
+
             parser.before_first()
             xb_parts, y_parts, w_parts = [], [], []
             any_weight = False
             for block in parser:
-                dense = block.to_dense(num_features)
-                xb_parts.append(
-                    _apply_bins_np(dense, self.edges, p.num_bins))
+                # gbdt consumes chunks here (no DeviceFeed): the binning
+                # slice terminates each pipelined chunk's arrow chain
+                fid = getattr(block, "flow_id", 0)
+                with obs.span("bin_block", rows=len(block), flow=fid):
+                    obs.flow_end(fid, "chunk")
+                    dense = block.to_dense(num_features)
+                    xb_parts.append(
+                        _apply_bins_np(dense, self.edges, p.num_bins))
                 y_parts.append(np.asarray(block.label, dtype=np.float32))
                 # instance weights ride the format when present (libsvm
                 # label:weight — data.h Row semantics); all-absent stays

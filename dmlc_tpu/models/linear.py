@@ -451,9 +451,14 @@ class LinearLearner:
             with obs.span("epoch", model="linear", epoch=epoch):
                 for batch in feed:
                     self._ensure(feed.spec.num_features, layout)
-                    self.params, self.velocity, metrics = self._step(
-                        self.params, self.velocity, step_batch(batch, layout)
-                    )
+                    # train_step closes the chunk's arrow chain: the feed
+                    # set the thread's current flow around this yield
+                    with obs.span("train_step", model="linear", step=nstep):
+                        obs.flow_step(obs.current_flow(), "chunk")
+                        self.params, self.velocity, metrics = self._step(
+                            self.params, self.velocity,
+                            step_batch(batch, layout)
+                        )
                     acc.add(metrics)
                     nstep += 1
                     if log_every and nstep % log_every == 0:

@@ -9,6 +9,11 @@ bottleneck diagnosis and auto-tuning):
   see obs/metrics.py)
 - :func:`span` / :func:`step_span` — Chrome-trace span context managers
   gated by ``DMLC_TPU_TRACE=<path>`` (see obs/trace.py)
+- :func:`new_flow` / :func:`flow_start` / :func:`flow_step` /
+  :func:`flow_end` — causal dataflow arrows (Chrome-trace flow events)
+  connecting a chunk's io→parse→stage→dispatch→consume journey across
+  threads and ranks; :func:`current_flow` / :func:`set_current_flow`
+  carry the in-flight chunk's id through the fit loop
 - exporters — JSONL / Prometheus textfile / log-sink summary, driven at
   epoch boundaries by :func:`export_epoch` via ``DMLC_TPU_METRICS_EXPORT``
 - :func:`cross_host_snapshot` / :func:`report_skew` — per-host
@@ -44,8 +49,14 @@ from dmlc_tpu.obs.metrics import (
 )
 from dmlc_tpu.obs.trace import (
     clear as clear_trace,
+    current_flow,
     events as trace_events,
+    flow_end,
+    flow_start,
+    flow_step,
     flush as flush_trace,
+    new_flow,
+    set_current_flow,
     span,
     step_span,
 )
@@ -58,6 +69,12 @@ __all__ = [
     "registry",
     "span",
     "step_span",
+    "new_flow",
+    "flow_start",
+    "flow_step",
+    "flow_end",
+    "current_flow",
+    "set_current_flow",
     "trace_events",
     "clear_trace",
     "flush_trace",

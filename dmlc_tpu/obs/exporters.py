@@ -31,7 +31,12 @@ import time
 from typing import List, Optional
 
 from dmlc_tpu.obs import trace
-from dmlc_tpu.obs.metrics import Registry, format_name, registry
+from dmlc_tpu.obs.metrics import (
+    Registry,
+    escape_label_value,
+    format_name,
+    registry,
+)
 from dmlc_tpu.params.knobs import metrics_export_path
 
 
@@ -62,7 +67,9 @@ def export_jsonl(path: str, reg: Optional[Registry] = None) -> None:
 def _prom_labels(labelkey) -> str:
     if not labelkey:
         return ""
-    return "{%s}" % ",".join('%s="%s"' % (k, v) for k, v in labelkey)
+    return "{%s}" % ",".join(
+        '%s="%s"' % (k, escape_label_value(v)) for k, v in labelkey
+    )
 
 
 def prometheus_lines(reg: Optional[Registry] = None) -> List[str]:

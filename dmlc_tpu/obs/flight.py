@@ -79,8 +79,15 @@ class FlightRecorder:
             self._ring.append(entry)
 
     def note_span(self, event: Dict) -> None:
-        self.note("span", name=event.get("name"), ts=event.get("ts"),
-                  dur=event.get("dur"), tid=event.get("tid"))
+        if event.get("ph") not in (None, "X"):
+            return  # flow markers ride the trace, not the crash ring
+        fields = dict(name=event.get("name"), ts=event.get("ts"),
+                      dur=event.get("dur"), tid=event.get("tid"))
+        args = event.get("args")
+        if isinstance(args, dict) and args.get("flow"):
+            # which chunk was in flight when the process died
+            fields["flow"] = args["flow"]
+        self.note("span", **fields)
 
     def note_metrics(self, reg: Optional[Registry] = None) -> None:
         """Record which flat metrics moved since the last call (deltas

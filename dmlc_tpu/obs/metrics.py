@@ -47,12 +47,29 @@ def _label_key(labels: Dict[str, object]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-format label-value escaping: ``\\`` → ``\\\\``,
+    ``"`` → ``\\"``, newline → ``\\n`` (exposition format spec). Backslash
+    first, or the escapes it introduces would be re-escaped."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def format_name(name: str, labels: LabelKey) -> str:
     """``name{k="v",...}`` — the Prometheus-style flat identity used by
-    snapshots, exporters, and the cross-host vector ordering."""
+    snapshots, exporters, and the cross-host vector ordering. Label
+    values are escaped per the Prometheus text format, so the flat name
+    stays parseable (and servable by the plane's merged ``/metrics``)
+    whatever the value contains."""
     if not labels:
         return name
-    inner = ",".join('%s="%s"' % (k, v) for k, v in labels)
+    inner = ",".join(
+        '%s="%s"' % (k, escape_label_value(v)) for k, v in labels
+    )
     return "%s{%s}" % (name, inner)
 
 

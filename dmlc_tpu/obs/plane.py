@@ -436,6 +436,8 @@ class StatusPlane:
                     worst_lag = now - v.last_seen_unix
                     lag_straggler = rank
                 for e in v.spans:
+                    if e.get("ph") not in (None, "X"):
+                        continue  # flow points carry no duration
                     per_stage.setdefault(e.get("name", "?"), {}).setdefault(
                         rank, 0.0)
                     per_stage[e.get("name", "?")][rank] += float(

@@ -35,11 +35,23 @@ a ``jax.profiler.TraceAnnotation`` (and ``step_span`` a
 ``StepTraceAnnotation``) when the running jax exposes them, so the same
 span names show up inside an XLA profiler capture next to the device
 timeline. Absent jax or the API, the bridge silently stays off.
+
+Flow events: ``new_flow()`` allocates a job-unique flow id and
+``flow_start/flow_step/flow_end`` emit Chrome-trace flow events
+(``"ph": "s"/"t"/"f"``) that Perfetto renders as arrows connecting the
+enclosing duration slices — across threads, and (because the id embeds
+the rank) across ranks once obs/plane.py merges per-worker traces. A
+flow point binds to the ``"ph": "X"`` slice open on the same pid/tid at
+its timestamp, so always emit flow points *inside* the span for the
+stage they mark. When tracing is off, ``new_flow()`` returns 0 and every
+flow call is an early-returning no-op — zero allocations on the hot
+path (the disabled contract tests/test_obs.py pins).
 """
 
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import os
 import threading
@@ -196,6 +208,104 @@ def step_span(step_num: int, name: str = "step", **args):
     cls = _jax_annotation_cls(step=True)
     annot = cls(name, step_num=step_num) if cls is not None else None
     return _Span(name, dict(args, step=step_num), annot)
+
+
+# ---- Flow events (causal dataflow arrows) -------------------------------
+# Chrome trace flow events match globally on (cat, id): a chunk's id must
+# be unique across every process whose trace lands in the merged /trace.
+# Layout: (rank+1) in the high bits | pid salt | per-process counter. The
+# pid salt keeps colocated rank-0 processes (tests, local launcher) from
+# colliding; the counter wraps at 2^24 flows, far past any one job.
+_FLOW_CAT = "dataflow"
+_FLOW_IDS = itertools.count(1)
+_FLOW_BASE: Optional[int] = None
+_FLOW_TLS = threading.local()
+
+
+def _flow_base() -> int:
+    global _FLOW_BASE
+    if _FLOW_BASE is None:
+        try:
+            rank = int(os.environ.get("DMLC_TASK_ID") or 0)
+        except ValueError:
+            rank = 0
+        _FLOW_BASE = (((rank & 0x3FFFFF) + 1) << 40) | (
+            (_PID & 0xFFFF) << 24
+        )
+    return _FLOW_BASE
+
+
+def new_flow() -> int:
+    """Allocate a flow id, or 0 when tracing is disarmed.
+
+    0 is the "no flow" sentinel every flow call early-returns on, so the
+    disabled path allocates nothing — callers can thread the result
+    unconditionally."""
+    if _active_path() is None and not _listeners:
+        return 0
+    return _flow_base() | (next(_FLOW_IDS) & 0xFFFFFF)
+
+
+def _flow_event(fid: int, ph: str, name: str) -> None:
+    event = {
+        "name": name,
+        "cat": _FLOW_CAT,
+        "ph": ph,
+        "id": fid,
+        "ts": _now_us(),
+        "pid": _PID,
+        "tid": threading.get_ident(),
+    }
+    if ph == "f":
+        # bind the arrow head to the enclosing slice rather than the
+        # next slice on the thread ("binding point: enclosing")
+        event["bp"] = "e"
+    if _active_path() is not None:
+        _ensure_atexit()
+        with _lock:
+            _events.append(event)
+    for fn in list(_listeners):
+        try:
+            fn(event)
+        except Exception:
+            pass  # telemetry consumers must never break the traced code
+
+
+def flow_start(fid: int, name: str = "flow") -> None:
+    """Emit the ``"s"`` (start) point of flow ``fid``. No-op when ``fid``
+    is 0 or tracing is disarmed. Call inside the span of the producing
+    stage so the arrow tail attaches to that slice."""
+    if not fid or (_active_path() is None and not _listeners):
+        return
+    _flow_event(fid, "s", name)
+
+
+def flow_step(fid: int, name: str = "flow") -> None:
+    """Emit a ``"t"`` (step) point: the flow passed through the enclosing
+    stage. No-op when ``fid`` is 0 or tracing is disarmed."""
+    if not fid or (_active_path() is None and not _listeners):
+        return
+    _flow_event(fid, "t", name)
+
+
+def flow_end(fid: int, name: str = "flow") -> None:
+    """Emit the ``"f"`` (finish) point terminating flow ``fid`` (with
+    ``"bp": "e"`` so the head binds to the enclosing slice)."""
+    if not fid or (_active_path() is None and not _listeners):
+        return
+    _flow_event(fid, "f", name)
+
+
+def set_current_flow(fid: int) -> None:
+    """Stash ``fid`` as this thread's ambient flow. DeviceFeed sets it
+    around the consume yield so fit-loop code (collective op spans,
+    train_step) can mark the in-flight chunk without plumbing ids."""
+    _FLOW_TLS.fid = fid
+
+
+def current_flow() -> int:
+    """This thread's ambient flow id (0 when none is set)."""
+    return getattr(_FLOW_TLS, "fid", 0)
 
 
 def events() -> List[Dict]:

@@ -1,0 +1,143 @@
+"""bench-gate: fail the build when a fresh bench run regresses.
+
+Feeds the perf sentry (obs/sentry.py): load the fresh record (a
+``bench_detail.json`` or a ``BENCH_r*.json``) plus the round history,
+compute noise-aware baselines (median ± MAD over the most recent
+``--window`` rounds per metric), and exit non-zero with a ranked
+regression report when a headline metric or a pipeline stall stage
+degrades beyond tolerance.
+
+    python -m dmlc_tpu.tools bench-gate \
+        --fresh bench_detail.json --history 'BENCH_r*.json'
+
+The fresh file may also appear in the history glob — the median baseline
+is robust to its own newest point, and self-inclusion is what lets a
+fresh record's environment-specific metrics (only it has measured) pass
+trivially rather than false-positive against alien hardware.
+
+``--smoke`` runs the self-check on the canned record pair shipped in
+obs/sentry.py (the degraded twin must fail, the clean one must pass) —
+wired into scripts/ci_checks.sh so the gate logic can't rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+from typing import List, Optional
+
+from dmlc_tpu.obs import sentry
+
+
+def _default_fresh() -> Optional[str]:
+    path = os.environ.get("DMLC_TPU_BENCH_DETAIL")
+    if path and os.path.exists(path):
+        return path
+    bench_dir = os.environ.get("DMLC_TPU_BENCH_DIR")
+    if bench_dir:
+        path = os.path.join(bench_dir, "bench_detail.json")
+        if os.path.exists(path):
+            return path
+    return None
+
+
+def _smoke() -> int:
+    series = sentry.metric_series(sentry.SMOKE_HISTORY)
+    clean = sentry.gate(
+        sentry.record_values(sentry.SMOKE_HISTORY[-1]), series)
+    degraded = sentry.gate(
+        sentry.record_values(sentry.smoke_degraded()), series)
+    failures = []
+    if clean:
+        failures.append(
+            "clean canned record flagged: %s" % [r["metric"] for r in clean])
+    if not any(r["metric"] == "higgs_libsvm_ingest" for r in degraded):
+        failures.append("20%% headline regression not caught")
+    if not any(r["metric"] == "stall.host_wait_s" for r in degraded):
+        failures.append("doubled stall stage not caught")
+    if failures:
+        for f in failures:
+            print("bench-gate --smoke FAILED: %s" % f)
+        return 1
+    print(
+        "bench-gate --smoke OK: clean record passes, degraded record "
+        "trips %d regression(s)" % len(degraded)
+    )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench-gate",
+        description="noise-aware perf regression gate over bench history",
+    )
+    ap.add_argument(
+        "--fresh",
+        help="fresh record (bench_detail.json or BENCH_r*.json; default "
+             "$DMLC_TPU_BENCH_DETAIL, else the newest history record)",
+    )
+    ap.add_argument(
+        "--history", action="append", default=[],
+        help="history file or glob; repeatable (default BENCH_r*.json)",
+    )
+    ap.add_argument("--rel-tol", type=float,
+                    default=sentry.DEFAULT_REL_TOL,
+                    help="relative tolerance floor (default %(default)s)")
+    ap.add_argument("--mad-mult", type=float,
+                    default=sentry.DEFAULT_MAD_MULT,
+                    help="MAD multiplier (default %(default)s)")
+    ap.add_argument("--window", type=int, default=sentry.DEFAULT_WINDOW,
+                    help="recent rounds per baseline (default %(default)s)")
+    ap.add_argument("--min-samples", type=int,
+                    default=sentry.DEFAULT_MIN_SAMPLES,
+                    help="history points required to gate a metric "
+                         "(default %(default)s)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-check on the canned record pair and exit")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke()
+
+    patterns = args.history or ["BENCH_r*.json"]
+    paths: List[str] = []
+    for pat in patterns:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else ([pat] if os.path.exists(pat) else []))
+    history = sentry.load_records(paths)
+    fresh_path = args.fresh or _default_fresh()
+    if fresh_path:
+        fresh_recs = sentry.load_record(fresh_path)
+        if not fresh_recs:
+            print("bench-gate: no parseable record in %s" % fresh_path,
+                  file=sys.stderr)
+            return 2
+        fresh_rec = fresh_recs[-1]
+    elif history:
+        fresh_rec = history[-1]
+        fresh_path = fresh_rec.get("source", "<history tail>")
+    else:
+        print("bench-gate: no fresh record and no history "
+              "(looked at: %s)" % ", ".join(patterns), file=sys.stderr)
+        return 2
+
+    series = sentry.metric_series(history)
+    regressions = sentry.gate(
+        sentry.record_values(fresh_rec), series,
+        rel_tol=args.rel_tol, mad_mult=args.mad_mult,
+        window=args.window, min_samples=args.min_samples,
+    )
+    if regressions:
+        print(sentry.format_report(regressions, fresh_source=fresh_path))
+        return 1
+    print(
+        "bench-gate OK: %s within tolerance of %d history record(s)"
+        % (fresh_path, len(history))
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

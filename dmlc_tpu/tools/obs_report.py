@@ -11,8 +11,13 @@ Renders a human-readable summary of a job's observability artifacts:
   cross-rank slack table, widest stage first — the critical-path view.
 - ``--status HOST:PORT`` — fetch ``/workers`` and ``/trace`` from a
   *live* tracker status server instead of files.
+- ``--diff A B`` — compare two traces (e.g. the last good run's
+  ``/trace`` download vs the regressed run's): per-stage total time
+  delta, biggest eater first — "which stage ate the regression", the
+  follow-up question a failing bench-gate raises.
 
-Exit 0 with a report, 2 when no artifact source yields anything.
+Exit 0 with a report, 2 when no artifact source yields anything (for
+``--diff``, when either trace is unreadable).
 """
 
 from __future__ import annotations
@@ -101,6 +106,51 @@ def _report_trace(trace_obj: Dict) -> bool:
     return True
 
 
+def _load_trace(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError) as err:
+        print(f"obs-report: cannot read trace {path}: {err}",
+              file=sys.stderr)
+        return None
+
+
+def _report_diff(path_a: str, path_b: str) -> bool:
+    """Critical-path delta table between two traces: per-stage total
+    duration summed across ranks, sorted by how much B grew over A."""
+    obj_a = _load_trace(path_a)
+    obj_b = _load_trace(path_b)
+    if obj_a is None or obj_b is None:
+        return False
+    totals = []
+    for obj in (obj_a, obj_b):
+        per_stage = _stage_table(obj.get("traceEvents", []))
+        totals.append(
+            {name: sum(per.values()) for name, per in per_stage.items()}
+        )
+    tot_a, tot_b = totals
+    stages = sorted(set(tot_a) | set(tot_b))
+    if not stages:
+        print("== trace diff: no complete spans in either trace ==")
+        return False
+    rows = []
+    for name in stages:
+        a = tot_a.get(name, 0.0)
+        b = tot_b.get(name, 0.0)
+        pct = ((b - a) / a * 100.0) if a else float("inf")
+        rows.append((b - a, pct, name, a, b))
+    rows.sort(reverse=True)
+    print(f"== trace diff: {path_a} -> {path_b} ==")
+    print(f"{'stage':<28} {'A_ms':>10} {'B_ms':>10} {'delta_ms':>10} "
+          f"{'delta':>8}")
+    for delta, pct, name, a, b in rows:
+        pct_s = f"{pct:+.0f}%" if pct != float("inf") else "new"
+        print(f"{name:<28} {a / 1e3:>10.1f} {b / 1e3:>10.1f} "
+              f"{delta / 1e3:>+10.1f} {pct_s:>8}")
+    return True
+
+
 def _report_workers(workers: Dict[str, Dict]) -> None:
     print("== workers ==")
     print(f"{'rank':>4} {'lag_s':>8} {'straggler':>9} {'epoch':>6} "
@@ -135,8 +185,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "download).")
     parser.add_argument("--status", default=None,
                         help="host:port of a live tracker status server.")
+    parser.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                        default=None,
+                        help="Two trace files: print the per-stage "
+                        "critical-path delta table (B relative to A).")
     args = parser.parse_args(argv)
     reported = False
+    if args.diff:
+        reported = _report_diff(args.diff[0], args.diff[1])
     if args.status:
         workers = _fetch(args.status, "/workers")
         if workers is not None:
@@ -151,17 +207,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             _report_flightrecs(dumps)
             reported = True
     if args.trace:
-        try:
-            with open(args.trace) as fh:
-                trace_obj = json.load(fh)
-        except (OSError, ValueError) as err:
-            print(f"obs-report: cannot read trace {args.trace}: {err}",
-                  file=sys.stderr)
-        else:
+        trace_obj = _load_trace(args.trace)
+        if trace_obj is not None:
             reported = _report_trace(trace_obj) or reported
     if not reported:
         print("obs-report: nothing to report (pass --flightrec, --trace, "
-              "or --status)", file=sys.stderr)
+              "--diff, or --status)", file=sys.stderr)
         return 2
     return 0
 

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Repo consistency checks, one entry point: metric-name lint, faultpoint/
+# knob lint, and the perf-sentry self-check. Run from anywhere; wired
+# into the tier-1 suite by tests/test_sentry.py so it cannot rot.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python scripts/check_metric_names.py
+python scripts/check_faultpoints.py
+python -m dmlc_tpu.tools bench-gate --smoke
+echo "ci_checks: all checks passed"

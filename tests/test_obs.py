@@ -3,7 +3,10 @@ thread safety, disabled-path cost, span tracing, exporters, cross-host
 aggregation, tracker heartbeats, and the Timer satellite fixes.
 """
 
+import gc
 import json
+import os
+import sys
 import threading
 import time
 
@@ -11,7 +14,12 @@ import numpy as np
 import pytest
 
 from dmlc_tpu import obs
-from dmlc_tpu.obs.metrics import DEFAULT_BUCKETS, NOOP, Registry
+from dmlc_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    NOOP,
+    Registry,
+    escape_label_value,
+)
 from dmlc_tpu.utils.logging import DMLCError
 from dmlc_tpu.utils.timer import Timer
 
@@ -105,6 +113,15 @@ class TestHistogramBuckets:
         # overflow observations clamp to the last finite bound
         assert h.quantile(1.0) == 100
         assert h.quantile(2.0) == h.quantile(1.0)  # q clamped down
+
+    def test_quantile_single_bucket(self):
+        h = Registry().histogram("dmlc_t_q1_ns", buckets=(8,))
+        assert h.quantile(1.0) == 0.0  # still empty
+        for _ in range(4):
+            h.observe(2)
+        assert h.quantile(0.0) == 0.0  # lower edge of the only bucket
+        assert h.quantile(1.0) == 8    # upper edge of the only bucket
+        assert h.quantile(0.5) == pytest.approx(4.0)  # interpolated
 
     def test_quantile_noop_child(self, monkeypatch):
         monkeypatch.setenv("DMLC_TPU_METRICS", "0")
@@ -211,6 +228,114 @@ class TestSpans:
         obs.clear_trace()
 
 
+class TestFlow:
+    def test_disabled_is_zero_and_allocation_free(self, monkeypatch):
+        monkeypatch.delenv("DMLC_TPU_TRACE", raising=False)
+        obs.clear_trace()
+        assert obs.new_flow() == 0
+
+        def burst(n=2000):
+            for _ in range(n):
+                fid = obs.new_flow()
+                obs.flow_start(fid, "chunk")
+                obs.flow_step(fid, "chunk")
+                obs.flow_end(fid, "chunk")
+
+        burst()  # warm caches before measuring
+        # min over trials irons out interpreter noise; a single retained
+        # object per call would show up as ~2000 blocks in every trial
+        deltas = []
+        for _ in range(5):
+            gc.collect()
+            before = sys.getallocatedblocks()
+            burst()
+            gc.collect()
+            deltas.append(sys.getallocatedblocks() - before)
+        assert min(deltas) <= 0
+
+    def test_enabled_chain_same_id_and_bp(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("DMLC_TPU_TRACE", str(tmp_path / "flow.json"))
+        obs.clear_trace()
+        fid = obs.new_flow()
+        assert fid > 0
+        assert obs.new_flow() != fid  # unique per allocation
+        with obs.span("io_read", flow=fid):
+            obs.flow_start(fid, "chunk")
+        with obs.span("parse", flow=fid):
+            obs.flow_step(fid, "chunk")
+        with obs.span("consume"):
+            obs.flow_end(fid, "chunk")
+        flows = [e for e in obs.trace_events()
+                 if e.get("cat") == "dataflow" and e.get("id") == fid]
+        assert [e["ph"] for e in flows] == ["s", "t", "f"]
+        for e in flows:
+            assert e["name"] == "chunk" and e["ts"] >= 0
+        # arrow head binds to the enclosing slice, tail/steps to theirs
+        assert "bp" not in flows[0] and "bp" not in flows[1]
+        assert flows[2]["bp"] == "e"
+        obs.clear_trace()
+
+    def test_flow_id_embeds_rank_and_pid(self, monkeypatch, tmp_path):
+        from dmlc_tpu.obs import trace as trace_mod
+
+        monkeypatch.setenv("DMLC_TPU_TRACE", str(tmp_path / "flow.json"))
+        monkeypatch.setenv("DMLC_TASK_ID", "3")
+        monkeypatch.setattr(trace_mod, "_FLOW_BASE", None)
+        obs.clear_trace()
+        fid = obs.new_flow()
+        assert fid >> 40 == 3 + 1  # rank+1 in the high bits
+        assert (fid >> 24) & 0xFFFF == os.getpid() & 0xFFFF
+        obs.clear_trace()
+
+    def test_current_flow_is_thread_local(self):
+        obs.set_current_flow(7)
+        try:
+            assert obs.current_flow() == 7
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(obs.current_flow()))
+            t.start()
+            t.join()
+            assert seen == [0]  # other threads see no ambient flow
+        finally:
+            obs.set_current_flow(0)
+        assert obs.current_flow() == 0
+
+    def test_ingest_flow_chain_end_to_end(self, monkeypatch, tmp_path):
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.data.pipeline import PipelinedParser
+        from dmlc_tpu.device.feed import BatchSpec, DeviceFeed
+        from dmlc_tpu.io.input_split import create_input_split
+
+        monkeypatch.setenv("DMLC_TPU_TRACE", str(tmp_path / "e2e.json"))
+        obs.clear_trace()
+        rng = np.random.RandomState(1)
+        lines = []
+        for i in range(600):
+            ids = np.sort(rng.choice(40, size=1 + i % 7, replace=False))
+            feats = " ".join("%d:%.6f" % (j, rng.rand()) for j in ids)
+            lines.append("%d %s" % (i % 2, feats))
+        path = tmp_path / "flow.svm"
+        path.write_text("\n".join(lines) + "\n")
+        split = create_input_split(str(path), 0, 1, "text", threaded=False)
+        split.hint_chunk_size(4096)  # multi-chunk, or one flow proves little
+        piped = PipelinedParser(LibSVMParser(split, nthread=1), nthread=2)
+        spec = BatchSpec(batch_size=128, layout="dense", num_features=40)
+        feed = DeviceFeed(piped, spec)
+        for batch in feed:
+            np.asarray(batch["label"])
+        feed.close()
+        chains = {}
+        for e in obs.trace_events():
+            if e.get("cat") == "dataflow":
+                chains.setdefault(e["id"], []).append(e["ph"])
+        assert len(chains) > 1  # one flow per chunk
+        # at least one chunk's full journey: io_read s → t steps → consume f
+        assert any(phs[0] == "s" and phs[-1] == "f" and "t" in phs
+                   for phs in chains.values())
+        obs.clear_trace()
+
+
 class TestExporters:
     def _reg(self):
         reg = Registry()
@@ -238,6 +363,19 @@ class TestExporters:
         assert 'dmlc_t_exp_ns_bucket{le="4"} 1' in text
         assert 'dmlc_t_exp_ns_bucket{le="+Inf"} 1' in text
         assert "dmlc_t_exp_ns_count 1" in text
+
+    def test_label_value_escaping(self):
+        from dmlc_tpu.obs.exporters import prometheus_lines
+
+        # backslash escaped first, or its own escapes would re-escape
+        assert escape_label_value('a\\b"c\nd') == 'a\\\\b\\"c\\nd'
+        reg = Registry()
+        reg.counter("dmlc_t_esc_total", "c", path='a"b\\c\nd').inc(1)
+        lines = prometheus_lines(reg)
+        assert all("\n" not in line for line in lines)  # format-valid
+        assert 'dmlc_t_esc_total{path="a\\"b\\\\c\\nd"} 1' in lines
+        # the flat snapshot identity uses the same escaping
+        assert 'dmlc_t_esc_total{path="a\\"b\\\\c\\nd"}' in reg.snapshot()
 
     def test_summary_line_and_export_epoch(self, monkeypatch, tmp_path):
         reg = self._reg()

@@ -172,6 +172,58 @@ class TestStatusPlane:
         assert 'dmlc_w_h_ns_sum{rank="0"} 5' in text
         assert 'dmlc_w_h_ns_count{rank="0"} 2' in text
 
+    def test_merged_trace_stitches_cross_rank_flow(self):
+        """A chunk fetched through BlockService on rank 1 and consumed
+        on rank 0 must come out of the merged trace as one connected
+        flow (same id, skew-rebased t-before-f) with each flow point
+        still inside its enclosing slice."""
+        sp = plane.StatusPlane(num_workers=2, heartbeat_gap=60.0)
+        anchor = 10 ** 18
+        fid = (2 << 40) | 99  # rank-1-flavored id, as new_flow would mint
+        send_span = _fake_span("service_send", 100, dur_us=20, tid=7)
+        step = {"name": "chunk", "cat": "dataflow", "ph": "t", "id": fid,
+                "ts": 110.0, "pid": 0, "tid": 7}
+        consume_span = _fake_span("consume", 500, dur_us=30, tid=3)
+        fin = {"name": "chunk", "cat": "dataflow", "ph": "f", "bp": "e",
+               "id": fid, "ts": 510.0, "pid": 0, "tid": 3}
+        # the serving rank's clock runs 5 s ahead; rebase must cancel it
+        self._feed(sp, 1, anchor, 5_000_000_000, [send_span, step])
+        self._feed(sp, 0, anchor, 0, [consume_span, fin])
+        doc = sp.merged_trace()
+        flows = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "dataflow"]
+        assert [(e["ph"], e["pid"]) for e in flows] == [("t", 1), ("f", 0)]
+        assert all(e["id"] == fid for e in flows)
+        t_evt, f_evt = flows
+        assert f_evt["bp"] == "e"
+        assert t_evt["ts"] < f_evt["ts"]
+        by_key = {(e["name"], e["pid"]): e for e in doc["traceEvents"]
+                  if e.get("ph") == "X"}
+        send = by_key[("service_send", 1)]
+        cons = by_key[("consume", 0)]
+        assert send["ts"] <= t_evt["ts"] <= send["ts"] + send["dur"]
+        assert cons["ts"] <= f_evt["ts"] <= cons["ts"] + cons["dur"]
+        # durationless flow points stay out of the stage accounting
+        slack = sp.stage_slack()
+        assert "chunk" not in slack
+        assert {"service_send", "consume"} <= set(slack)
+
+    def test_merged_metrics_text_escaped_labels_survive(self):
+        from dmlc_tpu.obs.metrics import format_name
+
+        flat = format_name("dmlc_w_esc_total", (("path", 'a"b\\c\nd'),))
+        sp = plane.StatusPlane(num_workers=1)
+        sp.note_payload(0, {
+            "sent_unix_ns": time.time_ns(), "anchor_unix_ns": 1,
+            "metrics": {flat: 1.0}, "spans": [],
+        }, recv_unix_ns=time.time_ns())
+        text = sp.merged_metrics_text(Registry())
+        hits = [line for line in text.splitlines()
+                if "dmlc_w_esc_total" in line]
+        # worker-side escaping keeps the merged exposition one-per-line
+        assert hits == [
+            'dmlc_w_esc_total{path="a\\"b\\\\c\\nd",rank="0"} 1']
+
     def test_malformed_payload_ignored(self):
         sp = plane.StatusPlane(num_workers=1)
         sp.note_payload(0, "not a dict", recv_unix_ns=time.time_ns())
@@ -377,6 +429,20 @@ class TestFlightRecorder:
             assert rec.records()[-1]["kind"] == "fault.injected"
         finally:
             flight.reset()
+
+    def test_note_span_records_flow_and_skips_flow_points(self, tmp_path):
+        rec = flight.FlightRecorder(str(tmp_path), capacity=8, rank=0)
+        rec.note_span({"name": "stage", "ph": "X", "ts": 1.0, "dur": 2.0,
+                       "tid": 5, "args": {"flow": 123}})
+        rec.note_span({"name": "chunk", "cat": "dataflow", "ph": "t",
+                       "id": 123, "ts": 1.5, "pid": 0, "tid": 5})
+        rec.note_span({"name": "plain", "ts": 2.0, "dur": 1.0, "tid": 5})
+        spans = [r for r in rec.records() if r["kind"] == "span"]
+        # flow markers ride the trace, not the crash ring; X slices keep
+        # the flow id so a dump names the chunk in flight at death
+        assert [r["name"] for r in spans] == ["stage", "plain"]
+        assert spans[0]["flow"] == 123
+        assert "flow" not in spans[1]
 
     def test_dump_if_injected_walks_cause_chain(self, tmp_path):
         from dmlc_tpu.resilience.faults import InjectedFault
