@@ -1175,6 +1175,16 @@ def main() -> None:
         extra["metrics_error"] = str(err)[:120]
 
     try:
+        # device-side picture (compile counts, peak HBM, H2D MB/s) —
+        # placed before the sentry pass so compiles.<fn>/hbm.peak_bytes/
+        # h2d_mbps gate against history like any other metric
+        from dmlc_tpu.obs import device_telemetry
+
+        extra["device_telemetry"] = device_telemetry.detail_section()
+    except Exception as err:
+        extra["device_telemetry_error"] = str(err)[:120]
+
+    try:
         # advisory perf-sentry pass (report-only — the blocking gate is
         # `dmlc_tpu.tools bench-gate` in scripts/ci_checks.sh): gate this
         # run against the committed round history so the regression

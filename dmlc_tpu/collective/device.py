@@ -18,6 +18,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_tpu import obs
+from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.utils.jax_compat import axis_size, shard_map
 
 from dmlc_tpu.utils.logging import DMLCError
@@ -119,8 +120,9 @@ class DeviceEngine:
 
             reduce_fn = _REDUCE_OPS[op]
             out_sharding = NamedSharding(self._process_mesh(), P())
-            fn = jax.jit(
+            fn = instrumented_jit(
                 lambda x: reduce_fn(x, axis=0),
+                "collective.reduce",
                 out_shardings=out_sharding,
             )
             self._reduce_fns[op] = fn
@@ -366,12 +368,13 @@ def make_allreduce_step(mesh: Mesh, axis: str = "dp", bucket: bool = True):
         return jax.tree.unflatten(treedef, out)
 
     spec = P(axis)
-    return jax.jit(
+    return instrumented_jit(
         shard_map(
             _sum,
             mesh=mesh,
             in_specs=spec,
             out_specs=P(),
         ),
+        "collective.allreduce_step",
         donate_argnums=(0,),
     )

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu import obs
+from dmlc_tpu.obs import device_telemetry
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
 from dmlc_tpu.data.row_block import RowBlockContainer
 from dmlc_tpu.device.csr import (
@@ -348,6 +349,10 @@ class DeviceFeed:
         }
         self._m_batches = reg.counter(
             "dmlc_feed_batches_total", "device batches delivered", feed=fid)
+        # H2D accounting around _put_tree: None when device telemetry is
+        # off, and then the dispatch path has no byte walk and no timer.
+        self._h2d = device_telemetry.h2d_meter(feed=fid)
+        device_telemetry.maybe_start_hbm_poller()
         self._epoch_base: dict = {}
         self._sync_host = host_prefetch <= 0
         if self._sync_host:
@@ -471,7 +476,22 @@ class DeviceFeed:
     def _put_tree(self, arrays: dict, specs: dict) -> dict:
         """One batched transfer for all of a batch's arrays: per-array
         device_put pays the dispatch overhead N times (measured ~5 ms/call
-        through a tunneled runtime); a pytree device_put batches them."""
+        through a tunneled runtime); a pytree device_put batches them.
+        With device telemetry on, the put is metered: payload bytes →
+        ``dmlc_feed_h2d_bytes_total``, submission MB/s →
+        ``dmlc_feed_h2d_mbps``."""
+        meter = self._h2d
+        if meter is None:
+            return self._put_tree_raw(arrays, specs)
+        nbytes = 0
+        for v in arrays.values():
+            nbytes += getattr(v, "nbytes", 0)
+        t0 = time.monotonic_ns()
+        out = self._put_tree_raw(arrays, specs)
+        meter.note(nbytes, time.monotonic_ns() - t0)
+        return out
+
+    def _put_tree_raw(self, arrays: dict, specs: dict) -> dict:
         if self._mesh is None:
             if jax.default_backend() == "cpu" and \
                     os.environ.get("DMLC_TPU_FEED_PUT") != "1":

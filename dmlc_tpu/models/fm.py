@@ -22,6 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dmlc_tpu.utils.jax_compat import shard_map
 
 from dmlc_tpu.models.linear import _margin_grad, step_batch
+from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import check
@@ -98,7 +99,6 @@ def make_fm_train_step(
 
     if mesh is None:
 
-        @jax.jit
         def step(params, batch):
             gw, gb, gv, loss_sum, wsum = _fm_forward_grads(
                 params, batch, objective, num_features
@@ -106,7 +106,7 @@ def make_fm_train_step(
             params = _apply(params, gw, gb, gv, wsum)
             return params, {"loss_sum": loss_sum, "weight_sum": wsum}
 
-        return step
+        return instrumented_jit(step, "fm.step")
 
     # Entries arrive SHARDED (ShardedCSRBatch: per-shard sections, local
     # row ids) — each device holds only its own nnz; no global mask.
@@ -131,7 +131,7 @@ def make_fm_train_step(
     step = shard_map(
         _sharded, mesh=mesh, in_specs=(P(), batch_specs), out_specs=(P(), P())
     )
-    return jax.jit(step, donate_argnums=(0,))
+    return instrumented_jit(step, "fm.step", donate_argnums=(0,))
 
 
 class FMLearner:

@@ -49,6 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.utils.jax_compat import shard_map
 
+from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import check
 
@@ -472,7 +473,7 @@ def make_tree_builder(
         )
 
     if mesh is None:
-        return jax.jit(_build)
+        return instrumented_jit(_build, "gbdt.build_tree")
     data_specs = (P(axis), P(axis), P(axis)) + (
         (P(),) if with_feat_mask else ())
     sharded = shard_map(
@@ -481,7 +482,7 @@ def make_tree_builder(
         in_specs=data_specs,
         out_specs=(P(), P(), P(), P(), P(axis)),
     )
-    return jax.jit(sharded)
+    return instrumented_jit(sharded, "gbdt.build_tree")
 
 
 def make_forest_builder(
@@ -582,7 +583,7 @@ def make_forest_builder(
         return trees, losses
 
     if mesh is None:
-        return jax.jit(_forest)
+        return instrumented_jit(_forest, "gbdt.forest")
     check(not with_eval,
           "mesh forest builds don't take an eval set — evaluate the "
           "replicated model after fit")
@@ -593,7 +594,7 @@ def make_forest_builder(
         in_specs=data_specs,
         out_specs=(P(), P()),
     )
-    return jax.jit(sharded)
+    return instrumented_jit(sharded, "gbdt.forest")
 
 
 def _tree_level_offsets(max_depth: int) -> np.ndarray:
@@ -1072,10 +1073,11 @@ class GBDTLearner:
                     "for a scan-identical model")
             base_key = jax.random.PRNGKey(p.seed)
             nf = int(xb.shape[1])
-            mask_step = jax.jit(
+            mask_step = instrumented_jit(
                 lambda t, g, h: _apply_stochastic_masks(
                     base_key, t, nf, g, h, p.subsample,
-                    p.colsample_bytree, None))
+                    p.colsample_bytree, None),
+                "gbdt.mask_step")
         grad_fn = self._make_grad_fn(weighted)
         update_fn = self._make_margin_update()
         if with_eval:
@@ -1127,14 +1129,14 @@ class GBDTLearner:
                 maybe_w[0] if weighted else None, axis)
 
         if self.mesh is None:
-            return jax.jit(_fn)
+            return instrumented_jit(_fn, "gbdt.grad")
         data = (P(self.axis),) * (3 if weighted else 2)
-        return jax.jit(shard_map(
+        return instrumented_jit(shard_map(
             lambda *args: _fn(*args, axis=self.axis),
             mesh=self.mesh,
             in_specs=data,
             out_specs=(P(self.axis), P(self.axis), P()),
-        ))
+        ), "gbdt.grad")
 
     def _make_margin_update(self):
         lr = self.param.learning_rate
@@ -1143,12 +1145,12 @@ class GBDTLearner:
             return _margin_update_core(margin, leaf, node, lr)
 
         if self.mesh is None:
-            return jax.jit(_fn)
-        return jax.jit(shard_map(
+            return instrumented_jit(_fn, "gbdt.margin_update")
+        return instrumented_jit(shard_map(
             _fn, mesh=self.mesh,
             in_specs=(P(self.axis), P(), P(self.axis)),
             out_specs=P(self.axis),
-        ))
+        ), "gbdt.margin_update")
 
     # ---- predict -------------------------------------------------------
     def predict_margin(self, x: np.ndarray) -> np.ndarray:
@@ -1222,14 +1224,13 @@ class GBDTLearner:
             lr = p.learning_rate
             objective = p.objective
 
-            @jax.jit
             def eval_step(exb, eyd, feature, split_bin, leaf, vmargin):
                 vnode = _descend_tree(exb, feature, split_bin,
                                       p.max_depth, offsets)
                 vmargin = _margin_update_core(vmargin, leaf, vnode, lr)
                 return vmargin, jnp.mean(_loss(objective, vmargin, eyd))
 
-            self._eval_step = eval_step
+            self._eval_step = instrumented_jit(eval_step, "gbdt.eval_step")
         return self._eval_step
 
     def _set_eval_history(self, vlosses: np.ndarray) -> None:

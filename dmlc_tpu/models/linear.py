@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.utils.jax_compat import shard_map
 
+from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.ops.objectives import margin_loss_grad
 from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
 from dmlc_tpu.params.parameter import Parameter, field
@@ -253,7 +254,10 @@ def make_linear_train_step(
 
         # this path historically donated nothing — donation here is purely
         # opt-in (tests and notebooks legitimately reuse inputs)
-        fn = jax.jit(step, donate_argnums=(0, 1, 2) if donate_batch else ())
+        fn = instrumented_jit(
+            step, "linear.step",
+            donate_argnums=(0, 1, 2) if donate_batch else (),
+        )
         return _suppress_donation_warnings(fn) if donate_batch else fn
 
     # Mesh path: one shard_map; batch rows sharded, params replicated. The
@@ -291,8 +295,9 @@ def make_linear_train_step(
         in_specs=(P(), P(), batch_specs),
         out_specs=(P(), P(), P()),
     )
-    fn = jax.jit(
-        step, donate_argnums=(0, 1, 2) if donate_batch else (0, 1)
+    fn = instrumented_jit(
+        step, "linear.step",
+        donate_argnums=(0, 1, 2) if donate_batch else (0, 1),
     )
     return _suppress_donation_warnings(fn) if donate_batch else fn
 
@@ -341,13 +346,14 @@ def make_feature_sharded_train_step(
         }
         return new_params, {"loss_sum": loss_sum, "weight_sum": wsum}
 
-    step = jax.jit(
+    step = instrumented_jit(
         shard_map(
             _step,
             mesh=mesh,
             in_specs=({"w": P(mp), "b": P()}, P(dp, mp), P(dp), P(dp)),
             out_specs=({"w": P(mp), "b": P()}, P()),
         ),
+        "linear.step_mp",
         donate_argnums=(0,),
     )
     in_shardings = {

@@ -26,6 +26,10 @@ bottleneck diagnosis and auto-tuning):
   spans/metric deltas/resilience events dumped to
   ``flightrec-rank<k>.json`` on fatal error when ``DMLC_TPU_FLIGHTREC``
   names a directory (see obs/flight.py)
+- ``obs.device_telemetry`` — the device side: :func:`instrumented_jit`
+  recompile sentinel, HBM/live-buffer gauges, H2D bandwidth metering,
+  and on-demand ``jax.profiler`` capture through the status plane
+  (``DMLC_TPU_DEVICE_TELEMETRY``; see obs/device_telemetry.py)
 
 Metric names follow ``dmlc_<area>_<name>_<unit>`` and every registered
 name is documented in docs/observability.md (enforced by
@@ -33,6 +37,7 @@ name is documented in docs/observability.md (enforced by
 """
 
 from dmlc_tpu.obs.aggregate import cross_host_snapshot, report_skew
+from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.obs.exporters import (
     export_epoch,
     export_jsonl,
@@ -85,4 +90,5 @@ __all__ = [
     "summary_line",
     "cross_host_snapshot",
     "report_skew",
+    "instrumented_jit",
 ]

@@ -31,6 +31,12 @@ timelines — so:
   time per (stage, rank) and emits ``dmlc_job_stage_slack_ns{stage=}``
   plus ``dmlc_job_straggler_rank`` (heartbeat-lag stragglers win over
   span-slack ones; −1 = none).
+- **On-demand profiling** — ``GET /profile?seconds=N`` arms a job-wide
+  capture request on the plane; the tracker piggybacks the encoded
+  request on every heartbeat ack (a second int frame — the same passive
+  pattern as the elastic generation protocol) and each publishing
+  worker runs ``jax.profiler`` for the window, dropping the artifact
+  beside its flight-recorder dump (obs/device_telemetry.py).
 
 With ``DMLC_TPU_STATUS_PORT`` unset the tracker binds no socket, starts
 no thread, and holds the shared :data:`NOOP_PLANE`; with
@@ -57,6 +63,25 @@ from dmlc_tpu.params.knobs import obs_payload_max, obs_publish_enabled
 logger = logging.getLogger("dmlc_tpu.obs.plane")
 
 PAYLOAD_MARK = "\nOBS1 "  # heartbeat-line suffix carrying the JSON payload
+
+# /profile request encoding for the heartbeat-ack side channel: one i32,
+# (req_id << PROFILE_SHIFT) | seconds. 0 = never requested. Workers act
+# when the req_id part advances past the last one they served.
+PROFILE_SHIFT = 12
+PROFILE_MAX_S = (1 << PROFILE_SHIFT) - 1
+
+
+def encode_profile_word(req_id: int, seconds: int) -> int:
+    return (int(req_id) << PROFILE_SHIFT) | max(
+        0, min(int(seconds), PROFILE_MAX_S))
+
+
+def decode_profile_word(word: int) -> Tuple[int, int]:
+    """→ ``(req_id, seconds)``; any non-positive word decodes to (0, 0)."""
+    word = int(word)
+    if word <= 0:
+        return 0, 0
+    return word >> PROFILE_SHIFT, word & PROFILE_MAX_S
 
 
 # ---------------------------------------------------------------------------
@@ -136,14 +161,22 @@ class ObsPublisher:
         self._m_publishes = registry().counter(
             "dmlc_obs_publishes_total",
             "obs heartbeat payloads published to the tracker")
+        self._profile_seen = 0
         trace.add_listener(self._on_span)
 
     def _on_span(self, event: Dict) -> None:
         self._spans.append(event)
 
     def publish(self, epoch: int = -1, timeout: float = 10.0) -> bool:
+        from dmlc_tpu.obs import device_telemetry
         from dmlc_tpu.tracker.rendezvous import send_heartbeat
 
+        # refresh HBM / live-buffer gauges so every payload carries the
+        # current device picture (no-op when telemetry is off)
+        try:
+            device_telemetry.sample(self._reg)
+        except Exception:  # noqa: BLE001 - telemetry must not block publish
+            pass
         spans: List[Dict] = []
         while True:
             try:
@@ -156,16 +189,34 @@ class ObsPublisher:
         )
         t0 = time.monotonic_ns()
         try:
-            send_heartbeat(
+            _, profile_word = send_heartbeat(
                 self.tracker_uri, self.tracker_port, self.rank, epoch=epoch,
-                obs_json=blob, timeout=timeout,
+                obs_json=blob, timeout=timeout, want_profile=True,
             )
         except (OSError, ValueError) as err:
             logger.debug("obs publish failed: %s", err)
             return False
         self._rtt_ns = time.monotonic_ns() - t0
         self._m_publishes.inc()
+        self._maybe_capture(profile_word)
         return True
+
+    def _maybe_capture(self, profile_word: int) -> None:
+        """Serve a ``/profile`` request seen in the heartbeat ack: a req_id
+        past the last one served (with seconds > 0) starts one background
+        ``jax.profiler`` capture."""
+        req_id, seconds = decode_profile_word(profile_word)
+        if req_id <= self._profile_seen:
+            return
+        self._profile_seen = req_id
+        if seconds <= 0:
+            return
+        from dmlc_tpu.obs import device_telemetry
+
+        logger.info(
+            "profile request %d: capturing %ds (rank %d)",
+            req_id, seconds, self.rank)
+        device_telemetry.capture_profile(seconds, req_id=req_id)
 
     def close(self) -> None:
         trace.remove_listener(self._on_span)
@@ -253,15 +304,19 @@ class _WorkerView:
 
 def _split_flat(flat: str) -> Tuple[str, str]:
     """``name{a="b"}`` → ``("name", 'a="b"')``; histogram ``:sum`` /
-    ``:count`` scalars become Prometheus-legal ``_sum``/``_count``."""
+    ``:count`` scalars become Prometheus-legal ``_sum``/``_count``.
+    The suffix sits at the very end of the flat key — after the ``}`` of
+    a labeled family (``name{a="b"}:sum``), directly on the name of an
+    unlabeled one (``name:sum``) — so strip it first, then split."""
+    suffix = ""
+    for s in (":sum", ":count"):
+        if flat.endswith(s):
+            flat = flat[: -len(s)]
+            suffix = "_" + s[1:]
+            break
     name, _, rest = flat.partition("{")
     labels = rest[:-1] if rest.endswith("}") else ""
-    for suffix in (":sum", ":count"):
-        if name.endswith(suffix):
-            name = name[: -len(suffix)] + "_" + suffix[1:]
-        elif labels.endswith(suffix + "}"):
-            pass  # labels never carry the suffix; flat puts it after }
-    return name, labels
+    return name + suffix, labels
 
 
 class StatusPlane:
@@ -282,6 +337,10 @@ class StatusPlane:
         # elastic membership (PR 6): generation counter + transition log
         self.world_version = 0
         self._events: Deque[Dict] = collections.deque(maxlen=512)
+        # on-demand profiling: /profile?seconds=N bumps the request id;
+        # the encoded word rides every heartbeat ack until superseded
+        self._profile_req = 0
+        self._profile_seconds = 0
         self._g_world = registry().gauge(
             "dmlc_tracker_world_version",
             "current membership generation committed by the tracker")
@@ -338,6 +397,27 @@ class StatusPlane:
                 self.world_version = int(fields["world_version"])
         if "world_version" in fields:
             self._g_world.set(int(fields["world_version"]))
+
+    def request_profile(self, seconds: int) -> Dict:
+        """Arm a job-wide profiler capture request (the ``/profile``
+        endpoint). Every worker that heartbeats with ``want_profile``
+        sees the new request id in its ack and captures once."""
+        seconds = max(1, min(int(seconds), PROFILE_MAX_S))
+        with self._lock:
+            self._profile_req += 1
+            self._profile_seconds = seconds
+            req = self._profile_req
+        logger.info("profile capture requested: %ds (req %d)", seconds, req)
+        return {"profile_req": req, "seconds": seconds}
+
+    def profile_word(self) -> int:
+        """The current request encoded for the heartbeat-ack side channel
+        (0 = never requested)."""
+        with self._lock:
+            if not self._profile_req:
+                return 0
+            return encode_profile_word(self._profile_req,
+                                       self._profile_seconds)
 
     def membership(self) -> Dict:
         """``{"world_version": N, "events": [...]}`` — the elastic half of
@@ -500,6 +580,9 @@ class _NoopPlane:
     def note_membership(self, kind, **fields):
         pass
 
+    def profile_word(self):
+        return 0
+
 
 NOOP_PLANE = _NoopPlane()
 
@@ -529,6 +612,20 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 ctype = "text/plain; version=0.0.4"
             elif path == "/trace":
                 body = json.dumps(plane.merged_trace()).encode()
+                ctype = "application/json"
+            elif path == "/profile":
+                from urllib.parse import parse_qs
+
+                query = parse_qs(self.path.partition("?")[2])
+                try:
+                    seconds = int(query.get("seconds", ["5"])[0])
+                except ValueError:
+                    self.send_error(400, "seconds must be an integer")
+                    return
+                if seconds <= 0:
+                    self.send_error(400, "seconds must be > 0")
+                    return
+                body = json.dumps(plane.request_profile(seconds)).encode()
                 ctype = "application/json"
             else:
                 self.send_error(404, "unknown endpoint %r" % path)

@@ -17,7 +17,12 @@ metric:
   higher-is-better throughputs;
 - ``extra["pipelined_stall_stages"]`` keys ending ``_s`` are gated
   lower-is-better as ``stall.<key>`` (a stall stage growing is exactly
-  the regression shape flow tracing exists to localize).
+  the regression shape flow tracing exists to localize);
+- ``extra["device_telemetry"]`` (obs/device_telemetry.py) contributes
+  ``compiles.<fn>`` (per-function XLA compile counts) and
+  ``hbm.peak_bytes`` lower-is-better — a compile-count increase is a
+  recompile regression, an HBM peak increase is memory pressure — plus
+  ``h2d_mbps`` (mean H2D submission bandwidth) higher-is-better.
 
 Bench numbers are noisy (the recorded higgs history spans 468–678 MB/s
 across environments), so the baseline is robust: per metric, take the
@@ -52,6 +57,9 @@ DEFAULT_MIN_SAMPLES = 2
 
 _HIGHER_SUFFIXES = ("_mbps", "_gbps", "_mrows_s")
 _STALL_PREFIX = "stall."
+# lower-is-better key families: stall stages, XLA compile counts, and
+# peak HBM (device_telemetry section)
+_LOWER_PREFIXES = (_STALL_PREFIX, "compiles.", "hbm.")
 
 # canned record pair for the --smoke self-check: a miniature history in
 # the real artifact shape (values loosely after BENCH_r01..r05) plus a
@@ -182,6 +190,17 @@ def record_values(rec: Dict) -> Dict[str, float]:
         for key, v in stalls.items():
             if _is_number(v) and key.endswith("_s"):
                 vals[_STALL_PREFIX + key] = float(v)
+    devtel = extra.get("device_telemetry")
+    if isinstance(devtel, dict):
+        compiles = devtel.get("compiles")
+        if isinstance(compiles, dict):
+            for fn, v in compiles.items():
+                if _is_number(v):
+                    vals["compiles." + str(fn)] = float(v)
+        if _is_number(devtel.get("peak_hbm_bytes")):
+            vals["hbm.peak_bytes"] = float(devtel["peak_hbm_bytes"])
+        if _is_number(devtel.get("h2d_mbps")):
+            vals["h2d_mbps"] = float(devtel["h2d_mbps"])
     return vals
 
 
@@ -195,7 +214,7 @@ def metric_series(records: Sequence[Dict]) -> Dict[str, List[float]]:
 
 
 def lower_is_better(key: str) -> bool:
-    return key.startswith(_STALL_PREFIX)
+    return key.startswith(_LOWER_PREFIXES)
 
 
 def gate(

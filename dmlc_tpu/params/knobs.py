@@ -82,6 +82,17 @@ docs/robustness.md "Elastic membership") adds four more:
   handshake and blocks until a transition activates the spare (or
   exits 0 if the job finishes without needing it)
 
+Device telemetry (obs/device_telemetry.py, see docs/observability.md
+"Device telemetry") adds two more:
+
+- ``DMLC_TPU_DEVICE_TELEMETRY`` — the recompile sentinel, H2D meter, and
+  HBM gauges (default on; 0 makes ``instrumented_jit`` return the plain
+  ``jax.jit`` callable and ``h2d_meter`` return None — the disabled hot
+  path is byte-for-byte the uninstrumented one)
+- ``DMLC_TPU_HBM_POLL_S`` — period in seconds for the background HBM
+  sampler thread (0 = no thread, the default; sampling still happens at
+  payload-publish and bench boundaries)
+
 ``KNOWN_KNOBS`` below is the authoritative list of every
 ``DMLC_TPU_*`` variable the tree reads; ``scripts/check_faultpoints.py``
 fails CI when a knob is referenced anywhere without being registered
@@ -238,6 +249,20 @@ def evict_after_s() -> float:
     return max(0.0, float(get_env("DMLC_TPU_EVICT_AFTER_S", 0.0)))
 
 
+def device_telemetry_enabled() -> bool:
+    """Whether the device telemetry layer is live
+    (``DMLC_TPU_DEVICE_TELEMETRY``, default on). Read once where each
+    surface is built (jit wrap time, feed construction), never on the
+    per-dispatch path."""
+    return get_env("DMLC_TPU_DEVICE_TELEMETRY", True)
+
+
+def hbm_poll_s() -> float:
+    """Background HBM sampler period in seconds (``DMLC_TPU_HBM_POLL_S``;
+    0 = no poller thread, the default)."""
+    return max(0.0, float(get_env("DMLC_TPU_HBM_POLL_S", 0.0)))
+
+
 def is_spare() -> bool:
     """Whether this process was launched as a warm spare
     (``DMLC_TPU_SPARE``, set by the launcher's ``--spares`` tasks).
@@ -276,6 +301,9 @@ KNOWN_KNOBS = (
     "DMLC_TPU_OBS_PAYLOAD_MAX",
     "DMLC_TPU_FLIGHTREC",
     "DMLC_TPU_FLIGHTREC_CAP",
+    # device telemetry
+    "DMLC_TPU_DEVICE_TELEMETRY",
+    "DMLC_TPU_HBM_POLL_S",
     # collective / distributed bootstrap
     "DMLC_TPU_RECOVER_TIMEOUT",
     "DMLC_TPU_RING_THRESHOLD_BYTES",

@@ -10,7 +10,11 @@ Renders a human-readable summary of a job's observability artifacts:
   download, or any Chrome-trace JSON): per-stage time by rank and the
   cross-rank slack table, widest stage first — the critical-path view.
 - ``--status HOST:PORT`` — fetch ``/workers`` and ``/trace`` from a
-  *live* tracker status server instead of files.
+  *live* tracker status server instead of files; also renders the device
+  telemetry section (per-rank XLA compiles / recompile anomalies, device
+  memory, H2D bandwidth — obs/device_telemetry.py) from ``/metrics``.
+- ``--top`` — with ``--status``: render the same per-rank table the live
+  ``obs-top`` tool shows, once (the non-live fallback).
 - ``--diff A B`` — compare two traces (e.g. the last good run's
   ``/trace`` download vs the regressed run's): per-stage total time
   delta, biggest eater first — "which stage ate the regression", the
@@ -174,6 +178,61 @@ def _fetch(status: str, endpoint: str) -> Optional[Dict]:
         return None
 
 
+def _fetch_metrics_text(status: str) -> Optional[str]:
+    from urllib.request import urlopen
+
+    url = f"http://{status}/metrics"
+    try:
+        with urlopen(url, timeout=10) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except OSError as err:
+        print(f"obs-report: fetching {url} failed: {err}", file=sys.stderr)
+        return None
+
+
+def _report_device(metrics_text: str) -> bool:
+    """Device telemetry section from the merged ``/metrics`` text:
+    per-rank compile totals (with the per-fn breakdown), recompile
+    anomalies, device memory, and H2D transfer totals."""
+    from dmlc_tpu.tools.obs_top import parse_metrics
+
+    samples = parse_metrics(metrics_text)
+    per_rank: Dict[int, Dict] = {}
+    fn_compiles: Dict[str, float] = {}
+    for name, labels, value in samples:
+        if "rank" not in labels:
+            continue
+        try:
+            rank = int(labels["rank"])
+        except ValueError:
+            continue
+        row = per_rank.setdefault(rank, {
+            "compiles": 0.0, "recompiles": 0.0, "hbm": 0.0, "h2d_mb": 0.0})
+        if name == "dmlc_xla_compiles_total":
+            row["compiles"] += value
+            fn = labels.get("fn", "?")
+            fn_compiles[fn] = fn_compiles.get(fn, 0.0) + value
+        elif name == "dmlc_xla_recompiles_total":
+            row["recompiles"] += value
+        elif name in ("dmlc_device_hbm_bytes", "dmlc_device_live_bytes"):
+            row["hbm"] = max(row["hbm"], value)
+        elif name == "dmlc_feed_h2d_bytes_total":
+            row["h2d_mb"] += value / 1e6
+    if not per_rank:
+        return False
+    print("== device telemetry ==")
+    print(f"{'rank':>4} {'compiles':>8} {'recomp':>6} {'mem_MB':>8} "
+          f"{'h2d_MB':>9}")
+    for rank, row in sorted(per_rank.items()):
+        print(f"{rank:>4} {int(row['compiles']):>8d} "
+              f"{int(row['recompiles']):>6d} {row['hbm'] / 1e6:>8.1f} "
+              f"{row['h2d_mb']:>9.1f}")
+    if fn_compiles:
+        print("  compiles by fn: " + " ".join(
+            f"{fn}={int(v)}" for fn, v in sorted(fn_compiles.items())))
+    return True
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="obs-report", description="Render a post-run job report from "
@@ -189,7 +248,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=None,
                         help="Two trace files: print the per-stage "
                         "critical-path delta table (B relative to A).")
+    parser.add_argument("--top", action="store_true",
+                        help="With --status: render the obs-top per-rank "
+                        "table once (non-live fallback).")
     args = parser.parse_args(argv)
+    if args.top and not args.status:
+        print("obs-report: --top needs --status", file=sys.stderr)
+        return 2
     reported = False
     if args.diff:
         reported = _report_diff(args.diff[0], args.diff[1])
@@ -198,6 +263,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         if workers is not None:
             _report_workers(workers)
             reported = True
+        metrics_text = _fetch_metrics_text(args.status)
+        if metrics_text is not None:
+            reported = _report_device(metrics_text) or reported
+            if args.top:
+                from dmlc_tpu.tools.obs_top import build_rows, render_table
+
+                rows, _ = build_rows(metrics_text, workers)
+                wv = (workers or {}).get("world_version")
+                print("== obs-top (one frame) ==")
+                print(render_table(rows, world_version=wv))
+                reported = True
         trace_obj = _fetch(args.status, "/trace")
         if trace_obj is not None:
             reported = _report_trace(trace_obj) or reported

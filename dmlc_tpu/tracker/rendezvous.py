@@ -638,6 +638,11 @@ class RabitTracker:
                     # checkpoint boundary (pre-elastic workers ignored
                     # the ack value, so the wire stays compatible).
                     worker.conn.send_int(self._target_version)
+                    # second ack frame: the encoded /profile request (0 =
+                    # none). Workers that don't opt in never read it —
+                    # the bytes die with the one-shot connection, so the
+                    # wire stays compatible both directions.
+                    worker.conn.send_int(self.plane.profile_word())
                     self._note_heartbeat(worker.rank, payload)
                 except (ConnectionError, OSError) as err:
                     logger.warning("heartbeat from %s failed: %s",
@@ -847,7 +852,8 @@ def send_heartbeat(
     metrics: str = "",
     timeout: float = 10.0,
     obs_json: Optional[str] = None,
-) -> int:
+    want_profile: bool = False,
+):
     """Worker-side heartbeat: one short-lived connection carrying the
     standard handshake with cmd="heartbeat" plus a free-form payload line
     (``epoch=N <metrics>`` — e.g. ``obs.summary_line()``). Waits for the
@@ -862,7 +868,14 @@ def send_heartbeat(
 
     ``obs_json`` (built by ``obs.plane.build_payload``) rides the same
     string frame behind the ``OBS1`` marker — still one line of opaque
-    text to a tracker that does not know the extension."""
+    text to a tracker that does not know the extension.
+
+    ``want_profile=True`` (the obs publisher) additionally reads the
+    tracker's second ack frame — the encoded ``/profile`` request word —
+    and returns ``(ack, profile_word)``. A tracker predating the frame
+    just closes the connection and the word reads as 0, so opting in is
+    safe against any tracker. The default leaves the frame unread
+    (compatible with the original single-int contract)."""
     sock = socket.create_connection((tracker_uri, tracker_port),
                                     timeout=timeout)
     conn = FramedSocket(sock)
@@ -883,7 +896,14 @@ def send_heartbeat(
 
             payload += PAYLOAD_MARK + obs_json
         conn.send_str(payload)
-        return conn.recv_int()  # ack: the tracker's target world_version
+        ack = conn.recv_int()  # ack: the tracker's target world_version
+        if not want_profile:
+            return ack
+        try:
+            profile_word = conn.recv_int()
+        except (ConnectionError, OSError, struct.error):
+            profile_word = 0  # pre-profile tracker: no second frame
+        return ack, profile_word
     finally:
         conn.close()
 

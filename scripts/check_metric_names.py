@@ -23,7 +23,8 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 DOC = ROOT / "docs" / "observability.md"
 
-UNITS = {"total", "ns", "bytes", "rows", "value", "count", "rank", "version"}
+UNITS = {"total", "ns", "bytes", "rows", "value", "count", "rank", "version",
+         "mbps"}
 
 # ".counter(" / ".gauge(" / ".histogram(" followed by a string literal —
 # matches across the line break of a wrapped call
@@ -33,7 +34,8 @@ CALL_RE = re.compile(
 # require a unit suffix so prose mentions of e.g. `dmlc_tpu.obs` don't
 # read as metric names
 DOC_NAME_RE = re.compile(
-    r"`(dmlc_[a-z0-9_]+_(?:total|ns|bytes|rows|value|count|rank|version))"
+    r"`(dmlc_[a-z0-9_]+_"
+    r"(?:total|ns|bytes|rows|value|count|rank|version|mbps))"
 )
 
 

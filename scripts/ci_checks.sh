@@ -8,4 +8,37 @@ cd "$(dirname "$0")/.."
 python scripts/check_metric_names.py
 python scripts/check_faultpoints.py
 python -m dmlc_tpu.tools bench-gate --smoke
+
+# obs-top --once smoke against a local StatusServer fixture: exercises
+# the /metrics + /workers endpoint contract and the CLI's table path
+# end to end (device telemetry metric names included).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import json, sys, time
+
+from dmlc_tpu.obs import plane
+from dmlc_tpu.obs.metrics import Registry
+from dmlc_tpu.tools import obs_top
+
+reg = Registry()
+reg.counter("dmlc_xla_compiles_total", "", fn="linear.step").inc(2)
+reg.counter("dmlc_feed_h2d_bytes_total", "", feed="f0").inc(1 << 20)
+reg.histogram("dmlc_feed_h2d_mbps", "", feed="f0").observe(512.0)
+reg.gauge("dmlc_device_live_bytes", "", device="cpu:0").set(1 << 22)
+reg.histogram("dmlc_feed_consume_ns", "", feed="f0").observe(2e6)
+
+sp = plane.StatusPlane(num_workers=1)
+blob, _ = plane.build_payload(rank=0, epoch=1, reg=reg)
+sp.note_live(0, time.time(), "epoch=1")
+sp.note_payload(0, json.loads(blob), time.time_ns())
+srv = plane.StatusServer(sp, port=0)
+srv.start()
+try:
+    rc = obs_top.main(["--once", "--status", "127.0.0.1:%d" % srv.port])
+finally:
+    srv.close()
+if rc != 0:
+    sys.exit("ci_checks: obs-top --once smoke failed (rc=%d)" % rc)
+print("ci_checks: obs-top smoke OK")
+EOF
+
 echo "ci_checks: all checks passed"
