@@ -26,8 +26,9 @@ from dmlc_tpu.data.row_iter import (
     DiskRowIter,
     create_row_block_iter,
 )
+from dmlc_tpu.data.dispatcher import DataDispatcher, DispatcherClient
 from dmlc_tpu.data.service import (BlockService, RemoteBlockParser,
-                                   reshard_split)
+                                   TruncatedFrame, reshard_split)
 from dmlc_tpu.data.rowrec import (
     RecordIORowParser,
     convert_to_recordio,
@@ -60,5 +61,8 @@ __all__ = [
     "write_recordio_rows",
     "BlockService",
     "RemoteBlockParser",
+    "TruncatedFrame",
+    "DataDispatcher",
+    "DispatcherClient",
     "reshard_split",
 ]

@@ -1,0 +1,645 @@
+"""Lease-based chunk dispatch: the fault-tolerant half of the data service.
+
+``data/service.py`` serves parsed RowBlocks; this module owns *who parses
+what* when there is a fleet of data workers instead of one. The tf.data
+service paper (arXiv:2210.14826 — PAPERS.md) frames the hard requirement:
+first-come-first-served sharding is easy, but a *visitation guarantee*
+under input-worker failure is what multi-epoch training actually needs.
+The :class:`DataDispatcher` provides it:
+
+- The dataset is split into ``nchunks`` deterministic chunk descriptors
+  ``(seq, uri, part, nparts)`` — InputSplit parts, so ANY worker can
+  parse a reassigned chunk (chunks are never bound to a worker's
+  memory). ``seq`` is the monotonic sequence id the exactly-once
+  accounting keys on.
+- :class:`~dmlc_tpu.data.service.BlockService` data workers register and
+  heartbeat; each ``lease`` hands the lowest-seq queued chunk to one
+  worker with a deadline. A worker that dies (heartbeat silence >
+  ``DMLC_TPU_DATA_DEAD_S``) or overruns its lease
+  (``DMLC_TPU_DATA_LEASE_S``) gets its chunks requeued — deterministic
+  reassignment to whichever surviving worker leases next.
+- Consumers report receipt (``recv``) when a chunk's frame lands and
+  ``ack`` once the chunk is consumed. The chunk state machine is
+  ``queued → leased → delivered → acked``; only the dispatcher decides
+  who wins when a requeue races a late delivery, so every chunk is
+  consumed exactly once per epoch (a duplicate delivery is *rejected*
+  and the consumer drops it).
+- Each chunk gets one obs flow id, minted at first lease and carried
+  through every (re)assignment — a requeued chunk's Perfetto arrow chain
+  shows both workers that touched it.
+
+Lease deadlines trade exactly-once bookkeeping for liveness under false
+suspicion: a worker that is merely slow past its lease gets its chunk
+requeued, and the late delivery is then rejected — the chunk is still
+consumed once, but the slow worker's parse work is wasted. Size
+``DMLC_TPU_DATA_LEASE_S`` well above one chunk's parse+serve time.
+DELIVERED chunks are different: the consumer already HOLDS the rows, so
+redelivering them would duplicate data, not waste work. A delivered
+chunk therefore requeues only once its holder's dispatcher connection
+is gone (a crashed consumer drops its TCP session; a slow-but-live one
+— a jit compile can take minutes — keeps it open and keeps the chunk),
+and the consumer side additionally drops any sequence id it has already
+received (``RemoteBlockParser`` tracks its seen set), closing the
+reconnect race.
+
+Transport is a tiny framed protocol (u32 length + JSON object per
+message) over one persistent TCP connection per peer;
+:class:`DispatcherClient` is the shared RPC shim (workers and failover
+consumers both use it) with transparent reconnect under the resilience
+``RetryPolicy``. The default chunk count when a caller passes none comes
+from ``DMLC_TPU_DATA_CHUNKS``.
+
+The live worker/lease/requeue view is exported two ways: ``snapshot()``
+(the ``/data`` status-plane endpoint — see ``attach_plane``) and the
+``dmlc_dispatch_*`` metrics; requeues and worker deaths are also flight-
+recorder events (``service.requeue`` / ``service.worker_dead``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+from dmlc_tpu import obs
+from dmlc_tpu.obs.flight import record_event
+from dmlc_tpu.params.knobs import (
+    data_chunks,
+    data_dead_after_s,
+    data_lease_s,
+)
+from dmlc_tpu.utils.logging import check, log_warning
+
+# one framed message: u32 little-endian byte length + a JSON object.
+# Length cap so a stray connection speaking another protocol cannot make
+# the dispatcher allocate gigabytes off four garbage bytes.
+_MAX_MSG = 1 << 20
+
+_QUEUED = "queued"
+_LEASED = "leased"
+_DELIVERED = "delivered"
+_ACKED = "acked"
+
+# rows the lease table ships to /data (full accounting stays in the
+# counters; the table is a human debugging view)
+_SNAPSHOT_ROWS = 512
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise OSError("dispatcher connection closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def _send_msg(sock: socket.socket, obj: Dict) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Dict:
+    (nbytes,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if nbytes > _MAX_MSG:
+        raise ValueError("dispatcher frame too large: %d bytes" % nbytes)
+    obj = json.loads(_recv_exact(sock, nbytes).decode())
+    if not isinstance(obj, dict):
+        raise ValueError("dispatcher frame is not an object")
+    return obj
+
+
+class DispatcherClient:
+    """Framed-JSON RPC shim onto a :class:`DataDispatcher`.
+
+    One persistent connection, one in-flight request at a time (the
+    internal lock serializes callers — a feed's producer thread and its
+    consumer's ack path share one client safely). A dead connection is
+    re-dialed transparently under the shared ``RetryPolicy``; the caller
+    sees either a reply dict or a ``DMLCError`` give-up."""
+
+    def __init__(self, address: Tuple[str, int], timeout: float = 30.0):
+        self.address = (str(address[0]), int(address[1]))
+        self._timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _ensure_locked(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.create_connection(
+                self.address, timeout=self._timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+        return self._sock
+
+    def _drop_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, obj: Dict, site: str = "service.dispatch") -> Dict:
+        from dmlc_tpu.resilience import RetryPolicy
+
+        def attempt() -> Dict:
+            with self._lock:
+                try:
+                    sock = self._ensure_locked()
+                    _send_msg(sock, obj)
+                    return _recv_msg(sock)
+                except ValueError as err:
+                    # garbled frame: reconnect and retry like a dead socket
+                    self._drop_locked()
+                    raise OSError(str(err)) from err
+                except OSError:
+                    self._drop_locked()
+                    raise
+
+        return RetryPolicy(max_attempts=5, base_s=0.05, cap_s=0.5).call(
+            attempt, site,
+            display="data dispatcher %s:%d" % self.address)
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_locked()
+
+
+class DataDispatcher:
+    """Registry of data workers + the lease table for one epoch's chunks.
+
+    ``uri`` is the dataset every worker can reach; it is split into
+    ``nchunks`` InputSplit parts served as one response frame each.
+    ``lease_s``/``dead_after_s`` default through the
+    ``DMLC_TPU_DATA_LEASE_S``/``DMLC_TPU_DATA_DEAD_S`` knobs. Expiry is
+    scanned on every RPC (workers poll ``lease`` while idle, so a
+    dispatcher with any live worker needs no timer thread).
+
+    Like the service it coordinates, a dispatcher is ONE epoch's pass:
+    re-create it per epoch, exactly like ``create_parser``."""
+
+    def __init__(
+        self,
+        uri: str,
+        nchunks: Optional[int] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        lease_s: Optional[float] = None,
+        dead_after_s: Optional[float] = None,
+        data_format: str = "auto",
+        plane=None,
+    ):
+        nchunks = data_chunks(nchunks)
+        check(nchunks >= 1, "nchunks must be >= 1, got %d", nchunks)
+        self.uri = str(uri)
+        self.lease_s = data_lease_s(lease_s)
+        self.dead_after_s = data_dead_after_s(dead_after_s)
+        self._lock = threading.Lock()
+        self._chunks: List[Dict] = [
+            {
+                "seq": k,
+                "uri": self.uri,
+                "part": k,
+                "nparts": nchunks,
+                "format": data_format,
+                "state": _QUEUED,
+                "worker": -1,
+                "client": -1,
+                "deadline": 0.0,
+                "requeues": 0,
+                "flow": 0,
+            }
+            for k in range(nchunks)
+        ]
+        self._workers: Dict[int, Dict] = {}
+        self._next_worker = 0
+        self._next_client = 0
+        # client id -> ids of live dispatcher connections that spoke for
+        # it. A DELIVERED chunk requeues only when its holder has NO live
+        # connection: consumer death is a dropped session, consumer
+        # slowness is not — redelivering rows a live consumer already
+        # holds would break exactly-once.
+        self._client_conns: Dict[int, set] = {}
+        # plain-int accounting (truthful under DMLC_TPU_METRICS=0; the
+        # registry carries the telemetry mirror)
+        self._requeued = 0
+        self._acked = 0
+        self._rejects = 0
+        self._dup_acks = 0
+        self._all_acked = threading.Event()
+        reg = obs.registry()
+        self._m_chunks = reg.counter(
+            "dmlc_dispatch_chunks_total",
+            "chunks registered for lease-based dispatch")
+        self._m_chunks.inc(nchunks)
+        self._m_requeued = reg.counter(
+            "dmlc_dispatch_requeued_total",
+            "chunk leases requeued after expiry or worker death")
+        self._m_acked = reg.counter(
+            "dmlc_dispatch_acked_total",
+            "chunks acked by consumers (the exactly-once frontier)")
+        self._m_rejects = reg.counter(
+            "dmlc_dispatch_rejects_total",
+            "duplicate chunk deliveries refused by the lease table")
+        self._g_workers = reg.gauge(
+            "dmlc_dispatch_workers_count", "live registered data workers")
+        self._g_workers.set(0)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._conns: List[socket.socket] = []
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="data-dispatcher")
+        self._accept_thread.start()
+        if plane is not None:
+            self.attach_plane(plane)
+
+    # ---- transport ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            # prune finished handler threads: a fault storm reconnects
+            # DispatcherClients many times per epoch, and the list must
+            # not grow one dead entry per reconnect
+            self._threads = [
+                th for th in self._threads if th.is_alive()] + [t]
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from dmlc_tpu.resilience import InjectedFault
+
+        self._conns.append(conn)
+        bound: set = set()  # client ids this connection spoke for
+        try:
+            while True:
+                try:
+                    obj = _recv_msg(conn)
+                except (OSError, ValueError):
+                    return  # peer gone / garbled — drop the connection
+                try:
+                    reply = self._handle(obj)
+                except InjectedFault:
+                    # service.lease fault: kill the connection, exactly
+                    # like a dispatcher transport failure — the peer's
+                    # DispatcherClient reconnects and retries
+                    return
+                except Exception as err:  # noqa: BLE001 — relay, don't die
+                    reply = {"ok": False,
+                             "error": "%s: %s" % (type(err).__name__, err)}
+                # liveness binding: any op that names a client id ties
+                # that client to this connection for delivered-chunk
+                # requeue gating (see _expire_locked)
+                try:
+                    cid = int(reply.get("client_id", obj.get("client", -1)))
+                except (TypeError, ValueError):
+                    cid = -1
+                if cid >= 0 and cid not in bound:
+                    bound.add(cid)
+                    with self._lock:
+                        self._client_conns.setdefault(cid, set()).add(
+                            id(conn))
+                try:
+                    _send_msg(conn, reply)
+                except OSError:
+                    return
+        finally:
+            with self._lock:
+                for cid in bound:
+                    conns = self._client_conns.get(cid)
+                    if conns is not None:
+                        conns.discard(id(conn))
+                        if not conns:
+                            del self._client_conns[cid]
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---- the chunk state machine ---------------------------------------
+
+    def _handle(self, obj: Dict) -> Dict:
+        op = obj.get("op")
+        if op == "register":
+            return self._op_register(obj)
+        if op == "client":
+            with self._lock:
+                cid = self._next_client
+                self._next_client += 1
+            return {"ok": True, "client_id": cid}
+        if op == "heartbeat":
+            with self._lock:
+                w = self._workers.get(int(obj.get("worker", -1)))
+                if w is not None and not w["dead"]:
+                    w["last_seen"] = time.monotonic()
+                self._expire_locked()
+            return {"ok": True}
+        if op == "lease":
+            return self._op_lease(obj)
+        if op == "recv":
+            return self._op_recv(obj)
+        if op == "ack":
+            return self._op_ack(obj)
+        if op == "workers":
+            with self._lock:
+                self._expire_locked()
+                live = [
+                    [w["addr"][0], w["addr"][1], wid]
+                    for wid, w in sorted(self._workers.items())
+                    if not w["dead"]
+                ]
+            return {"ok": True, "workers": live}
+        if op == "stats":
+            return dict(self.snapshot(), ok=True)
+        return {"ok": False, "error": "unknown op %r" % (op,)}
+
+    def _op_register(self, obj: Dict) -> Dict:
+        raw = obj.get("addr") or ("", 0)
+        addr = (str(raw[0]), int(raw[1]))
+        with self._lock:
+            # idempotent by serving address: register rides the retrying
+            # DispatcherClient, so a lost reply re-sends it — minting a
+            # fresh id each time would leave an orphan that never
+            # heartbeats, later firing a spurious worker_dead and
+            # skewing the workers gauge. Only one live worker can hold a
+            # host:port, so the live match IS the earlier registration.
+            wid = next(
+                (known for known, w in self._workers.items()
+                 if w["addr"] == addr and not w["dead"] and addr[1]),
+                None)
+            if wid is not None:
+                self._workers[wid]["last_seen"] = time.monotonic()
+            else:
+                wid = self._next_worker
+                self._next_worker += 1
+                self._workers[wid] = {
+                    "addr": addr,
+                    "last_seen": time.monotonic(),
+                    "dead": False,
+                }
+            self._expire_locked()
+        return {
+            "ok": True,
+            "worker_id": wid,
+            # workers heartbeat a few times per death threshold so one
+            # lost beat never reads as a crash
+            "heartbeat_s": max(0.05, self.dead_after_s / 3.0),
+        }
+
+    def _op_lease(self, obj: Dict) -> Dict:
+        from dmlc_tpu.resilience import faultpoint
+
+        faultpoint("service.lease")
+        wid = int(obj.get("worker", -1))
+        with self._lock:
+            now = time.monotonic()
+            w = self._workers.get(wid)
+            if w is not None:
+                if w["dead"]:
+                    # declared dead: its registration is gone; a zombie
+                    # must not take leases the table thinks are safe
+                    return {"ok": False, "dead": True}
+                w["last_seen"] = now
+            self._expire_locked()
+            chunk = next(
+                (c for c in self._chunks if c["state"] == _QUEUED), None)
+            if chunk is None:
+                # EOF once every chunk is delivered-or-acked: an
+                # explicit-ack consumer (DeviceFeed) may hold received
+                # rows across many batches before acking, and gating EOF
+                # on acks would deadlock it against its own worker. The
+                # expiry scan above ran first, so every delivered chunk
+                # here is either within its deadline or held by a
+                # consumer whose session is still alive; join() still
+                # waits for the full ack frontier.
+                if all(c["state"] in (_ACKED, _DELIVERED)
+                       for c in self._chunks):
+                    return {"ok": True, "eof": True}
+                # leased chunks may still requeue; the worker polls
+                # (each poll doubles as a heartbeat + expiry scan)
+                return {"ok": True, "wait": True}
+            if not chunk["flow"]:
+                # one flow per chunk, minted at FIRST lease and carried
+                # through every reassignment — the merged trace's arrow
+                # chain then shows every worker that touched the chunk
+                chunk["flow"] = obs.new_flow()
+                obs.flow_start(chunk["flow"], "chunk")
+            chunk["state"] = _LEASED
+            chunk["worker"] = wid
+            chunk["client"] = -1
+            chunk["deadline"] = now + self.lease_s
+            return {
+                "ok": True,
+                "chunk": {
+                    "seq": chunk["seq"],
+                    "uri": chunk["uri"],
+                    "part": chunk["part"],
+                    "nparts": chunk["nparts"],
+                    "format": chunk["format"],
+                    "flow": chunk["flow"],
+                },
+            }
+
+    def _chunk_locked(self, seq: int) -> Optional[Dict]:
+        if 0 <= seq < len(self._chunks):
+            return self._chunks[seq]
+        return None
+
+    def _op_recv(self, obj: Dict) -> Dict:
+        cid = int(obj.get("client", -1))
+        seq = int(obj.get("seq", -1))
+        with self._lock:
+            self._expire_locked()
+            c = self._chunk_locked(seq)
+            if c is None:
+                return {"ok": False, "reject": True,
+                        "error": "unknown seq %d" % seq}
+            if c["state"] in (_LEASED, _QUEUED):
+                # a requeued-but-not-relesed chunk whose original send
+                # did land is reclaimed here: the bytes arrived, so this
+                # delivery wins and the requeue is undone
+                c["state"] = _DELIVERED
+                c["client"] = cid
+                c["deadline"] = time.monotonic() + self.lease_s
+                return {"ok": True}
+            if c["state"] == _DELIVERED and c["client"] == cid:
+                return {"ok": True}  # same consumer re-reporting (hedge)
+            # delivered to someone else or already acked: the reporter
+            # must DROP this copy — that is the exactly-once guarantee
+            self._rejects += 1
+            self._m_rejects.inc()
+            return {"ok": True, "reject": True}
+
+    def _op_ack(self, obj: Dict) -> Dict:
+        seq = int(obj.get("seq", -1))
+        with self._lock:
+            self._expire_locked()
+            c = self._chunk_locked(seq)
+            if c is None:
+                return {"ok": False, "error": "unknown seq %d" % seq}
+            if c["state"] == _ACKED:
+                self._dup_acks += 1
+                return {"ok": True, "dup": True}
+            # an ack is authoritative from ANY state: the consumer holds
+            # the rows, so even a chunk the expiry scan already requeued
+            # is done — acking it here is what stops a second serve
+            c["state"] = _ACKED
+            c["worker"] = -1
+            c["deadline"] = 0.0
+            self._acked += 1
+            self._m_acked.inc()
+            if c["flow"]:
+                obs.flow_step(c["flow"], "chunk")
+            if all(ch["state"] == _ACKED for ch in self._chunks):
+                self._all_acked.set()
+            return {"ok": True}
+
+    def _expire_locked(self) -> None:
+        now = time.monotonic()
+        for wid, w in self._workers.items():
+            if not w["dead"] and now - w["last_seen"] > self.dead_after_s:
+                w["dead"] = True
+                record_event("service.worker_dead", worker=wid,
+                             addr="%s:%d" % w["addr"])
+                log_warning(
+                    "data worker %d (%s:%d) declared dead (%.1fs silent)",
+                    wid, w["addr"][0], w["addr"][1], now - w["last_seen"])
+        for c in self._chunks:
+            if c["state"] == _LEASED:
+                w = self._workers.get(c["worker"])
+                expired = (now > c["deadline"] or w is None or w["dead"])
+            elif c["state"] == _DELIVERED:
+                # the holder already HAS the rows — requeueing while it
+                # is alive would serve them twice. Its dispatcher session
+                # is the liveness signal: a crashed consumer drops the
+                # TCP connection; a slow one (jit compiles take minutes)
+                # keeps it open and keeps the chunk, however long past
+                # the deadline. The deadline still applies once the
+                # holder is gone.
+                expired = (now > c["deadline"]
+                           and c["client"] not in self._client_conns)
+            else:
+                continue
+            if not expired:
+                continue
+            record_event("service.requeue", seq=c["seq"], state=c["state"],
+                         worker=c["worker"], client=c["client"],
+                         requeues=c["requeues"] + 1)
+            c["state"] = _QUEUED
+            c["worker"] = -1
+            c["client"] = -1
+            c["deadline"] = 0.0
+            c["requeues"] += 1
+            self._requeued += 1
+            self._m_requeued.inc()
+        self._g_workers.set(
+            len([w for w in self._workers.values() if not w["dead"]]))
+
+    # ---- read side ------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """The live worker/lease/requeue view (the ``/data`` endpoint
+        body). Exactly-once invariant at end of epoch:
+        ``chunks.acked == chunks.total`` with ``queued == leased ==
+        delivered == 0`` and every requeue drained."""
+        with self._lock:
+            self._expire_locked()
+            now = time.monotonic()
+            counts = {_QUEUED: 0, _LEASED: 0, _DELIVERED: 0, _ACKED: 0}
+            table = []
+            for c in self._chunks:
+                counts[c["state"]] += 1
+                if len(table) < _SNAPSHOT_ROWS:
+                    table.append({
+                        "seq": c["seq"],
+                        "state": c["state"],
+                        "worker": c["worker"],
+                        "client": c["client"],
+                        "requeues": c["requeues"],
+                    })
+            workers = {
+                str(wid): {
+                    "addr": "%s:%d" % w["addr"],
+                    "live": not w["dead"],
+                    "lag_s": round(now - w["last_seen"], 3),
+                    "leased": len([
+                        c for c in self._chunks
+                        if c["state"] == _LEASED and c["worker"] == wid
+                    ]),
+                }
+                for wid, w in sorted(self._workers.items())
+            }
+        return {
+            "chunks": {
+                "total": len(self._chunks),
+                "queued": counts[_QUEUED],
+                "leased": counts[_LEASED],
+                "delivered": counts[_DELIVERED],
+                "acked": counts[_ACKED],
+            },
+            "requeued": self._requeued,
+            "rejects": self._rejects,
+            "duplicate_acks": self._dup_acks,
+            "workers": workers,
+            "lease_table": table,
+        }
+
+    def attach_plane(self, plane) -> None:
+        """Expose :meth:`snapshot` as the status plane's ``/data``
+        endpoint (``StatusPlane.set_data_provider``)."""
+        plane.set_data_provider(self.snapshot)
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Block until every chunk is acked (the epoch is complete);
+        True on completion, False on timeout."""
+        return self._all_acked.wait(timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def dispatcher_address(spec: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
+    """Normalize a ``host:port`` string or ``(host, port)`` pair — the
+    accepted ``dispatcher=`` argument shapes of BlockService and
+    RemoteBlockParser."""
+    if isinstance(spec, str):
+        host, _, port = spec.rpartition(":")
+        check(bool(host) and port.isdigit(),
+              "bad dispatcher address %r (want host:port)", spec)
+        return host, int(port)
+    return str(spec[0]), int(spec[1])

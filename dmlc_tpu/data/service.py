@@ -19,10 +19,25 @@ framework's own primitives:
   ``DeviceFeed(RemoteBlockParser(addr), spec)`` and every learner compose
   unchanged.
 
+Both ends also speak **dispatcher mode** (data/dispatcher.py), the
+fault-tolerant fleet shape: ``BlockService(dispatcher=addr)`` turns the
+service into a registered data *worker* that leases chunks from a
+:class:`~dmlc_tpu.data.dispatcher.DataDispatcher` and heartbeats it,
+while ``RemoteBlockParser(addr, dispatcher=True)`` becomes a failover
+client that discovers live workers through the dispatcher, re-dials the
+next worker when one dies mid-stream, reports receipt/consumption of
+each chunk (the exactly-once protocol), and optionally hedges slow
+fetches (``DMLC_TPU_DATA_HEDGE_S``; resilience/hedge.py) against a
+second worker. Undelivered-block requeues are bounded by
+``DMLC_TPU_DATA_PENDING_CAP`` with backpressure, metered as
+``dmlc_service_requeued_total`` (distinct from drops).
+
 Wire format (little-endian, per response): u32 field count (0 = end of
 stream), then per field u8 name length + name, u8 dtype-string length +
 dtype, u64 byte length + raw array bytes. All RowBlock fields are 1-D.
-Requests are a single u32: 1 = NEXT, 2 = CLOSE.
+Requests are a single u32: 1 = NEXT, 2 = CLOSE. The format is
+name-addressed, so the dispatcher-mode extras (``seq``, ``flow``) are
+invisible to legacy clients — they simply never ``.get()`` them.
 
 Like the parsers it serves, a service is ONE streaming pass (Parser
 semantics, data.h:298: "streaming one-pass"); epochs re-create service and
@@ -31,17 +46,20 @@ clients, mirroring create_parser per epoch.
 
 from __future__ import annotations
 
+import itertools
 import socket
 import struct
 import threading
 import time
-from typing import Dict, Optional, Tuple, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 from dmlc_tpu import obs
+from dmlc_tpu.data.dispatcher import DispatcherClient, dispatcher_address
 from dmlc_tpu.data.parsers import Parser, create_parser
-from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
+from dmlc_tpu.params.knobs import data_hedge_s, data_pending_cap
 from dmlc_tpu.utils.logging import DMLCError, check, log_warning
 
 _REQ_NEXT = 1
@@ -53,6 +71,19 @@ _RESP_ERROR = 0xFFFFFFFF
 
 _BLOCK_FIELDS = ("offset", "label", "index", "value", "weight", "qid",
                  "field")
+
+# how long a full pending stash waits for a consumer to drain it before
+# the block is dropped (module constant so tests can shrink it)
+_PENDING_WAIT_S = 1.0
+
+
+class TruncatedFrame(OSError):
+    """A peer hung up mid-frame.
+
+    An OSError (not DMLCError) on purpose: mid-frame closes are TRANSPORT
+    failures — the failover client re-dials and retries them, while
+    DMLCError stays reserved for fatal in-protocol errors (the server's
+    explicit error frame)."""
 
 
 def _pack_arrays(arrays: Dict[str, np.ndarray]) -> bytes:
@@ -74,7 +105,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
-            raise DMLCError("block service connection closed mid-frame")
+            raise TruncatedFrame(
+                "block service connection closed mid-frame")
         got += r
     return bytes(buf)
 
@@ -105,28 +137,53 @@ def _recv_arrays(sock: socket.socket) -> Optional[Dict[str, np.ndarray]]:
 
 
 class BlockService:
-    """Serve one parser's RowBlocks to N consumers, dynamically sharded.
+    """Serve RowBlocks to N consumers, dynamically sharded.
 
-    ``parser_kwargs`` pass through to :func:`create_parser` — notably
-    ``nthread`` (parse fan-out; defaults to the ``DMLC_TPU_NTHREAD`` env
-    knob), so a URI-constructed service gets the same pipelined chunk
-    parsing as a local feed."""
+    Two sources:
+
+    - ``source=`` (URI or Parser instance): the standalone shape — this
+      service owns one whole stream. ``parser_kwargs`` pass through to
+      :func:`create_parser` — notably ``nthread`` (parse fan-out;
+      defaults to the ``DMLC_TPU_NTHREAD`` env knob).
+    - ``dispatcher=`` (host:port or (host, port)): the fleet shape —
+      this service is a data *worker* registered with a
+      :class:`~dmlc_tpu.data.dispatcher.DataDispatcher`. It heartbeats,
+      leases chunk descriptors one at a time, parses each leased chunk
+      with :func:`create_parser` (any worker can parse any chunk), and
+      serves it as one frame tagged with the chunk's ``seq``. A worker
+      that dies simply stops heartbeating — its leases expire and the
+      dispatcher reassigns them to surviving workers."""
 
     def __init__(
         self,
-        source: Union[str, Parser],
+        source: Union[str, Parser, None] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        dispatcher: Union[str, Tuple[str, int], None] = None,
         **parser_kwargs,
     ):
-        self._parser = (
-            create_parser(source, 0, 1, **parser_kwargs)
-            if isinstance(source, str)
-            else source
+        check(
+            (source is None) != (dispatcher is None),
+            "BlockService takes exactly one of source= or dispatcher=",
         )
+        if dispatcher is not None:
+            self._parser: Optional[Parser] = None
+            self._parser_kwargs = dict(parser_kwargs)
+        else:
+            self._parser = (
+                create_parser(source, 0, 1, **parser_kwargs)
+                if isinstance(source, str)
+                else source
+            )
+            self._parser_kwargs = {}
         self._lock = threading.Lock()  # serializes parser pulls (the shard
         # point: one block goes to exactly one consumer)
+        self._cond = threading.Condition(self._lock)  # signaled when the
+        # pending stash drains (backpressure for _stash_undelivered)
+        self._pending_cap = data_pending_cap()
         self._done = False
+        self._crashed = False  # injected worker_crash fired: the worker is
+        # simulating sudden death (sockets closed, heartbeats stopped)
         self._drained = threading.Event()  # set when the stream is exhausted
         self._pending: list = []  # blocks pulled but undelivered (their
         # consumer died mid-send); redelivered before the next parser pull
@@ -159,10 +216,20 @@ class BlockService:
         self._m_dropped = reg.counter(
             "dmlc_service_blocks_dropped_total",
             "undelivered blocks at close (rows lost to the epoch)", svc=svc)
+        self._m_requeued = reg.counter(
+            "dmlc_service_requeued_total",
+            "undelivered blocks stashed for redelivery (rows kept in the "
+            "epoch)", svc=svc)
         self._m_responses = reg.counter(
             "dmlc_service_responses_total",
             "responses completed (telemetry mirror of the wait() signal)",
             svc=svc)
+        self._m_unconfirmed = reg.counter(
+            "dmlc_service_unconfirmed_total",
+            "legacy-mode responses fully sent to a consumer that vanished "
+            "before its next request (rows possibly lost: TCP cannot "
+            "confirm delivery, and without the dispatcher's ack ledger "
+            "redelivery could duplicate)", svc=svc)
         self._m_sent = reg.counter(
             "dmlc_service_sent_bytes_total",
             "payload bytes pushed to consumer sockets", svc=svc)
@@ -172,6 +239,20 @@ class BlockService:
             target=self._accept_loop, daemon=True, name="block-service"
         )
         self._accept_thread.start()
+        self._dispatch: Optional[DispatcherClient] = None
+        self._worker_id = -1
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if dispatcher is not None:
+            self._dispatch = DispatcherClient(dispatcher_address(dispatcher))
+            reply = self._dispatch.call(
+                {"op": "register", "addr": list(self.address)})
+            self._worker_id = int(reply.get("worker_id", -1))
+            self._hb_s = float(reply.get("heartbeat_s", 1.0))
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="block-service-hb")
+            self._hb_thread.start()
 
     # ---- server side ---------------------------------------------------
 
@@ -185,9 +266,158 @@ class BlockService:
         reached any consumer."""
         return int(self._m_dropped.value)
 
+    @property
+    def blocks_requeued(self) -> int:
+        """Undelivered blocks stashed for redelivery after their consumer
+        died mid-send — distinct from drops (those rows stayed in)."""
+        return int(self._m_requeued.value)
+
+    @property
+    def blocks_unconfirmed(self) -> int:
+        """Legacy-mode responses fully sent to a consumer that vanished
+        before issuing another request — delivery unknowable (possible
+        row loss); dispatcher mode closes this window with recv/ack."""
+        return int(self._m_unconfirmed.value)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self._hb_s):
+            if self._crashed:
+                return  # a crashed worker goes silent — that IS the signal
+            try:
+                self._dispatch.call(
+                    {"op": "heartbeat", "worker": self._worker_id})
+            except DMLCError:
+                return  # dispatcher gone; leases expire on their own
+
+    def _simulate_crash(self) -> None:
+        """Injected ``service.worker_crash``: die the way a real worker
+        does — stop heartbeating and close every socket abruptly, so
+        consumers see mid-frame cuts and the dispatcher sees silence."""
+        self._crashed = True
+        self._hb_stop.set()
+        with self._lock:
+            self._done = True
+            self._drained.set()
+        log_warning(
+            "block service %s:%d simulating worker crash (injected fault)",
+            self.address[0], self.address[1])
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _parse_chunk(self, chunk: Dict) -> Dict[str, np.ndarray]:
+        """Parse one leased chunk descriptor into a single response frame
+        tagged with its ``seq`` (and the chunk's flow, so a reassigned
+        chunk's trace chain spans every worker that touched it)."""
+        parser = create_parser(
+            chunk["uri"], chunk["part"], chunk["nparts"],
+            data_format=chunk.get("format", "auto"), **self._parser_kwargs)
+        cont = RowBlockContainer()
+        try:
+            while True:
+                block = parser.next_block()
+                if block is None:
+                    break
+                cont.push_block(block)
+        finally:
+            parser.close()
+        block = cont.to_block()
+        out = {}
+        for name in _BLOCK_FIELDS:
+            arr = getattr(block, name)
+            if arr is not None:
+                out[name] = np.asarray(arr)
+        out["seq"] = np.asarray([chunk["seq"]], dtype=np.int64)
+        fid = int(chunk.get("flow") or 0)
+        if fid:
+            out["flow"] = np.asarray([fid], dtype=np.int64)
+        return out
+
+    def _next_chunk_arrays(self) -> Optional[Dict[str, np.ndarray]]:
+        """Dispatcher-mode source: lease → parse → serve, one chunk per
+        call. The dispatcher is the shard point here (its lease table
+        assigns each chunk exactly once), so the local lock is NOT held
+        across the lease RPC or the parse — two consumer connections can
+        parse two leased chunks concurrently."""
+        from dmlc_tpu.resilience import InjectedFault, faultpoint
+
+        while True:
+            with self._lock:
+                if self._pending:
+                    self._cond.notify()
+                    return self._pending.pop(0)
+                if self._error is not None:
+                    raise self._error
+                if self._crashed:
+                    raise OSError("block service worker crashed")
+                if self._done:
+                    return None
+            try:
+                reply = self._dispatch.call(
+                    {"op": "lease", "worker": self._worker_id})
+            except DMLCError as err:
+                # the dispatcher is unreachable past retries. Without the
+                # control plane no further lease can be granted, so the
+                # stream is over from this worker's view — end it cleanly
+                # for consumers rather than relaying an opaque error (the
+                # common benign case is the dispatcher exiting the moment
+                # the last ack lands, one consumer pull before EOS).
+                log_warning(
+                    "block service %s:%d lost its dispatcher, ending "
+                    "stream: %s", self.address[0], self.address[1],
+                    str(err).split("\n\nStack trace:")[0])
+                with self._lock:
+                    self._done = True
+                    self._drained.set()
+                return None
+            if reply.get("eof") or reply.get("dead"):
+                # eof: every chunk acked. dead: the dispatcher declared
+                # this worker dead while it was merely slow — it must not
+                # serve leases the table already reassigned.
+                with self._lock:
+                    self._done = True
+                    self._drained.set()
+                return None
+            if reply.get("wait"):
+                # chunks exist but are leased/delivered elsewhere; they
+                # may yet requeue — poll (each poll heartbeats too)
+                time.sleep(0.05)
+                continue
+            chunk = reply.get("chunk")
+            if chunk is None:
+                raise DMLCError(
+                    "bad dispatcher lease reply: %r"
+                    % (reply.get("error") or reply,))
+            try:
+                faultpoint("service.worker_crash")
+            except InjectedFault as err:
+                self._simulate_crash()
+                raise OSError(str(err))
+            try:
+                arrays = self._parse_chunk(chunk)
+            except Exception as exc:
+                with self._lock:
+                    self._done = True
+                    detail = str(exc).split("\n\nStack trace:")[0]
+                    self._error_msg = "%s: %s" % (type(exc).__name__, detail)
+                    self._error = DMLCError(self._error_msg)
+                    self._drained.set()
+                raise self._error
+            self._m_served.inc()
+            return arrays
+
     def _next_block_arrays(self) -> Optional[Dict[str, np.ndarray]]:
+        if self._dispatch is not None:
+            return self._next_chunk_arrays()
         with self._lock:
             if self._pending:
+                self._cond.notify()
                 return self._pending.pop(0)
             if self._error is not None:
                 raise self._error
@@ -225,14 +455,43 @@ class BlockService:
         return out
 
     def _stash_undelivered(self, arrays: Dict[str, np.ndarray]) -> None:
-        with self._lock:
+        """Requeue a block whose consumer died mid-send.
+
+        Bounded (``DMLC_TPU_DATA_PENDING_CAP``): a full stash
+        backpressures the stashing connection thread for up to
+        ``_PENDING_WAIT_S`` waiting for a surviving consumer to drain it,
+        then drops the block (metered as a drop, not a requeue) — a crash
+        storm must not buffer the whole dataset in one worker's memory."""
+        with self._cond:
+            if self._pending_cap > 0:
+                deadline = time.monotonic() + _PENDING_WAIT_S
+                while (len(self._pending) >= self._pending_cap
+                       and not self._done):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                if len(self._pending) >= self._pending_cap:
+                    self._m_dropped.inc()
+                    log_warning(
+                        "block service pending stash full (cap %d); "
+                        "dropping an undelivered block (%d rows)",
+                        self._pending_cap, len(arrays["offset"]) - 1)
+                    return
             self._pending.append(arrays)
+            self._m_requeued.inc()
 
     def _send_response(self, conn: socket.socket, data: bytes) -> None:
         """sendall in ≤1 MiB slices, ticking _bytes_sent — so wait() can
         tell a slow-but-live transfer from a wedged one."""
+        from dmlc_tpu.resilience import faultpoint
+
         view = memoryview(data)
         while view:
+            # an injected service.send fault (or a real send error) cuts
+            # the consumer off MID-frame — the client-side truncated-frame
+            # handling is what makes this recoverable
+            faultpoint("service.send")
             sent = conn.send(view[: 1 << 20])
             with self._lock:
                 self._bytes_sent += sent
@@ -242,9 +501,13 @@ class BlockService:
     def _serve_conn(self, conn: socket.socket) -> None:
         self._conns.append(conn)
         undelivered: Optional[Dict[str, np.ndarray]] = None
+        unconfirmed = False  # a block frame was FULLY sent and no further
+        # request (or close) has arrived to prove the consumer read it
         try:
             while True:
                 (req,) = struct.unpack("<I", _recv_exact(conn, 4))
+                unconfirmed = False  # another request: the consumer read
+                # the previous frame (it asked for more on the same pipe)
                 try:
                     if req == _REQ_CLOSE:
                         return
@@ -276,6 +539,7 @@ class BlockService:
                     if undelivered is None:
                         return
                     undelivered = None
+                    unconfirmed = True
                 finally:
                     with self._lock:
                         self._responses_done += 1
@@ -285,8 +549,27 @@ class BlockService:
             # stream stays lossless for the remaining consumers
             if undelivered is not None:
                 self._stash_undelivered(undelivered)
+            elif unconfirmed and self._dispatch is None:
+                # the kernel took the whole frame but the consumer
+                # vanished before asking for more: TCP cannot say whether
+                # those rows landed, and legacy mode has no ack ledger to
+                # requeue them safely (redelivery could duplicate) — so
+                # the frame is counted possibly-lost, loudly. Dispatcher
+                # mode closes this window: its recv/ack accounting
+                # requeues any chunk the consumer never reported.
+                self._m_unconfirmed.inc()
+                log_warning(
+                    "block service %s:%d: consumer vanished after a fully "
+                    "sent block and before its next request — delivery "
+                    "unconfirmed, rows may be lost (dispatcher mode "
+                    "tracks and requeues these)",
+                    self.address[0], self.address[1])
             return
         finally:
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
             conn.close()
 
     def _accept_loop(self) -> None:
@@ -299,7 +582,12 @@ class BlockService:
                 target=self._serve_conn, args=(conn,), daemon=True
             )
             t.start()
-            self._threads.append(t)
+            # prune finished handler threads (failover clients re-dial
+            # many times under fault storms; dead entries must not pile
+            # up for the life of the epoch). Rebind, don't mutate: wait()
+            # and close() iterate snapshots of this list concurrently.
+            self._threads = [
+                th for th in self._threads if th.is_alive()] + [t]
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until the stream is exhausted and every consumer connection
@@ -351,6 +639,9 @@ class BlockService:
             return  # a silent window: only stuck/idle connections remain
 
     def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
         try:
             self._sock.close()
         except OSError:
@@ -381,9 +672,13 @@ class BlockService:
                         len(self._pending), rows,
                     )
                     self._pending.clear()
+                self._cond.notify_all()  # release any backpressured stash
             finally:
                 self._lock.release()
-        self._parser.close()
+        if self._parser is not None:
+            self._parser.close()
+        if self._dispatch is not None:
+            self._dispatch.close()
 
     def __enter__(self):
         return self
@@ -398,10 +693,67 @@ class RemoteBlockParser:
     Drop-in for create_parser output: next_block()/iteration/bytes_read/
     close. before_first raises — the service is a one-pass stream (re-create
     service + parser per epoch, exactly like a fresh create_parser).
-    """
 
-    def __init__(self, address: Tuple[str, int], timeout: float = 60.0):
+    Legacy mode (``dispatcher=False``) speaks to one service address.
+    Mid-stream transport failures (``OSError``, truncated frames) are
+    classified transient and retried through the shared ``RetryPolicy``
+    by re-dialing the same address — the service's redelivery stash keeps
+    the rows in the epoch. The server's explicit error frame stays FATAL
+    (``DMLCError``): a parse failure must surface, not retry.
+
+    Dispatcher mode (``dispatcher=True``, ``address`` = the dispatcher):
+    a failover client. Live workers are discovered via the dispatcher;
+    a worker death mid-fetch rotates to the next live worker. Every
+    received chunk is receipt-reported (``recv``) — the dispatcher
+    REJECTS duplicates of a chunk someone else already holds, and the
+    client silently drops rejected copies (exactly-once). Consumed
+    chunks are acked: implicitly (the previous chunk is acked right
+    before each new fetch — the ack frontier) or explicitly via
+    :meth:`ack` once a consumer (DeviceFeed) takes ownership. Slow
+    fetches can be hedged against a second worker
+    (``DMLC_TPU_DATA_HEDGE_S`` > 0); the loser's chunk is never
+    receipt-reported, so its lease expires and requeues — still
+    exactly-once, at the cost of one wasted parse."""
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        timeout: float = 60.0,
+        dispatcher: bool = False,
+    ):
         from dmlc_tpu.resilience import RetryPolicy, faultpoint
+
+        self._timeout = float(timeout)
+        self.bytes_read = 0  # Parser API surface; obs mirror below
+        self._m_read = obs.registry().counter(
+            "dmlc_io_read_bytes_total", "payload bytes ingested by source",
+            source="service")
+        self._closed = False
+        self._ended = False
+        self._inflight = False  # a _REQ_NEXT is on the wire (close() must
+        # drain its response so the server's send completes cleanly)
+        self._explicit_ack = False
+        self._unacked: List[int] = []
+        self._seen: set = set()  # every seq this client ever accepted —
+        # a redelivery of rows we already hold (a lease the dispatcher
+        # requeued while our dispatcher session was briefly down) is
+        # dropped HERE; the server cannot tell that duplicate apart from
+        # an idempotent recv retry, but we can
+        if dispatcher:
+            self.address = dispatcher_address(address)
+            self._dispatch: Optional[DispatcherClient] = DispatcherClient(
+                self.address, timeout=timeout)
+            reply = self._dispatch.call({"op": "client"})
+            self._client_id = int(reply.get("client_id", -1))
+            self._sock: Optional[socket.socket] = None
+            self._worker_pos = 0
+            self._hedge_s = data_hedge_s()
+            return
+        self._dispatch = None
+        self._client_id = -1
+        self._worker_pos = 0
+        self._hedge_s = 0.0
+        self.address = (str(address[0]), int(address[1]))
 
         def dial():
             faultpoint("service.connect")
@@ -414,53 +766,233 @@ class RemoteBlockParser:
             dial, "service.connect", display=f"block service {address}"
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.bytes_read = 0  # Parser API surface; obs mirror below
-        self._m_read = obs.registry().counter(
-            "dmlc_io_read_bytes_total", "payload bytes ingested by source",
-            source="service")
-        self._closed = False
-        self._ended = False
 
-    def next_block(self) -> Optional[RowBlock]:
+    # ---- connection management -----------------------------------------
+
+    def _dial_once(self, addr: Tuple[str, int]) -> socket.socket:
         from dmlc_tpu.resilience import faultpoint
 
-        if self._ended:
-            return None
-        faultpoint("service.next")
-        self._sock.sendall(struct.pack("<I", _REQ_NEXT))
+        faultpoint("service.connect")
+        sock = socket.create_connection(addr, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _live_workers(self) -> List[Tuple[str, int]]:
+        reply = self._dispatch.call({"op": "workers"})
+        return [(str(w[0]), int(w[1])) for w in reply.get("workers", [])]
+
+    def _dial_worker(self) -> socket.socket:
+        """Rotate over the dispatcher's live-worker list starting at the
+        current position. A worker the dispatcher has not yet declared
+        dead may still refuse the dial — skip it; the next heartbeat gap
+        will get it delisted."""
+        workers = self._live_workers()
+        if not workers:
+            raise OSError("no live data workers registered")
+        for i in range(len(workers)):
+            pos = (self._worker_pos + i) % len(workers)
+            try:
+                sock = self._dial_once(workers[pos])
+            except OSError:
+                continue
+            self._worker_pos = pos
+            return sock
+        raise OSError(
+            "no reachable data worker among %d listed" % len(workers))
+
+    def _ensure_sock(self) -> socket.socket:
+        if self._sock is None:
+            if self._dispatch is None:
+                self._sock = self._dial_once(self.address)
+            else:
+                self._sock = self._dial_worker()
+        return self._sock
+
+    def _drop_sock(self, advance: bool = False) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if advance:
+            self._worker_pos += 1  # failover: next fetch tries the NEXT
+            # live worker first instead of re-hitting the dead one
+
+    # ---- ack protocol ---------------------------------------------------
+
+    def set_explicit_ack(self) -> None:
+        """Switch off implicit acking BEFORE the first fetch: the consumer
+        (DeviceFeed) owns the ack frontier and will call :meth:`ack` per
+        consumed chunk. Must be called at construction time so early
+        prefetched chunks are never implicitly acked."""
+        self._explicit_ack = True
+
+    def ack(self, seq: int) -> None:
+        """Report chunk ``seq`` consumed (explicit-ack mode)."""
+        self._explicit_ack = True
+        if self._dispatch is None:
+            return
         try:
-            arrays = _recv_arrays(self._sock)
+            self._unacked.remove(int(seq))
+        except ValueError:
+            pass
+        self._dispatch.call(
+            {"op": "ack", "client": self._client_id, "seq": int(seq)})
+
+    def _flush_acks(self) -> None:
+        """Implicit ack frontier: everything received before this fetch
+        was consumed by the caller (Parser pull semantics — the caller
+        asked for the next block, so it is done with the previous)."""
+        if self._dispatch is None or self._explicit_ack:
+            return
+        while self._unacked:
+            sid = self._unacked[0]
+            self._dispatch.call(
+                {"op": "ack", "client": self._client_id, "seq": sid})
+            self._unacked.pop(0)
+
+    # ---- fetch path ------------------------------------------------------
+
+    def _hedged_fetch(self, workers: List[Tuple[str, int]]) -> Optional[
+            Dict[str, np.ndarray]]:
+        """Race a second worker after ``DMLC_TPU_DATA_HEDGE_S`` of
+        silence. Each attempt dials a FRESH connection to a distinct
+        worker; the winner's socket becomes the session socket. The
+        loser's chunk (if its fetch completes) is never receipt-reported,
+        so its lease expires and the dispatcher requeues it — wasted
+        work, never duplicated rows."""
+        from dmlc_tpu.resilience import hedged_call
+
+        picks = itertools.count(self._worker_pos)
+        socks: List[socket.socket] = []
+
+        def fetch():
+            sock = self._dial_once(workers[next(picks) % len(workers)])
+            socks.append(sock)
+            try:
+                sock.sendall(struct.pack("<I", _REQ_NEXT))
+                return sock, _recv_arrays(sock)
+            except Exception:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+
+        self._drop_sock()
+        self._inflight = True
+        try:
+            winner, arrays = hedged_call(
+                fetch, self._hedge_s, site="service.fetch")
+        finally:
+            self._inflight = False
+        self._sock = winner
+        for sock in socks:
+            if sock is not winner:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        return arrays
+
+    def _fetch_arrays(self) -> Optional[Dict[str, np.ndarray]]:
+        """One framed fetch with transport-failure failover.
+
+        Transient = OSError only (truncated frames, resets, injected
+        faults). The server's error frame raises DMLCError and is NOT
+        retried — RetryPolicy re-raises fatal errors untouched, so the
+        existing error-frame semantics hold."""
+        from dmlc_tpu.resilience import RetryPolicy, faultpoint
+
+        def attempt():
+            faultpoint("service.next")
+            # flush the ack frontier BEFORE requesting more: by the time
+            # the stream can end, every prior chunk is acked — which is
+            # exactly what lets the dispatcher answer this fetch with EOF
+            self._flush_acks()
+            if (self._dispatch is not None and self._hedge_s > 0):
+                workers = self._live_workers()
+                if len(workers) > 1:
+                    return self._hedged_fetch(workers)
+            sock = self._ensure_sock()
+            self._inflight = True
+            try:
+                sock.sendall(struct.pack("<I", _REQ_NEXT))
+                return _recv_arrays(sock)
+            except OSError:
+                self._drop_sock(advance=True)
+                raise
+            finally:
+                self._inflight = False
+
+        policy = RetryPolicy(
+            max_attempts=5, base_s=0.2, cap_s=2.0,
+            classify=lambda err: isinstance(err, OSError))
+        try:
+            return policy.call(
+                attempt, "service.next", display="block service fetch")
         except DMLCError:
-            # error frame or dead socket: the stream is over — a retried
+            # error frame or retry give-up: the stream is over — a retried
             # next_block() must not mask the original error with a
             # broken-pipe on the closed connection
             self._ended = True
             raise
-        if arrays is None:
-            self._ended = True
+
+    def next_block(self) -> Optional[RowBlock]:
+        if self._ended:
             return None
-        nbytes = sum(a.nbytes for a in arrays.values())
-        self.bytes_read += nbytes
-        self._m_read.inc(nbytes)
-        flow = arrays.pop("flow", None)
-        fid = int(flow[0]) if flow is not None and len(flow) else 0
-        block = RowBlock(
-            offset=arrays["offset"],
-            label=arrays["label"],
-            index=arrays["index"],
-            value=arrays.get("value"),
-            weight=arrays.get("weight"),
-            qid=arrays.get("qid"),
-            field=arrays.get("field"),
-        )
-        if fid:
-            # continue the server's flow on this rank: after the plane
-            # merges traces, the arrow crosses from the serving rank's
-            # service_send slice into this receive
-            block.flow_id = fid
-            with obs.span("service_recv", nbytes=nbytes, flow=fid):
-                obs.flow_step(fid, "chunk")
-        return block
+        while True:
+            arrays = self._fetch_arrays()
+            if arrays is None:
+                self._ended = True
+                try:
+                    self._flush_acks()  # defensive: EOF implies all acked
+                except (DMLCError, OSError):
+                    pass
+                return None
+            seq = arrays.pop("seq", None)
+            sid = int(seq[0]) if seq is not None and len(seq) else None
+            if self._dispatch is not None and sid is not None:
+                reply = self._dispatch.call(
+                    {"op": "recv",
+                     "client": self._client_id, "seq": sid})
+                if reply.get("reject") or sid in self._seen:
+                    # reject: another client already owns this chunk —
+                    # the dispatcher's lease table is the exactly-once
+                    # arbiter. seen: WE already hold (or consumed) these
+                    # rows from an earlier delivery whose lease was
+                    # requeued — the recv above re-marks the table
+                    # delivered-to-us (stopping further reserves), and
+                    # this duplicate copy is dropped; the original's ack
+                    # settles the chunk.
+                    continue
+                self._seen.add(sid)
+                self._unacked.append(sid)
+            nbytes = sum(a.nbytes for a in arrays.values())
+            self.bytes_read += nbytes
+            self._m_read.inc(nbytes)
+            flow = arrays.pop("flow", None)
+            fid = int(flow[0]) if flow is not None and len(flow) else 0
+            block = RowBlock(
+                offset=arrays["offset"],
+                label=arrays["label"],
+                index=arrays["index"],
+                value=arrays.get("value"),
+                weight=arrays.get("weight"),
+                qid=arrays.get("qid"),
+                field=arrays.get("field"),
+            )
+            if sid is not None:
+                block.seq_id = sid
+            if fid:
+                # continue the server's flow on this rank: after the plane
+                # merges traces, the arrow crosses from the serving rank's
+                # service_send slice into this receive
+                block.flow_id = fid
+                with obs.span("service_recv", nbytes=nbytes, flow=fid):
+                    obs.flow_step(fid, "chunk")
+            return block
 
     def __iter__(self):
         while True:
@@ -479,12 +1011,32 @@ class RemoteBlockParser:
         if self._closed:
             return
         self._closed = True
+        sock = self._sock
+        if sock is not None and self._inflight:
+            # graceful close handshake: a _REQ_NEXT is on the wire — drain
+            # its response so the server's send completes cleanly (no
+            # OSError on its side, no spurious requeue of a block this
+            # client never wanted). Dispatcher mode: the drained chunk is
+            # never receipt-reported, so it requeues by lease expiry.
+            try:
+                sock.settimeout(min(5.0, self._timeout))
+                _recv_arrays(sock)
+            except (DMLCError, OSError):
+                pass
+            self._inflight = False
         try:
-            if not self._ended:
-                self._sock.sendall(struct.pack("<I", _REQ_CLOSE))
+            if sock is not None and not self._ended:
+                sock.sendall(struct.pack("<I", _REQ_CLOSE))
         except OSError:
             pass
-        self._sock.close()
+        try:
+            self._flush_acks()
+        except (DMLCError, OSError):
+            pass
+        if self._dispatch is not None:
+            self._dispatch.close()
+        if sock is not None:
+            sock.close()
 
 
 def reshard_split(split, rank: Optional[int] = None,

@@ -354,6 +354,16 @@ class DeviceFeed:
         self._h2d = device_telemetry.h2d_meter(feed=fid)
         device_telemetry.maybe_start_hbm_poller()
         self._epoch_base: dict = {}
+        # exactly-once ack emission (dispatcher-mode RemoteBlockParser):
+        # switch the parser to explicit acks BEFORE the producer thread
+        # can issue its first fetch, so prefetched chunks are acked only
+        # when their rows are consumed (or dropped) by this feed
+        self._ack = getattr(self._parser, "ack", None)
+        set_explicit = getattr(self._parser, "set_explicit_ack", None)
+        if callable(self._ack) and callable(set_explicit):
+            set_explicit()
+        else:
+            self._ack = None
         self._sync_host = host_prefetch <= 0
         if self._sync_host:
             # synchronous host stage: on a 1-core host the prefetch
@@ -412,14 +422,18 @@ class DeviceFeed:
     def _host_batches_python(self) -> Iterator:
         bs = self.spec.batch_size
         pending = RowBlockContainer()
-        # flow ids of parser chunks not yet represented in an emitted
-        # batch; rebatching is N:M, so each chunk's flow rides the first
-        # slice it contributes rows to
+        # flow ids (and dispatcher chunk seq ids) of parser chunks not yet
+        # represented in an emitted batch; rebatching is N:M, so each
+        # chunk's ids ride the first slice it contributes rows to
         flows = []
+        seqs = []
         for block in self._parser:
             fid = getattr(block, "flow_id", 0)
             if fid:
                 flows.append(fid)
+            sid = getattr(block, "seq_id", None)
+            if sid is not None:
+                seqs.append(sid)
             pending.push_block(block)
             if len(pending) < bs:
                 continue
@@ -431,6 +445,9 @@ class DeviceFeed:
                 if flows:
                     piece.flow_ids = tuple(flows)
                     flows = []
+                if seqs:
+                    piece.seq_ids = tuple(seqs)
+                    seqs = []
                 yield piece
             pending = RowBlockContainer()
             if len(whole) > nfull * bs:
@@ -439,7 +456,16 @@ class DeviceFeed:
             tail = pending.to_block()
             if flows:
                 tail.flow_ids = tuple(flows)
+            if seqs:
+                tail.seq_ids = tuple(seqs)
+                seqs = []
             yield tail
+        if seqs and self._ack is not None:
+            # chunks whose rows only ever reached a dropped remainder (or
+            # an empty chunk) still count as visited — ack them here or
+            # the dispatcher would requeue them forever
+            for sid in seqs:
+                self._ack_seq(sid)
 
     def _host_batches_native(self) -> Iterator:
         spec = self.spec
@@ -599,11 +625,21 @@ class DeviceFeed:
         out["num_nonzero"] = batch.num_nonzero
         return out
 
+    def _ack_seq(self, sid) -> None:
+        """Report one dispatcher chunk consumed; best-effort — a dead
+        dispatcher must not kill the training loop (the lease deadline
+        covers a lost ack; the duplicate-ack path makes a retried one
+        harmless)."""
+        try:
+            self._ack(sid)
+        except Exception:  # noqa: BLE001 — see docstring
+            pass
+
     def _deliver(self, entry):
         """Retire a pending batch's staging buffers (guarded by its own
         device arrays: acquire() reuses them only once the async H2D copy
         is done) and hand the batch to the consumer."""
-        batch, bufs, _flows = entry
+        batch, bufs = entry[0], entry[1]
         if bufs:
             self.pool.retire(
                 bufs, [v for v in batch.values() if isinstance(v, jax.Array)]
@@ -644,6 +680,12 @@ class DeviceFeed:
                     for fid in flows:
                         obs.flow_end(fid, "chunk")
             self._stage["consume_ns"].observe(time.monotonic_ns() - t2)
+            if self._ack is not None:
+                # the consumer released the batch: every chunk whose rows
+                # first appeared in it is now consumed — advance the
+                # exactly-once ack frontier
+                for sid in entry[3]:
+                    self._ack_seq(sid)
             ndelivered += 1
 
         while True:
@@ -663,11 +705,14 @@ class DeviceFeed:
                             time.monotonic_ns() - t0)
                 t1 = time.monotonic_ns()
                 flows = getattr(block, "flow_ids", ())
+                seqs = getattr(block, "seq_ids", ())
                 with obs.span("dispatch", batch=nbatch):
                     for fid in flows:
                         obs.flow_step(fid, "chunk")
                     batch_bufs = self._to_device(block, flows)
-                    pending.append(batch_bufs + (flows,))  # async dispatch
+                    # async dispatch; the entry keeps the chunk ids so
+                    # _consume can close flows and ack seqs on delivery
+                    pending.append(batch_bufs + (flows, seqs))
                 self._stage["dispatch_ns"].observe(time.monotonic_ns() - t1)
                 self._m_batches.inc()
                 nbatch += 1

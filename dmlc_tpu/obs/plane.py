@@ -20,7 +20,9 @@ timelines — so:
   ``DMLC_TPU_STATUS_PORT``) serves it: ``/healthz``, ``/workers``
   (membership ``world_version`` + event log + rank →
   last-seen/lag/straggler), ``/metrics`` (Prometheus text merged
-  across ranks), and ``/trace`` (job-wide Chrome-trace JSON).
+  across ranks), ``/trace`` (job-wide Chrome-trace JSON), and ``/data``
+  (the data dispatcher's worker/lease/requeue view, when one is
+  attached — see data/dispatcher.py).
 - **Clock skew** — each payload carries the worker's send wall-time and
   its last measured heartbeat RTT; the tracker estimates per-rank offset
   as ``recv − sent − rtt/2`` (the NTP/obs-aggregate midpoint idea) and
@@ -345,6 +347,9 @@ class StatusPlane:
             "dmlc_tracker_world_version",
             "current membership generation committed by the tracker")
         self._g_world.set(0)
+        # fault-tolerant data service (data/dispatcher.py): a snapshot
+        # provider installed by DataDispatcher.attach_plane backs /data
+        self._data_provider = None
 
     def _view(self, rank: int) -> _WorkerView:
         view = self._views.get(rank)
@@ -418,6 +423,25 @@ class StatusPlane:
                 return 0
             return encode_profile_word(self._profile_req,
                                        self._profile_seconds)
+
+    def set_data_provider(self, fn) -> None:
+        """Install the data-dispatcher snapshot callable behind ``/data``
+        (``DataDispatcher.attach_plane``). Latest wins — one dispatcher
+        per epoch, same lifecycle as the service."""
+        self._data_provider = fn
+
+    def data_view(self) -> Dict:
+        """The ``/data`` body: live worker/lease/requeue view from the
+        attached dispatcher, or ``{"attached": false}`` when no data
+        service is running behind this tracker."""
+        fn = self._data_provider
+        if fn is None:
+            return {"attached": False}
+        try:
+            return dict(fn(), attached=True)
+        except Exception as err:  # noqa: BLE001 — a dying dispatcher must
+            # not take the status server down with it
+            return {"attached": True, "error": str(err)}
 
     def membership(self) -> Dict:
         """``{"world_version": N, "events": [...]}`` — the elastic half of
@@ -583,6 +607,9 @@ class _NoopPlane:
     def profile_word(self):
         return 0
 
+    def set_data_provider(self, fn):
+        pass
+
 
 NOOP_PLANE = _NoopPlane()
 
@@ -612,6 +639,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 ctype = "text/plain; version=0.0.4"
             elif path == "/trace":
                 body = json.dumps(plane.merged_trace()).encode()
+                ctype = "application/json"
+            elif path == "/data":
+                body = json.dumps(plane.data_view()).encode()
                 ctype = "application/json"
             elif path == "/profile":
                 from urllib.parse import parse_qs

@@ -82,6 +82,22 @@ docs/robustness.md "Elastic membership") adds four more:
   handshake and blocks until a transition activates the spare (or
   exits 0 if the job finishes without needing it)
 
+The fault-tolerant data service (data/dispatcher.py + data/service.py,
+see docs/distributed.md "Disaggregated ingest") adds five more:
+
+- ``DMLC_TPU_DATA_CHUNKS`` — chunks the dispatcher splits one dataset
+  into (the lease/requeue granularity; default 16)
+- ``DMLC_TPU_DATA_LEASE_S`` — seconds a leased/delivered chunk may stay
+  unacked before the dispatcher requeues it (default 30)
+- ``DMLC_TPU_DATA_DEAD_S`` — seconds of heartbeat silence before a data
+  worker is declared dead and its leases requeued (default 10)
+- ``DMLC_TPU_DATA_PENDING_CAP`` — cap on one service's undelivered-block
+  requeue stash; a full stash backpressures then drops (default 64;
+  0 or negative = unbounded, the pre-cap behavior)
+- ``DMLC_TPU_DATA_HEDGE_S`` — seconds of fetch silence before a
+  dispatcher-mode client hedges the fetch against a second live worker
+  (0 = hedging off, the default)
+
 Device telemetry (obs/device_telemetry.py, see docs/observability.md
 "Device telemetry") adds two more:
 
@@ -249,6 +265,53 @@ def evict_after_s() -> float:
     return max(0.0, float(get_env("DMLC_TPU_EVICT_AFTER_S", 0.0)))
 
 
+def data_chunks(explicit: Optional[int] = None) -> int:
+    """Chunk count for lease-based dispatch: the explicit argument when
+    given, else ``DMLC_TPU_DATA_CHUNKS``, else 16. More chunks = finer
+    reassignment granularity (less lost work per worker death) at more
+    lease RPCs per epoch; floor 1."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    return max(1, get_env("DMLC_TPU_DATA_CHUNKS", 16))
+
+
+def data_lease_s(explicit: Optional[float] = None) -> float:
+    """Chunk lease duration in seconds: explicit argument, else
+    ``DMLC_TPU_DATA_LEASE_S``, else 30. Size it well above one chunk's
+    parse+serve+consume time — a too-short lease requeues chunks that
+    merely ran slow (their late deliveries are then rejected: correct,
+    but wasted work). Floor 0.1."""
+    if explicit is not None:
+        return max(0.1, float(explicit))
+    return max(0.1, float(get_env("DMLC_TPU_DATA_LEASE_S", 30.0)))
+
+
+def data_dead_after_s(explicit: Optional[float] = None) -> float:
+    """Data-worker death threshold in seconds of heartbeat silence:
+    explicit argument, else ``DMLC_TPU_DATA_DEAD_S``, else 10. Workers
+    heartbeat at a third of this, so one lost beat never reads as a
+    crash. Floor 0.1."""
+    if explicit is not None:
+        return max(0.1, float(explicit))
+    return max(0.1, float(get_env("DMLC_TPU_DATA_DEAD_S", 10.0)))
+
+
+def data_pending_cap() -> int:
+    """Cap on a block service's undelivered-block requeue stash
+    (``DMLC_TPU_DATA_PENDING_CAP``, default 64; 0 or negative =
+    unbounded). A full stash backpressures the stashing thread briefly,
+    then drops the block — metered as a drop, never silently."""
+    return get_env("DMLC_TPU_DATA_PENDING_CAP", 64)
+
+
+def data_hedge_s() -> float:
+    """Fetch-hedging threshold for dispatcher-mode clients in seconds
+    (``DMLC_TPU_DATA_HEDGE_S``; 0 = hedging off, the default). Distinct
+    from ``DMLC_TPU_HEDGE_S`` (the readahead I/O hedge): this one races
+    a whole chunk fetch against a second data worker."""
+    return max(0.0, float(get_env("DMLC_TPU_DATA_HEDGE_S", 0.0)))
+
+
 def device_telemetry_enabled() -> bool:
     """Whether the device telemetry layer is live
     (``DMLC_TPU_DEVICE_TELEMETRY``, default on). Read once where each
@@ -301,6 +364,12 @@ KNOWN_KNOBS = (
     "DMLC_TPU_OBS_PAYLOAD_MAX",
     "DMLC_TPU_FLIGHTREC",
     "DMLC_TPU_FLIGHTREC_CAP",
+    # fault-tolerant data service
+    "DMLC_TPU_DATA_CHUNKS",
+    "DMLC_TPU_DATA_LEASE_S",
+    "DMLC_TPU_DATA_DEAD_S",
+    "DMLC_TPU_DATA_PENDING_CAP",
+    "DMLC_TPU_DATA_HEDGE_S",
     # device telemetry
     "DMLC_TPU_DEVICE_TELEMETRY",
     "DMLC_TPU_HBM_POLL_S",

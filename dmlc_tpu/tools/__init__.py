@@ -40,6 +40,7 @@ _COMMANDS = {
     "strtonum": "dmlc_tpu.tools.strtonum",
     "rowrec": "dmlc_tpu.tools.rowrec",
     "serve": "dmlc_tpu.tools.serve",
+    "dispatch": "dmlc_tpu.tools.dispatch",
     "parity": "dmlc_tpu.tools.parity",
     "obs-report": "dmlc_tpu.tools.obs_report",
     "obs-top": "dmlc_tpu.tools.obs_top",

@@ -1,0 +1,87 @@
+"""Data-dispatcher CLI: run the fault-tolerant data service control
+plane from the shell.
+
+The fleet counterpart of ``serve``: this process owns one epoch's chunk
+lease table (data/dispatcher.py); ``serve --dispatcher HOST:PORT``
+workers register with it and parse whichever chunks they lease, and
+``RemoteBlockParser(addr, dispatcher=True)`` consumers discover workers
+through it. Killing a worker mid-epoch is safe — its leases requeue.
+
+Usage::
+
+    python -m dmlc_tpu.tools dispatch <uri> [--nchunks N] [--host H]
+        [--port P] [--format auto|libsvm|libfm|csv|recordio]
+        [--lease-s SECS] [--dead-after-s SECS] [--status-port P]
+
+Prints ``dispatching HOST PORT`` on stdout once listening, then blocks
+until every chunk is acked (the epoch is complete) and prints a summary
+with the requeue count. ``--status-port`` additionally serves the live
+``/data`` worker/lease/requeue view over HTTP (obs/plane.py status
+server; 0 = ephemeral port, printed as ``status HOST PORT``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from dmlc_tpu.data import DataDispatcher
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("uri")
+    ap.add_argument("--nchunks", type=int, default=None,
+                    help="chunks to split the dataset into (default: the "
+                         "DMLC_TPU_DATA_CHUNKS knob, 16)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--format", default="auto",
+                    choices=["auto", "libsvm", "libfm", "csv", "recordio"])
+    ap.add_argument("--lease-s", type=float, default=None,
+                    help="chunk lease seconds (default: the "
+                         "DMLC_TPU_DATA_LEASE_S knob, 30)")
+    ap.add_argument("--dead-after-s", type=float, default=None,
+                    help="worker heartbeat-silence death threshold "
+                         "(default: the DMLC_TPU_DATA_DEAD_S knob, 10)")
+    ap.add_argument("--status-port", type=int, default=None,
+                    help="serve the /data lease view over HTTP on this "
+                         "port (0 = ephemeral; default: no server)")
+    args = ap.parse_args(argv)
+
+    disp = DataDispatcher(
+        args.uri, nchunks=args.nchunks, host=args.host, port=args.port,
+        lease_s=args.lease_s, dead_after_s=args.dead_after_s,
+        data_format=args.format)
+    status = None
+    if args.status_port is not None:
+        from dmlc_tpu.obs.plane import StatusPlane, StatusServer
+
+        plane = StatusPlane()
+        disp.attach_plane(plane)
+        status = StatusServer(plane, port=args.status_port)
+        status.start()
+        print(f"status {args.host} {status.port}", flush=True)
+    host, port = disp.address
+    print(f"dispatching {host} {port}", flush=True)
+    try:
+        disp.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        snap = disp.snapshot()
+        if status is not None:
+            status.close()
+        disp.close()
+    chunks = snap["chunks"]
+    print(
+        "dispatched %d chunks (%d acked, %d requeued, %d duplicate "
+        "deliveries rejected)" % (chunks["total"], chunks["acked"],
+                                  snap["requeued"], snap["rejects"]),
+        flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

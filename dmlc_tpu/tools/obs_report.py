@@ -9,8 +9,10 @@ Renders a human-readable summary of a job's observability artifacts:
 - ``--trace FILE`` — a merged job trace (the status server's ``/trace``
   download, or any Chrome-trace JSON): per-stage time by rank and the
   cross-rank slack table, widest stage first — the critical-path view.
-- ``--status HOST:PORT`` — fetch ``/workers`` and ``/trace`` from a
-  *live* tracker status server instead of files; also renders the device
+- ``--status HOST:PORT`` — fetch ``/workers``, ``/data`` (the data
+  dispatcher's worker/lease/requeue view, when one is attached), and
+  ``/trace`` from a *live* tracker status server instead of files; also
+  renders the device
   telemetry section (per-rank XLA compiles / recompile anomalies, device
   memory, H2D bandwidth — obs/device_telemetry.py) from ``/metrics``.
 - ``--top`` — with ``--status``: render the same per-rank table the live
@@ -34,7 +36,8 @@ import sys
 from typing import Dict, List, Optional
 
 _RESILIENCE_KINDS = ("fault.injected", "retry.giveup", "collective.recover",
-                     "ckpt.fallback", "uncaught")
+                     "ckpt.fallback", "uncaught", "service.requeue",
+                     "service.worker_dead")
 
 
 def _load_flightrecs(dirpath: str) -> List[Dict]:
@@ -75,6 +78,73 @@ def _report_flightrecs(dumps: List[Dict]) -> None:
             if rec.get("kind") == "uncaught":
                 print(f"  uncaught: {rec.get('error')}: "
                       f"{rec.get('message')}")
+
+
+def _report_reassignments(dumps: List[Dict]) -> None:
+    """Chunk-reassignment event table from the flight-recorder dumps:
+    every ``service.requeue`` the data dispatcher recorded (seq, the
+    state the lease was in, which worker/client held it, how many times
+    that chunk has requeued), plus worker-death events."""
+    rows = []
+    deaths = []
+    for obj in dumps:
+        for rec in obj.get("records", []):
+            if rec.get("kind") == "service.requeue":
+                rows.append(rec)
+            elif rec.get("kind") == "service.worker_dead":
+                deaths.append(rec)
+    if not rows and not deaths:
+        return
+    print("== data service reassignments ==")
+    for rec in deaths:
+        print(f"worker {rec.get('worker')} ({rec.get('addr')}) "
+              "declared dead")
+    if rows:
+        print(f"{'seq':>5} {'state':<10} {'worker':>6} {'client':>6} "
+              f"{'requeues':>8}")
+        for rec in rows:
+            print(f"{str(rec.get('seq')):>5} {str(rec.get('state')):<10} "
+                  f"{str(rec.get('worker')):>6} "
+                  f"{str(rec.get('client')):>6} "
+                  f"{str(rec.get('requeues')):>8}")
+
+
+def _report_data(data: Dict) -> bool:
+    """The ``/data`` endpoint rendered: dispatcher chunk accounting,
+    per-worker liveness/lease counts, and the lease table rows that are
+    not yet acked (the interesting ones post-mortem)."""
+    if not data.get("attached"):
+        return False
+    if "error" in data:
+        print(f"== data service: dispatcher error: {data['error']} ==")
+        return True
+    chunks = data.get("chunks", {})
+    print("== data service ==")
+    print("chunks: total=%s queued=%s leased=%s delivered=%s acked=%s | "
+          "requeued=%s rejects=%s dup_acks=%s"
+          % (chunks.get("total"), chunks.get("queued"),
+             chunks.get("leased"), chunks.get("delivered"),
+             chunks.get("acked"), data.get("requeued"),
+             data.get("rejects"), data.get("duplicate_acks")))
+    workers = data.get("workers", {})
+    if workers:
+        print(f"{'worker':>6} {'addr':<22} {'live':>5} {'lag_s':>7} "
+              f"{'leased':>6}")
+        for wid, info in sorted(workers.items(), key=lambda kv: kv[0]):
+            print(f"{wid:>6} {str(info.get('addr')):<22} "
+                  f"{str(info.get('live')):>5} {str(info.get('lag_s')):>7} "
+                  f"{str(info.get('leased')):>6}")
+    stuck = [row for row in data.get("lease_table", [])
+             if row.get("state") != "acked" or row.get("requeues")]
+    if stuck:
+        print(f"{'seq':>5} {'state':<10} {'worker':>6} {'client':>6} "
+              f"{'requeues':>8}")
+        for row in stuck:
+            print(f"{str(row.get('seq')):>5} {str(row.get('state')):<10} "
+                  f"{str(row.get('worker')):>6} "
+                  f"{str(row.get('client')):>6} "
+                  f"{str(row.get('requeues')):>8}")
+    return True
 
 
 def _stage_table(events: List[Dict]) -> Dict[str, Dict[int, float]]:
@@ -274,6 +344,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("== obs-top (one frame) ==")
                 print(render_table(rows, world_version=wv))
                 reported = True
+        data = _fetch(args.status, "/data")
+        if data is not None:
+            reported = _report_data(data) or reported
         trace_obj = _fetch(args.status, "/trace")
         if trace_obj is not None:
             reported = _report_trace(trace_obj) or reported
@@ -281,6 +354,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         dumps = _load_flightrecs(args.flightrec)
         if dumps:
             _report_flightrecs(dumps)
+            _report_reassignments(dumps)
             reported = True
     if args.trace:
         trace_obj = _load_trace(args.trace)

@@ -10,9 +10,16 @@ Usage::
     python -m dmlc_tpu.tools serve <uri> [--host H] [--port P]
         [--part K --nparts N] [--format auto|libsvm|libfm|csv|recordio]
         [--nthread N] [--grace SECS] [--linger]
+    python -m dmlc_tpu.tools serve --dispatcher HOST:PORT [--host H]
+        [--port P] [--nthread N] [--grace SECS]
 
 ``--part/--nparts`` serve one InputSplit part (static sharding: one serve
 host per part; within a part, consumers still shard dynamically).
+
+``--dispatcher`` joins the fault-tolerant fleet instead: no URI — the
+worker registers with a running ``dispatch`` process (data/dispatcher.py),
+heartbeats it, and parses whichever chunks it leases; killing the process
+mid-epoch is safe (its leases requeue to surviving workers).
 
 Prints ``serving HOST PORT`` on stdout once listening. Exits when the
 stream is exhausted and post-drain delivery goes silent for ``--grace``
@@ -34,7 +41,10 @@ from dmlc_tpu.utils.logging import check
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("uri")
+    ap.add_argument("uri", nargs="?", default=None)
+    ap.add_argument("--dispatcher", default=None, metavar="HOST:PORT",
+                    help="join a data-dispatcher fleet as a worker "
+                         "instead of serving one URI")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--part", type=int, default=0)
@@ -49,12 +59,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--linger", action="store_true",
                     help="keep serving end-of-stream to late consumers")
     args = ap.parse_args(argv)
+    check((args.uri is None) != (args.dispatcher is None),
+          "serve takes exactly one of <uri> or --dispatcher")
     check(0 <= args.part < args.nparts, "bad part %d/%d (parts are "
           "0-based)", args.part, args.nparts)
 
-    parser = create_parser(args.uri, args.part, args.nparts,
-                           data_format=args.format, nthread=args.nthread)
-    svc = BlockService(parser, host=args.host, port=args.port)
+    if args.dispatcher is not None:
+        svc = BlockService(dispatcher=args.dispatcher, host=args.host,
+                           port=args.port, nthread=args.nthread)
+    else:
+        parser = create_parser(args.uri, args.part, args.nparts,
+                               data_format=args.format,
+                               nthread=args.nthread)
+        svc = BlockService(parser, host=args.host, port=args.port)
     host, port = svc.address
     print(f"serving {host} {port}", flush=True)
     try:

@@ -393,7 +393,7 @@ class RabitTracker:
             self.status = obs_plane.StatusServer(self.plane, port=sp)
             self.status.start()
             logger.info("status server on http://%s:%d (/healthz /workers "
-                        "/metrics /trace)", host_ip, self.status.port)
+                        "/metrics /trace /data)", host_ip, self.status.port)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, object]:
@@ -409,6 +409,13 @@ class RabitTracker:
             envs["DMLC_TPU_STATUS_URI"] = "%s:%d" % (
                 self.host_ip, self.status.port)
         return envs
+
+    def attach_data_dispatcher(self, dispatcher) -> None:
+        """Wire a :class:`~dmlc_tpu.data.dispatcher.DataDispatcher` into
+        this tracker's status plane so ``/data`` serves its live
+        worker/lease/requeue snapshot (a no-op when the plane is the
+        shared no-op plane — no status server, nothing to serve)."""
+        self.plane.set_data_provider(dispatcher.snapshot)
 
     # ---- heartbeat satellite -------------------------------------------
     def _note_heartbeat(self, rank: int, payload: str) -> None:

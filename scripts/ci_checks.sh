@@ -41,4 +41,46 @@ if rc != 0:
 print("ci_checks: obs-top smoke OK")
 EOF
 
+# dispatcher-failover smoke: a 2-worker data fleet loses one worker to
+# an injected crash mid-epoch; the lease table must still drain every
+# chunk exactly once (requeue >= 1 proves the reassignment path ran).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import sys, tempfile, os
+
+from dmlc_tpu import resilience
+from dmlc_tpu.data import BlockService, DataDispatcher, RemoteBlockParser
+
+fd, path = tempfile.mkstemp(suffix=".svm")
+with os.fdopen(fd, "w") as fh:
+    for i in range(20):
+        fh.write("%d 1:%d\n" % (i % 2, i))
+try:
+    resilience.reset()
+    resilience.configure("service.worker_crash:nth=1")
+    with DataDispatcher(path, nchunks=4, lease_s=1.0,
+                        dead_after_s=0.75) as disp:
+        workers = [BlockService(dispatcher=disp.address, nthread=1)
+                   for _ in range(2)]
+        try:
+            p = RemoteBlockParser(disp.address, dispatcher=True)
+            rows = sum(len(b) for b in p)
+            p.close()
+            ok = disp.join(timeout=30)
+            snap = disp.snapshot()
+        finally:
+            for svc in workers:
+                svc.close()
+    if not ok or rows != 20:
+        sys.exit("ci_checks: dispatcher smoke lost rows (%d/20, ok=%s)"
+                 % (rows, ok))
+    if snap["chunks"]["acked"] != snap["chunks"]["total"]:
+        sys.exit("ci_checks: lease table not drained: %r" % (snap,))
+    if snap["requeued"] < 1:
+        sys.exit("ci_checks: the injected crash never forced a requeue")
+finally:
+    resilience.reset()
+    os.unlink(path)
+print("ci_checks: dispatcher failover smoke OK")
+EOF
+
 echo "ci_checks: all checks passed"

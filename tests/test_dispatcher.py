@@ -1,0 +1,469 @@
+"""Fault-tolerant data-service control plane (data/dispatcher.py): the
+lease table's exactly-once state machine, worker liveness, requeue on
+expiry/death, the /data status endpoint, and the obs-report rendering of
+reassignment events.
+
+End-to-end chaos (kill a data worker mid-epoch, bit-identical weights)
+lives in tests/test_chaos.py; these tests exercise the dispatcher's RPC
+surface and bookkeeping directly.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import resilience
+from dmlc_tpu.data import BlockService, DataDispatcher, RemoteBlockParser
+from dmlc_tpu.data.dispatcher import DispatcherClient, dispatcher_address
+
+ROWS = 40
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+@pytest.fixture()
+def svm_file(tmp_path):
+    path = tmp_path / "d.svm"
+    with open(path, "w") as fh:
+        for i in range(ROWS):
+            fh.write(f"{i % 3} 1:{i} 2:{2 * i}\n")
+    return str(path)
+
+
+class TestLeaseTable:
+    def test_lease_lifecycle_exactly_once(self, svm_file):
+        """queued -> leased -> delivered -> acked by hand over the RPC
+        surface; EOF once nothing is queued, join() once all acked."""
+        with DataDispatcher(svm_file, nchunks=4) as d:
+            cli = DispatcherClient(d.address)
+            wid = cli.call({"op": "register",
+                            "addr": ("127.0.0.1", 1)})["worker_id"]
+            cid = cli.call({"op": "client"})["client_id"]
+            seqs = []
+            for _ in range(4):
+                chunk = cli.call({"op": "lease", "worker": wid})["chunk"]
+                seqs.append(chunk["seq"])
+                assert chunk["uri"] == svm_file and chunk["nparts"] == 4
+                assert cli.call({"op": "recv", "client": cid,
+                                 "seq": chunk["seq"]})["ok"]
+            assert seqs == [0, 1, 2, 3]  # lowest-seq-first determinism
+            # all delivered, none acked: lease says EOF (an explicit-ack
+            # consumer may hold rows arbitrarily long), join() does not
+            assert cli.call({"op": "lease", "worker": wid}).get("eof")
+            assert not d.join(timeout=0.05)
+            for seq in seqs:
+                assert cli.call({"op": "ack", "client": cid,
+                                 "seq": seq})["ok"]
+            assert d.join(timeout=5)
+            snap = d.snapshot()
+            assert snap["chunks"] == {"total": 4, "queued": 0, "leased": 0,
+                                      "delivered": 0, "acked": 4}
+            assert snap["requeued"] == 0 and snap["rejects"] == 0
+            cli.close()
+
+    def test_lease_expiry_requeues_to_next_worker(self, svm_file):
+        """A worker that overruns its lease loses the chunk: the next
+        lease hands the SAME seq to whoever asks, requeues is counted."""
+        with DataDispatcher(svm_file, nchunks=1, lease_s=0.1) as d:
+            cli = DispatcherClient(d.address)
+            w0 = cli.call({"op": "register",
+                           "addr": ("127.0.0.1", 1)})["worker_id"]
+            w1 = cli.call({"op": "register",
+                           "addr": ("127.0.0.1", 2)})["worker_id"]
+            first = cli.call({"op": "lease", "worker": w0})["chunk"]
+            time.sleep(0.25)  # let the lease expire
+            again = cli.call({"op": "lease", "worker": w1})["chunk"]
+            assert again["seq"] == first["seq"]
+            assert again["flow"] == first["flow"]  # one flow per chunk,
+            # carried through the reassignment (the trace spans workers)
+            snap = d.snapshot()
+            assert snap["requeued"] == 1
+            assert snap["lease_table"][0]["requeues"] == 1
+            assert snap["lease_table"][0]["worker"] == w1
+            cli.close()
+
+    def test_duplicate_delivery_rejected(self, svm_file):
+        """Two consumers reporting the same seq: first reporter wins,
+        the second is told to drop its copy (exactly-once)."""
+        with DataDispatcher(svm_file, nchunks=1) as d:
+            cli = DispatcherClient(d.address)
+            wid = cli.call({"op": "register",
+                            "addr": ("127.0.0.1", 1)})["worker_id"]
+            c0 = cli.call({"op": "client"})["client_id"]
+            c1 = cli.call({"op": "client"})["client_id"]
+            seq = cli.call({"op": "lease",
+                            "worker": wid})["chunk"]["seq"]
+            assert not cli.call({"op": "recv", "client": c0,
+                                 "seq": seq}).get("reject")
+            # same consumer re-reporting (a hedged fetch) is fine...
+            assert not cli.call({"op": "recv", "client": c0,
+                                 "seq": seq}).get("reject")
+            # ...a different consumer is not
+            assert cli.call({"op": "recv", "client": c1,
+                             "seq": seq}).get("reject")
+            snap = d.snapshot()
+            assert snap["rejects"] == 1
+            # an ack after the fact is authoritative, a second is dup
+            assert cli.call({"op": "ack", "client": c0, "seq": seq})["ok"]
+            assert cli.call({"op": "ack", "client": c1,
+                             "seq": seq}).get("dup")
+            assert d.snapshot()["duplicate_acks"] == 1
+            cli.close()
+
+    def test_dead_worker_chunks_requeue_and_registration_revoked(
+            self, svm_file):
+        """Heartbeat silence past dead_after_s: the worker's leases
+        requeue, it drops out of the `workers` list, and a zombie lease
+        attempt is refused."""
+        with DataDispatcher(svm_file, nchunks=2, lease_s=30.0,
+                            dead_after_s=0.2) as d:
+            cli = DispatcherClient(d.address)
+            w0 = cli.call({"op": "register",
+                           "addr": ("127.0.0.1", 1)})["worker_id"]
+            reply = cli.call({"op": "register", "addr": ("127.0.0.1", 2)})
+            w1 = reply["worker_id"]
+            assert reply["heartbeat_s"] < d.dead_after_s
+            cli.call({"op": "lease", "worker": w0})
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                time.sleep(0.1)
+                # w1 heartbeats (each beat runs the expiry scan); w0 is
+                # silent and crosses the death threshold
+                cli.call({"op": "heartbeat", "worker": w1})
+                if d.snapshot()["requeued"]:
+                    break
+            snap = d.snapshot()
+            assert snap["requeued"] == 1
+            assert snap["workers"][str(w0)]["live"] is False
+            assert snap["workers"][str(w1)]["live"] is True
+            live = cli.call({"op": "workers"})["workers"]
+            assert [w[2] for w in live] == [w1]
+            assert cli.call({"op": "lease", "worker": w0}).get("dead")
+            cli.close()
+
+    def test_delivered_chunk_survives_lease_expiry_while_holder_lives(
+            self, svm_file):
+        """A DELIVERED chunk past its deadline must NOT requeue while the
+        holding client's dispatcher session is alive: the consumer
+        already has the rows (it may sit in a minutes-long jit compile
+        before acking), and redelivery would serve them twice. Once the
+        holder disconnects, the deadline applies and the chunk requeues."""
+        with DataDispatcher(svm_file, nchunks=1, lease_s=0.1) as d:
+            holder = DispatcherClient(d.address)
+            aux = DispatcherClient(d.address)  # stats-only: never binds a
+            # client id, so it must not keep the chunk alive
+            wid = holder.call({"op": "register",
+                               "addr": ("127.0.0.1", 1)})["worker_id"]
+            cid = holder.call({"op": "client"})["client_id"]
+            seq = holder.call({"op": "lease", "worker": wid})["chunk"]["seq"]
+            assert not holder.call({"op": "recv", "client": cid,
+                                    "seq": seq}).get("reject")
+            time.sleep(0.3)  # well past lease_s
+            snap = aux.call({"op": "stats"})  # stats runs the expiry scan
+            assert snap["chunks"]["delivered"] == 1
+            assert snap["requeued"] == 0
+            holder.close()  # the holder crashes: its session drops
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                snap = aux.call({"op": "stats"})
+                if snap["requeued"]:
+                    break
+                time.sleep(0.05)
+            assert snap["requeued"] == 1
+            assert snap["chunks"]["queued"] == 1
+            aux.close()
+
+    def test_register_retry_is_idempotent_by_addr(self, svm_file):
+        """register rides the retrying DispatcherClient: a re-sent
+        register (lost reply) must return the SAME worker id, not mint
+        an orphan that never heartbeats and later fires worker_dead."""
+        with DataDispatcher(svm_file, nchunks=1) as d:
+            cli = DispatcherClient(d.address)
+            first = cli.call({"op": "register", "addr": ("127.0.0.1", 77)})
+            again = cli.call({"op": "register", "addr": ("127.0.0.1", 77)})
+            assert again["worker_id"] == first["worker_id"]
+            other = cli.call({"op": "register", "addr": ("127.0.0.1", 78)})
+            assert other["worker_id"] != first["worker_id"]
+            snap = d.snapshot()
+            assert len(snap["workers"]) == 2
+            assert all(w["live"] for w in snap["workers"].values())
+            cli.close()
+
+    def test_finished_connections_are_pruned(self, svm_file):
+        """Closed peer connections must not accumulate in the
+        dispatcher's bookkeeping for the life of the epoch (fault storms
+        reconnect DispatcherClients many times)."""
+        with DataDispatcher(svm_file, nchunks=1) as d:
+            for _ in range(5):
+                cli = DispatcherClient(d.address)
+                assert cli.call({"op": "stats"})["ok"]
+                cli.close()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and (
+                    d._conns or any(t.is_alive() for t in d._threads)):
+                time.sleep(0.05)
+            assert not d._conns
+            assert not any(t.is_alive() for t in d._threads)
+
+    def test_unknown_op_and_unknown_seq_are_errors_not_crashes(
+            self, svm_file):
+        with DataDispatcher(svm_file, nchunks=1) as d:
+            cli = DispatcherClient(d.address)
+            assert not cli.call({"op": "frobnicate"})["ok"]
+            assert not cli.call({"op": "ack", "client": 0, "seq": 99})["ok"]
+            # the connection survives error replies
+            assert cli.call({"op": "stats"})["ok"]
+            cli.close()
+
+    def test_dispatcher_address_forms(self):
+        assert dispatcher_address("10.0.0.1:9000") == ("10.0.0.1", 9000)
+        assert dispatcher_address(("h", 1)) == ("h", 1)
+        from dmlc_tpu.utils.logging import DMLCError
+
+        with pytest.raises(DMLCError):
+            dispatcher_address("no-port-here")
+
+
+class TestStatusPlane:
+    def test_data_endpoint_serves_live_lease_view(self, svm_file):
+        from dmlc_tpu.obs.plane import StatusPlane, StatusServer
+
+        plane = StatusPlane()
+        server = StatusServer(plane, port=0)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/data"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                before = json.loads(resp.read().decode())
+            assert before == {"attached": False}
+            with DataDispatcher(svm_file, nchunks=3, plane=plane) as d:
+                cli = DispatcherClient(d.address)
+                wid = cli.call({"op": "register",
+                                "addr": ("127.0.0.1", 7)})["worker_id"]
+                cli.call({"op": "lease", "worker": wid})
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    view = json.loads(resp.read().decode())
+                cli.close()
+            assert view["attached"] is True
+            assert view["chunks"] == {"total": 3, "queued": 2, "leased": 1,
+                                      "delivered": 0, "acked": 0}
+            assert view["workers"][str(wid)]["leased"] == 1
+            assert len(view["lease_table"]) == 3
+        finally:
+            server.close()
+
+
+class TestObsReport:
+    def test_reassignment_table_from_flightrec(self, tmp_path, capsys):
+        """obs-report --flightrec renders every service.requeue /
+        service.worker_dead event the dispatcher recorded."""
+        from dmlc_tpu.tools import obs_report
+
+        dump = {
+            "rank": 0, "reason": "manual",
+            "records": [
+                {"kind": "service.worker_dead", "worker": 1,
+                 "addr": "127.0.0.1:4242"},
+                {"kind": "service.requeue", "seq": 5, "state": "leased",
+                 "worker": 1, "client": -1, "requeues": 1},
+                {"kind": "service.requeue", "seq": 5, "state": "delivered",
+                 "worker": 2, "client": 0, "requeues": 2},
+            ],
+        }
+        (tmp_path / "flightrec-rank0.json").write_text(json.dumps(dump))
+        assert obs_report.main(["--flightrec", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "== data service reassignments ==" in out
+        assert "worker 1 (127.0.0.1:4242) declared dead" in out
+        assert out.count("    5 ") == 2  # both requeue rows rendered
+        assert "leased" in out and "delivered" in out
+
+    def test_data_view_rendering(self, capsys):
+        from dmlc_tpu.tools.obs_report import _report_data
+
+        assert not _report_data({"attached": False})
+        assert _report_data({
+            "attached": True,
+            "chunks": {"total": 2, "queued": 0, "leased": 1, "delivered": 0,
+                       "acked": 1},
+            "requeued": 3, "rejects": 1, "duplicate_acks": 0,
+            "workers": {"0": {"addr": "127.0.0.1:1", "live": True,
+                              "lag_s": 0.01, "leased": 1}},
+            "lease_table": [
+                {"seq": 0, "state": "acked", "worker": -1, "client": 0,
+                 "requeues": 0},
+                {"seq": 1, "state": "leased", "worker": 0, "client": -1,
+                 "requeues": 3},
+            ],
+        })
+        out = capsys.readouterr().out
+        assert "requeued=3" in out
+        # acked-with-no-requeues rows are elided; the stuck row shows
+        assert "acked" not in out.split("chunks:")[1].split("\n")[1]
+        assert "leased" in out
+
+
+class TestFleetIntegration:
+    def test_device_feed_explicit_ack_drains_lease_table(self, svm_file):
+        """DeviceFeed over a dispatcher-mode RemoteBlockParser switches
+        the parser to explicit acks and acks every chunk as its batches
+        are consumed: end of epoch, the lease table is fully acked."""
+        from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+        spec = BatchSpec(batch_size=8, layout="dense", num_features=3)
+        with DataDispatcher(svm_file, nchunks=4) as d:
+            with BlockService(dispatcher=d.address, nthread=1):
+                parser = RemoteBlockParser(d.address, dispatcher=True)
+                feed = DeviceFeed(parser, spec)
+                rows = sum(np.asarray(b["x"]).shape[0] for b in feed)
+                feed.close()
+                assert rows == ROWS
+                assert d.join(timeout=10), d.snapshot()
+            snap = d.snapshot()
+        assert snap["chunks"]["acked"] == snap["chunks"]["total"] == 4
+        assert snap["rejects"] == 0
+
+    def test_slow_explicit_ack_consumer_never_served_twice(self, svm_file):
+        """An explicit-ack consumer (the DeviceFeed shape) holds every
+        delivered chunk far past its lease before acking. With its
+        dispatcher session alive the whole time, nothing may requeue and
+        nothing may arrive twice — the exactly-once guarantee the lease
+        deadline must not break for slow-but-live consumers."""
+        with DataDispatcher(svm_file, nchunks=4, lease_s=0.2) as d:
+            with BlockService(dispatcher=d.address, nthread=1):
+                parser = RemoteBlockParser(d.address, dispatcher=True)
+                parser.set_explicit_ack()
+                blocks = []
+                while True:
+                    b = parser.next_block()
+                    if b is None:
+                        break
+                    blocks.append(b)
+                time.sleep(0.6)  # hold all chunks well past lease_s
+                for b in blocks:
+                    parser.ack(b.seq_id)
+                parser.close()
+                assert d.join(timeout=10), d.snapshot()
+            snap = d.snapshot()
+        vals = sorted(v for b in blocks
+                      for v in np.asarray(b.value)[::2].tolist())
+        assert vals == [float(i) for i in range(ROWS)]
+        assert len(blocks) == 4  # one delivery per chunk, no duplicates
+        assert snap["requeued"] == 0 and snap["rejects"] == 0
+        assert snap["chunks"]["acked"] == 4
+
+    def test_client_drops_duplicate_seq_redelivery(self):
+        """Unit pin on the consumer half of exactly-once: a seq this
+        client already accepted (a lease requeued while its dispatcher
+        session blinked, then re-served to it) is receipt-reported —
+        re-marking the lease table delivered-to-us — but the duplicate
+        copy is dropped, never surfaced as a second block."""
+        from dmlc_tpu import obs
+
+        p = RemoteBlockParser.__new__(RemoteBlockParser)
+        p._ended = False
+        p._closed = False
+        p._inflight = False
+        p._explicit_ack = True
+        p._unacked = []
+        p._seen = set()
+        p.bytes_read = 0
+        p._m_read = obs.registry().counter(
+            "dmlc_io_read_bytes_total", "payload bytes ingested by source",
+            source="service")
+        calls = []
+
+        class _Dispatch:
+            def call(self, obj, site="service.dispatch"):
+                calls.append(dict(obj))
+                return {"ok": True}
+
+        p._dispatch = _Dispatch()
+        p._client_id = 0
+
+        def frame():
+            return {
+                "seq": np.asarray([0], dtype=np.int64),
+                "offset": np.asarray([0, 1], dtype=np.int64),
+                "label": np.asarray([1.0]),
+                "index": np.asarray([1], dtype=np.int64),
+                "value": np.asarray([2.0]),
+            }
+
+        frames = [frame(), frame(), None]
+        p._fetch_arrays = lambda: frames.pop(0)
+        first = p.next_block()
+        assert first is not None and first.seq_id == 0
+        assert p.next_block() is None  # the duplicate is skipped, EOS
+        assert p._unacked == [0]  # consumed once, owed exactly one ack
+        assert [c["op"] for c in calls] == ["recv", "recv"]
+
+    def test_two_workers_share_one_epoch(self, svm_file):
+        """Both registered workers take leases; the consumer sees every
+        row exactly once across the fleet."""
+        with DataDispatcher(svm_file, nchunks=8) as d:
+            with BlockService(dispatcher=d.address, nthread=1), \
+                    BlockService(dispatcher=d.address, nthread=1):
+                parser = RemoteBlockParser(d.address, dispatcher=True)
+                vals = []
+                for block in parser:
+                    vals.extend(np.asarray(block.value)[::2].tolist())
+                parser.close()
+                assert d.join(timeout=10), d.snapshot()
+            snap = d.snapshot()
+        assert sorted(vals) == [float(i) for i in range(ROWS)]
+        assert snap["chunks"]["acked"] == 8
+        # both workers served at least one chunk each epoch is not
+        # guaranteed (one can win every race), but both must be live
+        assert all(w["live"] for w in snap["workers"].values())
+
+
+def test_dispatch_cli_end_to_end(svm_file):
+    """python -m dmlc_tpu.tools dispatch + serve --dispatcher: the CLI
+    fleet drains one epoch and both processes exit cleanly."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    disp = subprocess.Popen(
+        [sys.executable, "-m", "dmlc_tpu.tools", "dispatch", svm_file,
+         "--nchunks", "4", "--host", "127.0.0.1"],
+        stdout=subprocess.PIPE, text=True, cwd=repo, env=env)
+    serve = None
+    try:
+        m = re.match(r"dispatching (\S+) (\d+)", disp.stdout.readline())
+        assert m, "dispatch CLI did not announce its address"
+        addr = f"{m.group(1)}:{m.group(2)}"
+        serve = subprocess.Popen(
+            [sys.executable, "-m", "dmlc_tpu.tools", "serve",
+             "--dispatcher", addr, "--host", "127.0.0.1",
+             "--nthread", "1", "--grace", "5"],
+            stdout=subprocess.PIPE, text=True, cwd=repo, env=env)
+        m = re.match(r"serving (\S+) (\d+)", serve.stdout.readline())
+        assert m, "serve CLI did not announce its address"
+        p = RemoteBlockParser(addr, dispatcher=True)
+        rows = sum(len(b) for b in p)
+        p.close()
+        assert rows == ROWS
+        disp.wait(timeout=30)
+        serve.wait(timeout=30)
+        assert disp.returncode == 0 and serve.returncode == 0
+        out = disp.stdout.read()
+        assert "dispatched 4 chunks (4 acked, 0 requeued" in out
+    finally:
+        for proc in (disp, serve):
+            if proc is not None and proc.poll() is None:
+                proc.kill()

@@ -210,6 +210,164 @@ class TestBlockService:
         np.testing.assert_array_equal(b.qid, [7, 8])
 
 
+class TestFaultTolerance:
+    """Satellites of the fault-tolerant service PR: bounded pending
+    stash, truncated-frame failover, graceful in-flight close."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        from dmlc_tpu import resilience
+
+        resilience.reset()
+        yield
+        resilience.reset()
+
+    def test_pending_stash_bounded_requeues_metered_apart_from_drops(
+            self, svm_file, monkeypatch):
+        """The pending stash caps at DMLC_TPU_DATA_PENDING_CAP: stashes
+        under the cap are requeues (rows stay in the epoch), overflow
+        past the backpressure window is a drop — metered separately."""
+        from dmlc_tpu.data import service
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.io import create_input_split
+
+        monkeypatch.setattr(service, "_PENDING_WAIT_S", 0.05)
+        monkeypatch.setenv("DMLC_TPU_DATA_PENDING_CAP", "2")
+        split = create_input_split(svm_file, 0, 1, "text", threaded=False)
+        split.hint_chunk_size(2048)
+        with BlockService(LibSVMParser(split, nthread=1)) as svc:
+            blocks = [svc._next_block_arrays() for _ in range(3)]
+            for arrays in blocks:
+                svc._stash_undelivered(arrays)
+            assert svc.blocks_requeued == 2
+            assert svc.blocks_dropped == 1  # the third overflowed the cap
+            dropped_rows = len(blocks[2]["offset"]) - 1
+            p = RemoteBlockParser(svc.address)
+            rows = sum(len(b) for b in p)
+            p.close()
+        # the two requeued blocks redelivered; only the drop's rows left
+        assert rows == ROWS - dropped_rows
+
+    def test_truncated_frame_fails_over_no_row_lost(self, svm_file):
+        """An injected service.send fault cuts a consumer off mid-frame.
+        The client classifies the truncated frame as transient transport
+        failure, re-dials, and the server's redelivery stash keeps the
+        half-sent block in the epoch: every row arrives exactly once."""
+        from dmlc_tpu import resilience
+
+        resilience.configure("service.send:nth=1")
+        with BlockService(svm_file, nthread=1) as svc:
+            p = RemoteBlockParser(svc.address)
+            vals = []
+            for block in p:
+                vals.extend(np.asarray(block.value)[::2].tolist())
+            p.close()
+        assert len(resilience.injector().fired) == 1
+        assert sorted(vals) == [i + 0.25 for i in range(ROWS)]
+        assert svc.blocks_requeued >= 1  # the cut-off block was stashed
+        assert svc.blocks_dropped == 0
+
+    def test_truncated_frame_raises_transient_oserror(self, svm_file):
+        """The wire-level contract behind the failover: a mid-frame hangup
+        surfaces as TruncatedFrame, an OSError (transient), never a
+        garbled-unpack DMLCError (fatal)."""
+        import socket
+        import struct
+
+        from dmlc_tpu.data import TruncatedFrame
+        from dmlc_tpu.data.service import _recv_arrays
+
+        server = socket.socket()
+        server.bind(("127.0.0.1", 0))
+        server.listen(1)
+        client = socket.create_connection(server.getsockname())
+        conn, _ = server.accept()
+        try:
+            conn.sendall(struct.pack("<I", 3))  # field count, then hangup
+            conn.close()
+            with pytest.raises(TruncatedFrame):
+                _recv_arrays(client)
+        finally:
+            client.close()
+            server.close()
+
+    def test_vanished_consumer_after_full_frame_counted_unconfirmed(
+            self, svm_file):
+        """Legacy mode cannot prove delivery of a fully-sent frame whose
+        consumer dies before its next request (TCP gives no receipt, and
+        there is no ack ledger to requeue safely — redelivery could
+        duplicate rows the consumer did read). The frame must be counted
+        possibly-lost, NOT silently forgotten and NOT restashed."""
+        import socket
+        import struct
+        import time
+
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.data.service import _recv_arrays
+        from dmlc_tpu.io import create_input_split
+
+        split = create_input_split(svm_file, 0, 1, "text", threaded=False)
+        split.hint_chunk_size(2048)  # many blocks: the stream outlives
+        # the vanishing consumer's single pull
+        with BlockService(LibSVMParser(split, nthread=1)) as svc:
+            rude = socket.create_connection(svc.address)
+            rude.sendall(struct.pack("<I", 1))  # _REQ_NEXT
+            arrays = _recv_arrays(rude)  # read the FULL frame...
+            first_rows = len(arrays["offset"]) - 1
+            rude.close()  # ...then vanish without another request
+            deadline = time.monotonic() + 5
+            while (time.monotonic() < deadline
+                   and not svc.blocks_unconfirmed):
+                time.sleep(0.05)
+            assert svc.blocks_unconfirmed == 1
+            assert svc.blocks_requeued == 0  # delivery unknown: never
+            # restashed (it could duplicate) — counted instead
+            survivor = RemoteBlockParser(svc.address)
+            rows = sum(len(b) for b in survivor)
+            survivor.close()
+        # the unconfirmed frame's rows are exactly the ones missing
+        assert rows == ROWS - first_rows
+
+    def test_close_with_inflight_request_no_spurious_requeue(
+            self, svm_file):
+        """close() during an in-flight _REQ_NEXT drains the response
+        before hanging up: the server's send completes, so the block is
+        counted delivered — not stashed for redelivery (where it would
+        duplicate rows for the next consumer) and not dropped."""
+        import struct
+
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.io import create_input_split
+
+        split = create_input_split(svm_file, 0, 1, "text", threaded=False)
+        split.hint_chunk_size(2048)  # many blocks, so the stream outlives
+        # the quitter's pulls
+        with BlockService(LibSVMParser(split, nthread=1)) as svc:
+            p = RemoteBlockParser(svc.address)
+            first = p.next_block()
+            assert first is not None
+            seen = set(np.asarray(first.value)[::2].tolist())
+            # hand-roll the race: a request is on the wire, close() runs
+            # before the response is read
+            p._sock.sendall(struct.pack("<I", 1))  # _REQ_NEXT
+            p._inflight = True
+            p.close()
+            survivor = RemoteBlockParser(svc.address)
+            got = []
+            for b in survivor:
+                got.extend(np.asarray(b.value)[::2].tolist())
+            survivor.close()
+        assert svc.blocks_requeued == 0 and svc.blocks_dropped == 0
+        # the in-flight block was consumed by the drain (counted
+        # delivered), so the survivor sees each remaining row exactly
+        # once and the drained block's rows exactly zero times
+        assert not seen.intersection(got)
+        assert len(got) == len(set(got))
+        missing = set(i + 0.25 for i in range(ROWS)) - seen - set(got)
+        assert 0 < len(missing) < ROWS - len(first)  # exactly the one
+        # drained block's rows are absent — not redelivered
+
+
 def _spawn_serve(svm_file, *extra_args):
     """Launch the serve CLI; → (proc, (host, port))."""
     import os

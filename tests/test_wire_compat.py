@@ -1,16 +1,27 @@
-"""Wire-compatibility proof: our SocketEngine workers rendezvous through
-the REFERENCE's tracker.py (RabitTracker from
-/root/reference/tracker/dmlc_tracker) and run collectives.
+"""Wire-compatibility proofs.
 
-Round-1 verdict asked for exactly this: the rendezvous protocol in
-dmlc_tpu.tracker.rendezvous claims wire compatibility with the reference
-tracker (magic 0xff99, framed ints, goodset/badset brokering, tree+ring
-link maps — tracker.py:58-135); running the reference's own tracker binary
-against our workers is the proof. The reference tracker is executed as a
-black box (study of behavior, not code reuse)."""
+1. Tracker rendezvous: our SocketEngine workers rendezvous through the
+   REFERENCE's tracker.py (RabitTracker from
+   /root/reference/tracker/dmlc_tracker) and run collectives.
+   Round-1 verdict asked for exactly this: the rendezvous protocol in
+   dmlc_tpu.tracker.rendezvous claims wire compatibility with the
+   reference tracker (magic 0xff99, framed ints, goodset/badset
+   brokering, tree+ring link maps — tracker.py:58-135); running the
+   reference's own tracker binary against our workers is the proof. The
+   reference tracker is executed as a black box (study of behavior, not
+   code reuse).
+
+2. Block-service framing: the fault-tolerant service's new per-frame
+   fields (``seq``, ``flow``) ride the name-addressed response format,
+   so a lease-unaware legacy client keeps working against a
+   dispatcher-mode service — proven with a hand-rolled decoder pinned
+   to the PRE-lease wire spec (an independent copy, so a format change
+   breaks the test, not silently both sides)."""
 
 import multiprocessing as mp
 import os
+import socket
+import struct
 import sys
 
 import numpy as np
@@ -18,7 +29,7 @@ import pytest
 
 REFERENCE_TRACKER_DIR = "/root/reference/tracker"
 
-pytestmark = pytest.mark.skipif(
+_needs_reference_tracker = pytest.mark.skipif(
     not os.path.isdir(os.path.join(REFERENCE_TRACKER_DIR, "dmlc_tracker")),
     reason="reference tracker not available",
 )
@@ -62,6 +73,7 @@ def _worker_main(uri, port, world, results):
         engine.shutdown()
 
 
+@_needs_reference_tracker
 @pytest.mark.parametrize("world", [2, 4, 8])
 def test_our_workers_against_reference_tracker(world):
     RefTracker = _load_reference_tracker()
@@ -91,3 +103,110 @@ def test_our_workers_against_reference_tracker(world):
     assert not tracker.thread.is_alive()
     assert sorted(oks) == list(range(world))
     assert all(oks.values()), oks
+
+
+# ---------------------------------------------------------------------------
+# block-service framing: a lease-unaware legacy client vs the new
+# dispatcher-mode service
+# ---------------------------------------------------------------------------
+
+class _LegacyBlockClient:
+    """A consumer pinned to the pre-lease wire format, hand-rolled.
+
+    Speaks exactly the original protocol: u32 request (1=NEXT, 2=CLOSE);
+    response = u32 field count (0 = end of stream, 0xFFFFFFFF = error),
+    then per field u8 name-len + name, u8 dtype-len + dtype, u64
+    byte-len + bytes. It predates ``seq``/``flow``, so it demonstrates
+    the compatibility contract: unknown name-addressed fields are
+    decodable and ignorable — never a framing break."""
+
+    def __init__(self, address):
+        self._sock = socket.create_connection(address, timeout=30)
+
+    def _recv(self, n):
+        buf = b""
+        while len(buf) < n:
+            piece = self._sock.recv(n - len(buf))
+            assert piece, "legacy client: connection died mid-frame"
+            buf += piece
+        return buf
+
+    def next_fields(self):
+        self._sock.sendall(struct.pack("<I", 1))  # NEXT
+        (nfields,) = struct.unpack("<I", self._recv(4))
+        assert nfields != 0xFFFFFFFF, "service sent an error frame"
+        if nfields == 0:
+            return None
+        out = {}
+        for _ in range(nfields):
+            (nlen,) = struct.unpack("<B", self._recv(1))
+            name = self._recv(nlen).decode()
+            (dlen,) = struct.unpack("<B", self._recv(1))
+            dtype = np.dtype(self._recv(dlen).decode())
+            (nbytes,) = struct.unpack("<Q", self._recv(8))
+            out[name] = np.frombuffer(self._recv(nbytes), dtype=dtype)
+        return out
+
+    def close(self):
+        try:
+            self._sock.sendall(struct.pack("<I", 2))  # CLOSE
+        finally:
+            self._sock.close()
+
+
+def test_legacy_client_against_dispatcher_mode_service(tmp_path):
+    """The legacy decoder pulls a full epoch from a NEW dispatcher-mode
+    worker: every frame decodes cleanly (the added ``seq``/``flow``
+    fields are just extra named fields), every row arrives exactly once.
+    The client cannot recv/ack, so it reads exactly ``nchunks`` frames
+    and closes — it never polls for EOS, which the lease table only
+    grants once chunks are delivered or acked (default generous leases
+    keep the undelivered chunks from requeuing into duplicates)."""
+    from dmlc_tpu.data import BlockService, DataDispatcher
+
+    rows = 40
+    path = tmp_path / "legacy.svm"
+    with open(path, "w") as fh:
+        for i in range(rows):
+            fh.write(f"{i % 3} 1:{i}\n")
+    nchunks = 4
+    with DataDispatcher(str(path), nchunks=nchunks) as disp:
+        with BlockService(dispatcher=disp.address, nthread=1) as svc:
+            cli = _LegacyBlockClient(svc.address)
+            vals, seqs = [], []
+            for _ in range(nchunks):
+                fields = cli.next_fields()
+                assert fields is not None
+                # the new fields are present and ignorable — a real
+                # legacy client would simply never look them up
+                assert "seq" in fields and fields["seq"].dtype == np.int64
+                seqs.append(int(fields["seq"][0]))
+                vals.extend(fields["value"].tolist())  # one feature/row:
+                # feature 1 carries the row id
+            cli.close()
+        snap = disp.snapshot()
+    assert sorted(vals) == [float(i) for i in range(rows)]
+    assert sorted(seqs) == list(range(nchunks))
+    # the epoch was fully served even though nothing was ever acked
+    assert snap["chunks"]["leased"] == nchunks
+    assert snap["requeued"] == 0
+
+
+def test_legacy_fields_unchanged_on_wire(tmp_path):
+    """Regression pin: the legacy one-URI service's frames carry the
+    SAME field names and dtypes as before the lease work (plus nothing
+    mandatory) — byte-level framing identical for old consumers."""
+    from dmlc_tpu.data import BlockService
+
+    path = tmp_path / "pin.svm"
+    with open(path, "w") as fh:
+        fh.write("1 1:0.5 2:1.5\n0 1:2.5 2:3.5\n")
+    with BlockService(str(path), nthread=1) as svc:
+        cli = _LegacyBlockClient(svc.address)
+        fields = cli.next_fields()
+        assert cli.next_fields() is None  # EOS frame: u32 zero, as ever
+        cli.close()
+    assert set(fields) >= {"offset", "label", "index", "value"}
+    assert "seq" not in fields  # legacy mode mints no sequence ids
+    np.testing.assert_array_equal(fields["label"], [1.0, 0.0])
+    np.testing.assert_allclose(fields["value"][::2], [0.5, 2.5])
