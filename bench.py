@@ -436,6 +436,100 @@ def _criteo_parse_sweep() -> dict:
     return {"probe_gbps": probe, "trials": best_runs}
 
 
+# parse-stage corpora, read/synthesized once per run and kept in memory so
+# every parse_only sweep times parse_chunk ALONE — no file I/O, no pipeline
+# threads, no per-sweep page-cache variance
+_PARSE_ONLY_CORPUS: dict = {}
+
+
+def _parse_only_corpora() -> dict:
+    if _PARSE_ONLY_CORPUS:
+        return _PARSE_ONLY_CORPUS
+    import numpy as np
+
+    def _chunks(raw: bytes, target: int) -> list:
+        out, pos = [], 0
+        while pos < len(raw):
+            cut = raw.rfind(b"\n", pos, pos + target) + 1
+            if cut <= pos:  # no newline in window: take the rest
+                cut = len(raw)
+            out.append(raw[pos:cut])
+            pos = cut
+        return out
+
+    with open(_ensure_data(), "rb") as fh:
+        svm = fh.read(64 << 20)
+    svm = svm[: svm.rfind(b"\n") + 1]
+    _PARSE_ONLY_CORPUS["libsvm"] = _chunks(svm, 8 << 20)
+
+    # dense CSV corpus, higgs-shaped (label + FEATURES columns), ~24 MB
+    rng = np.random.RandomState(11)
+    rows = []
+    for start in range(0, 120_000, 20_000):
+        labels = rng.randint(0, 2, size=20_000)
+        vals = rng.rand(20_000, FEATURES)
+        for i in range(20_000):
+            rows.append(
+                str(labels[i]) + ","
+                + ",".join(f"{v:.4f}" for v in vals[i])
+            )
+    csv = ("\n".join(rows) + "\n").encode()
+    _PARSE_ONLY_CORPUS["csv"] = _chunks(csv, 8 << 20)
+    return _PARSE_ONLY_CORPUS
+
+
+def _parse_only_sweep() -> dict:
+    """Parse-STAGE microbench: in-memory chunks through parse_chunk per
+    (format, backend), nothing else on the clock. The tier's trials (and
+    so parse_only_mbps) are the production libsvm path — native when the
+    core is loaded, else the python vector path; per-backend medians land
+    in ``formats`` as ``*_gbps`` and are lifted into extra for the sentry.
+    The python backends time a single chunk (they are 20-60 MB/s; the
+    point is tracking the ratio, not burning bench wall-clock)."""
+    from dmlc_tpu import native
+    from dmlc_tpu.data import vparse
+    from dmlc_tpu.data.parsers import _native_libsvm
+    from dmlc_tpu.data.row_block import RowBlockContainer
+
+    corpora = _parse_only_corpora()
+    probe = _host_probe()
+
+    def _time(chunks, fn):
+        mb = sum(len(c) for c in chunks) / (1 << 20)
+        runs = []
+        for _ in range(TRIALS + 1):  # first is warmup, dropped
+            t0 = time.time()
+            for chunk in chunks:
+                fn(chunk)
+            runs.append(round(mb / (time.time() - t0), 1))
+        return runs[1:]
+
+    formats: dict = {}
+    trials = None
+    native_on = native.available()
+    if native_on:
+        runs = _time(corpora["libsvm"], _native_libsvm)
+        trials = runs
+        formats["libsvm_native_gbps"] = round(
+            statistics.median(runs) / 1024, 3)
+        csv_runs = _time(
+            corpora["csv"], lambda c: native.parse_csv_chunk(c))
+        formats["csv_native_gbps"] = round(
+            statistics.median(csv_runs) / 1024, 3)
+    vec_runs = _time(
+        corpora["libsvm"][:1],
+        lambda c: vparse.parse_libsvm_vector(c, RowBlockContainer()),
+    )
+    formats["libsvm_vector_gbps"] = round(
+        statistics.median(vec_runs) / 1024, 3)
+    csv_vec = _time(corpora["csv"][:1], vparse.parse_csv_vector_table)
+    formats["csv_vector_gbps"] = round(statistics.median(csv_vec) / 1024, 3)
+    if trials is None:
+        trials = vec_runs
+    return {"probe_gbps": probe, "trials": trials, "formats": formats,
+            "native": native_on}
+
+
 def _bench_criteo_sgd() -> dict:
     """Criteo sparse END-TO-END on the attached device: parse → sharded-COO
     staging → csr train step (segment-sum SpMV grads over the 2^20 feature
@@ -819,6 +913,9 @@ def _remote_sweep(path: str) -> dict:
 # every tier median + device/collective status the verdict reads
 _COMPACT_KEYS = (
     "recordio_ingest_mbps", "criteo_like_parse_mbps",
+    "parse_only_mbps", "parse_only_libsvm_native_gbps",
+    "parse_only_libsvm_vector_gbps", "parse_only_csv_native_gbps",
+    "parse_only_csv_vector_gbps",
     "criteo_recordio_ingest_mbps", "remote_ingest_mbps",
     "feed_dense_mbps", "sgd_e2e_mbps", "sgd_e2e_serial_mbps",
     "sgd_e2e_pipelined_mbps", "sgd_e2e_cached_mbps",
@@ -1032,6 +1129,7 @@ def main() -> None:
     host_tiers = {
         "recordio_ingest": lambda: _recordio_sweep(path),
         "criteo_like_parse": _criteo_parse_sweep,
+        "parse_only": _parse_only_sweep,
         "criteo_recordio_ingest": _criteo_recordio_sweep,
         "remote_ingest": lambda: _remote_sweep(path),
     }
@@ -1150,6 +1248,16 @@ def main() -> None:
         else:
             extra[name + "_mbps"] = round(value, 1)
             extra[name + "_sweeps"] = sw_extra
+    # per-(format, backend) parse-stage medians: best window across the
+    # three parse_only sweeps, lifted to flat *_gbps keys so the sentry
+    # gates each backend's parse throughput independently of the e2e tiers
+    fmt_best: dict = {}
+    for sw in tier_sweeps.get("parse_only", ()):
+        for key, v in (sw.get("formats") or {}).items():
+            if isinstance(v, (int, float)):
+                fmt_best[key] = max(fmt_best.get(key, 0.0), float(v))
+    for key, v in fmt_best.items():
+        extra["parse_only_" + key] = v
     if "remote_ingest_mbps" in extra:
         # The loopback harness runs BOTH http ends and the parser on this
         # host's core(s): at 1 core the serial budget is parse + server

@@ -25,7 +25,7 @@
 extern "C" {
 #endif
 
-#define DMLC_TPU_ABI_VERSION 6
+#define DMLC_TPU_ABI_VERSION 7
 
 /* ---- status codes (parsers and pipeline) ------------------------------ */
 enum {
@@ -50,6 +50,12 @@ enum {
 };
 
 int dmlc_tpu_abi_version(void);
+
+/* SIMD tier selected at runtime for the LibSVM parse path (CPUID check +
+ * the DMLC_TPU_SIMD env gate): 0 = portable scalar, 2 = AVX2+BMI2
+ * tokenize/convert engine. Results are bit-identical at every tier; the
+ * value is telemetry for bench records and the parse-parity tests. */
+int dmlc_tpu_simd_level(void);
 
 /* ---- chunk parsers (src/data/strtonum.h + libsvm/libfm/csv analogs) ---
  * One forward scan per chunk: caller allocates outputs using upper bounds

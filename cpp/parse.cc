@@ -9,199 +9,20 @@
 // chunk), and row/nnz counts returned for exact trimming. No OpenMP — the
 // Python side maps chunk pieces onto a thread pool and ctypes releases the
 // GIL, so parallelism composes at the chunk level.
+//
+// The LibSVM path additionally runtime-dispatches to the AVX2 tokenize +
+// batch-convert engine in parse_simd.cc (SimdKernelLevel() gates on CPUID
+// and DMLC_TPU_SIMD); the scalar loop below is both the portable fallback
+// and the row-level oracle the SIMD engine defers to for anything outside
+// its fast shapes, so results are bit-identical either way.
 
 #include <cstdint>
 #include <cstring>
 
 #include "dmlc_tpu.h"
+#include "parse_common.h"
 
-namespace {
-
-inline bool is_space(char c) { return c == ' ' || c == '\t'; }
-
-// '\r' is a line terminator (LineSplitter record boundaries accept \n, \r,
-// and \r\n), never inline whitespace — treating it as a space would merge
-// adjacent rows.
-inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
-
-inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
-
-// Exact powers of ten: 10^k is representable exactly in a double for
-// k <= 22, so mantissa*10^k / mantissa/10^k round once — the classic fast
-// strtod fast path.
-const double kPow10[23] = {
-    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10, 1e11,
-    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
-
-inline double ApplyExp10(double val, int64_t exp10) {
-  if (exp10 == 0) return val;
-  // |exp10| beyond ±350 already saturates to ±inf / ±0 for any mantissa the
-  // scan can produce (<= 1e19); clamping bounds the loop for adversarial
-  // exponents like 1e-999999999. The clamp happens HERE, after the explicit
-  // exponent has been folded in, so compensating pairs (long zero run +
-  // large positive exponent) stay exact.
-  if (exp10 > 350) exp10 = 350;
-  else if (exp10 < -350) exp10 = -350;
-  if (exp10 > 0) {
-    while (exp10 > 22) { val *= 1e22; exp10 -= 22; }
-    return val * kPow10[exp10];
-  }
-  exp10 = -exp10;
-  while (exp10 > 22) { val /= 1e22; exp10 -= 22; }
-  return val / kPow10[exp10];
-}
-
-// SWAR helpers for the fraction hot path: classify 8 bytes at once and
-// convert a full 8-digit group with a multiply tree instead of a serial
-// per-digit loop. `y` is the chunk XOR 0x30..30, so digit bytes are 0..9.
-// Returns the count of leading (lowest-address-first) digit bytes and masks
-// *digits down to them. Carry-free: the add is done on 7-bit bytes.
-inline int CountDigits8(uint64_t y, uint64_t* digits) {
-  uint64_t y7 = y & 0x7F7F7F7F7F7F7F7FULL;
-  uint64_t nondigit =
-      (((y7 + 0x7676767676767676ULL) | y) & 0x8080808080808080ULL);
-  if (nondigit == 0) {
-    *digits = y;
-    return 8;
-  }
-  int k = __builtin_ctzll(nondigit) >> 3;
-  *digits = y & ((1ULL << (k * 8)) - 1);
-  return k;
-}
-
-// 8 ascii-stripped digit bytes (lowest address = most significant digit,
-// little-endian load) -> the 8-digit number. Three multiplies total.
-inline uint32_t Swar8Digits(uint64_t y) {
-  const uint64_t mask = 0x000000FF000000FFULL;
-  const uint64_t mul1 = 0x000F424000000064ULL;  // 100 + (1000000 << 32)
-  const uint64_t mul2 = 0x0000271000000001ULL;  // 1 + (10000 << 32)
-  y = (y * 10) + (y >> 8);
-  return static_cast<uint32_t>(
-      (((y & mask) * mul1) + (((y >> 16) & mask) * mul2)) >> 32);
-}
-
-// Fast float scan: sign, integer part, fraction, optional exponent.
-// Handles the common data-file cases inline; no INF/NAN/hex (same contract
-// as the reference's strtonum.h:37, by design: data files don't contain
-// them, and rejecting keeps the loop branch-light). Digits accumulate into
-// an integer mantissa (pipelinable integer ops, no serial FP chain); the
-// decimal exponent is applied once at the end via exact powers of ten.
-inline const char* scan_double(const char* p, const char* end, double* out) {
-  if (p == end) return nullptr;
-  bool neg = false;
-  if (*p == '-') { neg = true; ++p; }
-  else if (*p == '+') { ++p; }
-  if (p == end || (!is_digit(*p) && *p != '.')) return nullptr;
-  uint64_t mant = 0;
-  int ndig = 0;   // significant digits folded into mant (19 max: fits uint64)
-  // int64: bounded by the input length, so digit/zero runs can't overflow
-  // it; saturation is applied once in ApplyExp10 after the explicit
-  // exponent is added (a mid-scan cap would corrupt compensating pairs
-  // like "0.<420 zeros>5e450").
-  int64_t exp10 = 0;
-  // ndig += (mant != 0) keeps leading zeros mantissa-budget-free without a
-  // branch in the hot loop (folding a 0 into mant==0 is a numeric no-op).
-  while (p != end && is_digit(*p)) {
-    if (ndig < 19) {
-      mant = mant * 10 + static_cast<uint64_t>(*p - '0');
-      ndig += static_cast<int>(mant != 0);
-    } else {
-      ++exp10;
-    }
-    ++p;
-  }
-  if (p != end && *p == '.') {
-    ++p;
-    // 8-wide groups while the mantissa has room (mant*1e8 + 8 digits must
-    // fit uint64: safe while ndig <= 11). A short group (k < 8) appends
-    // 8-k virtual zero digits — value-preserving for a fraction tail, and
-    // the byte at p+k is a real non-digit so the scalar loop below exits
-    // immediately. An all-zero group before any significant digit shifts
-    // the decimal point but costs no mantissa budget, so long zero runs
-    // ("0.<420 zeros>5") skip 8 bytes at a time with their significant
-    // digits preserved.
-    while (end - p >= 8 && ndig <= 11) {
-      uint64_t chunk;
-      std::memcpy(&chunk, p, 8);
-      uint64_t digs;
-      int k = CountDigits8(chunk ^ 0x3030303030303030ULL, &digs);
-      if (k == 0) break;
-      // branchless: folding an all-zero group into a zero mantissa is a
-      // numeric no-op, and ndig charges 8 only once a significant digit
-      // has appeared
-      mant = mant * 100000000ULL + Swar8Digits(digs);
-      ndig += static_cast<int>(mant != 0) << 3;
-      exp10 -= 8;
-      p += k;
-      if (k < 8) break;
-    }
-    while (p != end && is_digit(*p)) {
-      if (ndig < 19) {
-        mant = mant * 10 + static_cast<uint64_t>(*p - '0');
-        ndig += static_cast<int>(mant != 0);
-        --exp10;
-      }
-      ++p;
-    }
-  }
-  if (p != end && (*p == 'e' || *p == 'E')) {
-    ++p;
-    bool eneg = false;
-    if (p != end && (*p == '-' || *p == '+')) { eneg = (*p == '-'); ++p; }
-    int ex = 0;
-    while (p != end && is_digit(*p)) {
-      if (ex < 100000000) ex = ex * 10 + (*p - '0');
-      ++p;
-    }
-    exp10 += eneg ? -ex : ex;
-  }
-  *out = ApplyExp10(neg ? -static_cast<double>(mant)
-                        : static_cast<double>(mant),
-                    exp10);
-  return p;
-}
-
-inline const char* scan_u64(const char* p, const char* end, uint64_t* out) {
-  if (p == end || !is_digit(*p)) return nullptr;
-  uint64_t v = 0;
-  while (p != end && is_digit(*p)) { v = v * 10 + (*p - '0'); ++p; }
-  *out = v;
-  return p;
-}
-
-const uint64_t kPow10U64[9] = {1ULL,       10ULL,       100ULL,
-                               1000ULL,    10000ULL,    100000ULL,
-                               1000000ULL, 10000000ULL, 100000000ULL};
-
-// SWAR u64 scan for LONG digit runs (high-cardinality feature ids: Criteo's
-// 7-digit hashed ids). Classify 8 bytes at once, then convert the k leading
-// digits in one multiply tree: the k digit bytes (most significant at the
-// lowest address) are shifted up so Swar8Digits sees them as the LEAST
-// significant digit positions behind leading zeros — value-exact, no
-// division. ~constant ~20 ops per <=8-digit run vs a 4-5 cycle/digit serial
-// mul-add chain; loses on 1-2 digit ids (measured 45% slower if applied
-// unconditionally — see BASELINE.md round-3 notes), so callers pick it
-// per-chunk from observed id lengths.
-inline const char* scan_u64_swar(const char* p, const char* end,
-                                 uint64_t* out) {
-  if (p == end || !is_digit(*p)) return nullptr;
-  uint64_t v = 0;
-  while (end - p >= 8) {
-    uint64_t chunk;
-    std::memcpy(&chunk, p, 8);
-    uint64_t digs;
-    int k = CountDigits8(chunk ^ 0x3030303030303030ULL, &digs);
-    if (k == 0) break;
-    v = v * kPow10U64[k] + Swar8Digits(digs << ((8 - k) * 8));
-    p += k;
-    if (k < 8) { *out = v; return p; }
-  }
-  while (p != end && is_digit(*p)) { v = v * 10 + (*p - '0'); ++p; }
-  *out = v;
-  return p;
-}
-
-}  // namespace
+using namespace dmlc_tpu_parse;
 
 // Status codes and feature flags come from the public header
 // (dmlc_tpu.h) — the single source the Python binding and external
@@ -266,6 +87,36 @@ static int parse_libfm_impl(const char* data, int64_t len,
   return DMLC_TPU_OK;
 }
 
+// First-line shape probe for kernel dispatch: average feature-id length in
+// bytes. The AVX2 engine amortizes its tokenize+batch-convert tiles best on
+// long tokens (Criteo-class 6-7 digit hashed ids: ~16% over scalar); on
+// HIGGS-class 1-2 digit ids the scalar SWAR loop's per-byte costs are
+// already near the floor and the engine's extra passes lose ~8%. Same
+// homogeneity assumption as the long_ids SWAR pick below, sampled without
+// parsing: bytes from each token start to its first ':' (or token end).
+static bool ProbeLongIds(const char* data, int64_t len) {
+  int64_t cap = len < 2048 ? len : 2048;
+  int64_t i = 0;
+  while (i < cap && (is_space(data[i]) || is_eol(data[i]))) ++i;
+  int64_t id_bytes = 0, id_count = 0;
+  bool first_tok = true;  // the label doesn't count
+  while (i < cap && !is_eol(data[i])) {
+    while (i < cap && is_space(data[i])) ++i;
+    if (i >= cap || is_eol(data[i])) break;
+    int64_t tok = i, colon = -1;
+    while (i < cap && !is_space(data[i]) && !is_eol(data[i])) {
+      if (colon < 0 && data[i] == ':') colon = i;
+      ++i;
+    }
+    if (!first_tok) {
+      id_bytes += (colon >= 0 ? colon : i) - tok;
+      ++id_count;
+    }
+    first_tok = false;
+  }
+  return id_count > 0 && id_bytes >= 5 * id_count;  // avg >= 5 digits
+}
+
 // Templated over the index width: the pipeline consumes u32 indices, and
 // writing them directly saves a whole narrowing pass over nnz (the
 // NarrowU64ToU32 sweep used to re-read 8 and re-write 4 bytes per entry).
@@ -275,10 +126,23 @@ static int parse_libsvm_impl(const char* data, int64_t len,
                  int64_t* row_nnz, IndexT* indices, float* values,
                  int64_t max_rows, int64_t max_nnz,
                  int64_t* out_rows, int64_t* out_nnz, int* out_flags) {
+  SvmSink<IndexT> sink{labels,   weights, qids, row_nnz, indices, values,
+                       max_rows, max_nnz, 0,    0,       0};
+  // AVX2 engine when the CPU has it, the chunk is big enough to amortize
+  // its tile setup (tiny chunks — unit-test strings — stay scalar), and the
+  // first-line probe says the token shape favors it. DMLC_TPU_SIMD=1 forces
+  // the engine regardless of shape (parity tests exercise it that way).
+  if (len >= 256 && SimdKernelLevel() >= 2 &&
+      (SimdKernelForced() || ProbeLongIds(data, len))) {
+    int rc = ParseSvmSimd(data, len, &sink);
+    if (rc != DMLC_TPU_OK) return rc;
+    *out_rows = sink.rows;
+    *out_nnz = sink.nnz;
+    *out_flags = sink.flags;
+    return DMLC_TPU_OK;
+  }
   const char* p = data;
   const char* end = data + len;
-  int64_t rows = 0, nnz = 0;
-  int flags = 0;
   // Adaptive id scan: the first row's average id length picks serial vs
   // SWAR-group conversion for the whole chunk (files are homogeneous;
   // HIGGS-class 1-2 digit ids lose on SWAR classify overhead, Criteo-class
@@ -288,68 +152,17 @@ static int parse_libsvm_impl(const char* data, int64_t len,
   while (p != end) {
     while (p != end && (is_space(*p) || is_eol(*p))) ++p;
     if (p == end) break;
-    // label [:weight]
-    double label;
-    const char* q = scan_double(p, end, &label);
-    if (q == nullptr) return DMLC_TPU_EPARSE;
-    p = q;
-    double weight = 1.0;
-    if (p != end && *p == ':') {
-      ++p;
-      q = scan_double(p, end, &weight);
-      if (q == nullptr) return DMLC_TPU_EPARSE;
-      p = q;
-      flags |= DMLC_TPU_HAS_WEIGHT;
-    }
-    if (rows >= max_rows) return DMLC_TPU_EOVERFLOW;
-    // missing qid -> 0, matching RowBlockContainer's neutral-default policy
-    // (and the pure-Python twin)
-    int64_t qid = 0;
-    int64_t row_start = nnz;
-    // features until newline
-    for (;;) {
-      while (p != end && is_space(*p)) ++p;
-      if (p == end || is_eol(*p)) {
-        if (p != end) ++p;
-        break;
-      }
-      if (*p == 'q' && end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
-        uint64_t qv;
-        q = scan_u64(p + 4, end, &qv);
-        if (q == nullptr) return DMLC_TPU_EPARSE;
-        qid = static_cast<int64_t>(qv);
-        flags |= DMLC_TPU_HAS_QID;
-        p = q;
-        continue;
-      }
-      uint64_t idx;
-      q = long_ids ? scan_u64_swar(p, end, &idx) : scan_u64(p, end, &idx);
-      if (q == nullptr) return DMLC_TPU_EPARSE;
-      if (rows == 0) { id_bytes += q - p; ++id_count; }
-      p = q;
-      double val = 1.0;
-      if (p != end && *p == ':') {
-        ++p;
-        q = scan_double(p, end, &val);
-        if (q == nullptr) return DMLC_TPU_EPARSE;
-        p = q;
-        flags |= DMLC_TPU_HAS_VALUE;
-      }
-      if (nnz >= max_nnz) return DMLC_TPU_EOVERFLOW;
-      indices[nnz] = static_cast<IndexT>(idx);
-      values[nnz] = static_cast<float>(val);
-      ++nnz;
-    }
-    labels[rows] = static_cast<float>(label);
-    weights[rows] = static_cast<float>(weight);
-    qids[rows] = qid;
-    row_nnz[rows] = nnz - row_start;
-    ++rows;
-    if (rows == 1) long_ids = id_count > 0 && id_bytes >= 5 * id_count;  // avg >= 5 digits
+    bool first = sink.rows == 0;
+    int rc = ParseSvmRowScalar<IndexT>(&p, end, long_ids,
+                                       first ? &id_bytes : nullptr,
+                                       first ? &id_count : nullptr, &sink);
+    if (rc != DMLC_TPU_OK) return rc;
+    if (sink.rows == 1)
+      long_ids = id_count > 0 && id_bytes >= 5 * id_count;  // avg >= 5 digits
   }
-  *out_rows = rows;
-  *out_nnz = nnz;
-  *out_flags = flags;
+  *out_rows = sink.rows;
+  *out_nnz = sink.nnz;
+  *out_flags = sink.flags;
   return DMLC_TPU_OK;
 }
 
@@ -482,5 +295,10 @@ void count_tokens(const char* data, int64_t len,
 }
 
 int dmlc_tpu_abi_version(void) { return DMLC_TPU_ABI_VERSION; }
+
+// SIMD tier actually selected at runtime (CPUID + DMLC_TPU_SIMD gate):
+// 0 = scalar, 2 = AVX2+BMI2 tokenizer engine. Exposed for telemetry and
+// the parse-parity tests.
+int dmlc_tpu_simd_level(void) { return SimdKernelLevel(); }
 
 }  // extern "C"

@@ -28,6 +28,7 @@ from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
+from dmlc_tpu.data import vparse
 from dmlc_tpu.data.row_block import (
     INDEX_DTYPE,
     REAL_DTYPE,
@@ -36,6 +37,7 @@ from dmlc_tpu.data.row_block import (
 )
 from dmlc_tpu.io.input_split import InputSplit, create_input_split
 from dmlc_tpu.io.uri_spec import URISpec
+from dmlc_tpu.params.knobs import parse_backend, parse_procs
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.params.registry import Registry
 from dmlc_tpu.utils.logging import DMLCError, check
@@ -170,110 +172,31 @@ def _native_libfm(chunk: bytes) -> Optional[RowBlockContainer]:
 
 
 class LibSVMParser(Parser):
-    """``label[:weight] [qid:n] index[:value]...`` (libsvm_parser.h)."""
+    """``label[:weight] [qid:n] index[:value]...`` (libsvm_parser.h).
+
+    Chunk parsing routes through ``DMLC_TPU_PARSE_BACKEND``
+    (params/knobs.py): native C++ core first under auto/native, then the
+    columnar vectorized tokenizer (data/vparse.py), with the scalar line
+    loop as the semantic oracle (``backend=scalar`` or vparse's own
+    grammar fallback)."""
 
     def parse_chunk(self, chunk: bytes) -> RowBlockContainer:
-        native_out = _native_libsvm(chunk)
-        if native_out is not None:
-            return native_out
+        backend = parse_backend()
+        if backend in ("auto", "native"):
+            native_out = _native_libsvm(chunk)
+            if native_out is not None:
+                return native_out
         out = RowBlockContainer()
-        if b"qid:" in chunk:
-            self._parse_general(chunk, out)
-            return out
-        lines = [ln for ln in chunk.splitlines() if ln.strip()]
-        if not lines:
-            return out
-        # Fast path: every line is "label[:weight] idx:val idx:val ...".
-        # After ':'→' ' replacement, token count parity distinguishes the
-        # optional weight. Bare "idx" features (implicit value 1) fall back.
-        flat: List[bytes] = []
-        counts = np.empty(len(lines), dtype=np.int64)
-        weighted = np.empty(len(lines), dtype=bool)
-        ok = True
-        for i, line in enumerate(lines):
-            toks = line.replace(b":", b" ").split()
-            ncolon = line.count(b":")
-            has_weight = b":" in line.split(None, 1)[0]
-            nfeat = ncolon - (1 if has_weight else 0)
-            if len(toks) != 1 + (1 if has_weight else 0) + 2 * nfeat or nfeat < 0:
-                ok = False
-                break
-            counts[i] = nfeat
-            weighted[i] = has_weight
-            flat.extend(toks)
-        if not ok:
-            out.clear()
-            self._parse_general(chunk, out)
-            return out
-        values = _tokens_to_floats(flat)
-        pos = 0
-        labels = np.empty(len(lines), dtype=np.float64)
-        # Unweighted lines in a weighted dataset default to weight 1.0 (the
-        # reference's Row::get_weight semantics, data.h:101-104) instead of
-        # silently dropping the weights that were present.
-        weights = np.ones(len(lines), dtype=np.float64)
-        idx_parts = []
-        val_parts = []
-        for i in range(len(lines)):
-            nfeat = int(counts[i])
-            labels[i] = values[pos]
-            start = pos + 1
-            if weighted[i]:
-                weights[i] = values[pos + 1]
-                start = pos + 2
-            pairs = values[start : start + 2 * nfeat].reshape(nfeat, 2)
-            idx_parts.append(pairs[:, 0])
-            val_parts.append(pairs[:, 1])
-            pos = start + 2 * nfeat
-        index = (
-            np.concatenate(idx_parts).astype(INDEX_DTYPE)
-            if idx_parts
-            else np.empty(0, dtype=INDEX_DTYPE)
-        )
-        value = (
-            np.concatenate(val_parts).astype(REAL_DTYPE)
-            if val_parts
-            else np.empty(0, dtype=REAL_DTYPE)
-        )
-        weight = (
-            weights.astype(REAL_DTYPE) if len(lines) and weighted.any() else None
-        )
-        out.push_arrays(
-            labels.astype(REAL_DTYPE), counts, index, value=value, weight=weight
-        )
+        if backend == "scalar":
+            vparse.parse_libsvm_scalar(chunk, out)
+        else:
+            vparse.parse_libsvm_vector(chunk, out)
         return out
 
     def _parse_general(self, chunk: bytes, out: RowBlockContainer) -> None:
-        """Slow path covering qid, bare indices, mixed weights."""
-        for line in chunk.splitlines():
-            toks = line.split()
-            if not toks:
-                continue
-            head = toks[0].split(b":")
-            label = float(head[0])
-            weight = float(head[1]) if len(head) > 1 else None
-            qid = None
-            feats_idx: List[float] = []
-            feats_val: List[float] = []
-            has_vals = False
-            for tok in toks[1:]:
-                if tok.startswith(b"qid:"):
-                    qid = int(tok[4:])
-                    continue
-                pair = tok.split(b":")
-                feats_idx.append(float(pair[0]))
-                if len(pair) > 1:
-                    feats_val.append(float(pair[1]))
-                    has_vals = True
-                else:
-                    feats_val.append(1.0)
-            out.push_row(
-                label,
-                np.asarray(feats_idx, dtype=np.float64).astype(INDEX_DTYPE),
-                value=np.asarray(feats_val, dtype=REAL_DTYPE) if has_vals else None,
-                weight=weight,
-                qid=qid,
-            )
+        """Scalar oracle path (qid, bare indices, mixed weights — the full
+        grammar). Kept as a hook for subclasses; delegates to vparse."""
+        vparse.parse_libsvm_scalar(chunk, out)
 
 
 class LibFMParser(Parser):
@@ -348,36 +271,24 @@ class CSVParser(Parser):
         check(self.param.format == "csv", "CSVParser requires format=csv")
 
     def parse_chunk(self, chunk: bytes) -> RowBlockContainer:
-        from dmlc_tpu import native
-
         out = RowBlockContainer()
-        table = native.parse_csv_chunk(chunk)
-        if table is not None:
-            if len(table) == 0:
-                return out
-            return self._table_to_block(table, out)
-        lines = [ln for ln in chunk.splitlines() if ln.strip()]
-        if not lines:
-            return out
-        ncols = lines[0].count(b",") + 1
-        uniform = all(ln.count(b",") + 1 == ncols for ln in lines)
-        if uniform:
-            cells = np.asarray(b",".join(lines).split(b","), dtype="S")
-            # empty cells parse as 0.0 (strtof-on-empty semantics)
-            cells = np.where(cells == b"", b"0", cells)
-            table = cells.astype(np.float64).reshape(len(lines), ncols)
+        backend = parse_backend()
+        if backend in ("auto", "native"):
+            from dmlc_tpu import native
+
+            table = native.parse_csv_chunk(chunk)
+            if table is not None:
+                if len(table) == 0:
+                    return out
+                return self._table_to_block(table, out)
+        # vparse cell spans come straight from comma/newline offset arrays
+        # (no b",".join re-join); the scalar table is the semantic oracle
+        if backend == "scalar":
+            table = vparse.parse_csv_scalar_table(chunk)
         else:
-            # ragged csv: pad per line (reference treats each line separately)
-            rows = [
-                np.asarray(
-                    [c or b"0" for c in ln.split(b",")], dtype="S"
-                ).astype(np.float64)
-                for ln in lines
-            ]
-            width = max(len(r) for r in rows)
-            table = np.zeros((len(rows), width), dtype=np.float64)
-            for i, r in enumerate(rows):
-                table[i, : len(r)] = r
+            table = vparse.parse_csv_vector_table(chunk)
+        if table.shape[0] == 0:
+            return out
         return self._table_to_block(table, out)
 
     def _table_to_block(
@@ -1089,10 +1000,13 @@ def create_parser(
             f"unknown data format {data_format!r}; known: "
             f"{PARSER_REGISTRY.list_all_names()}"
         )
-    if threaded:
+    if threaded and parse_backend() in ("auto", "native") and parse_procs() == 0:
         # Built-in formats over local files take the all-native pipeline
         # (reader + parse + prefetch in C++); everything else composes the
-        # Python InputSplit stack with native chunk parses inside.
+        # Python InputSplit stack with native chunk parses inside. A
+        # vector/scalar backend override or a process-pool request
+        # (DMLC_TPU_PARSE_PROCS>0) keeps the Python PipelinedParser so the
+        # selected engine actually runs.
         native_parser = _try_native_pipeline(
             spec, data_format, part_index, num_parts, nthread
         )

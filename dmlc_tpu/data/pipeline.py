@@ -20,6 +20,13 @@ formats and sources the native router declines (custom registry
 parsers, mixed filesystems, native lib unavailable) with the same
 concurrency shape.
 
+``DMLC_TPU_PARSE_PROCS`` (params/knobs.py) escalates the workers from
+threads to a shared spawn-start process pool: each worker thread ships
+its chunk to a pool process and blocks on the future, so the
+OrderedWindow still owns ordering, backpressure, poisoning and flow
+tracing while the actual byte crunching escapes the GIL. See
+docs/pipeline.md "Vectorized parse".
+
 Stage accounting mirrors the native pipeline's counters: ``stats()``
 reports worker parse time, consumer wait on the queue head, and chunk
 count — surfaced by ``DeviceFeed.stats()["pipeline"]`` next to the
@@ -42,10 +49,59 @@ from dmlc_tpu import obs
 from dmlc_tpu.data.parsers import Parser
 from dmlc_tpu.data.row_block import RowBlock
 from dmlc_tpu.io.readahead import OrderedWindow
-from dmlc_tpu.params.knobs import default_nthread
+from dmlc_tpu.params.knobs import default_nthread, parse_procs
 from dmlc_tpu.utils.logging import check
 
 _PIPE_IDS = itertools.count()  # per-instance obs label (pipe="c0")
+
+
+class _NullSource:
+    """Chunk-less InputSplit stand-in for process-pool parser replicas:
+    ``parse_chunk`` never touches the source, which cannot cross a
+    process boundary anyway (open files, sockets)."""
+
+    def next_chunk(self):
+        return None
+
+    def before_first(self):
+        pass
+
+    def close(self):
+        pass
+
+
+# parser replica per (class, args) per worker process, built on first use
+_PROC_PARSERS: dict = {}
+
+
+def _proc_parse(spec, chunk):
+    """Parse one chunk in a pool process. ``spec`` rebuilds a replica of
+    the parent's parser (class path + stringified params); module-level
+    and import-driven so it pickles under the spawn start method."""
+    parser = _PROC_PARSERS.get(spec)
+    if parser is None:
+        import importlib
+
+        mod_name, cls_name, args = spec
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        try:
+            parser = cls(_NullSource(), dict(args), nthread=1)
+        except TypeError:  # (source, nthread) signature
+            parser = cls(_NullSource(), nthread=1)
+        _PROC_PARSERS[spec] = parser
+    return parser.parse_chunk(chunk)
+
+
+def _proc_spec(base: Parser):
+    """Picklable replica recipe for ``base``, or None when the parser
+    can't be rebuilt from (class, params) alone — then chunks stay on
+    the worker threads."""
+    cls = type(base)
+    if cls.__qualname__ != cls.__name__:  # nested/local class: no import path
+        return None
+    param = getattr(base, "param", None)
+    args = tuple(sorted(param.to_dict().items())) if param is not None else ()
+    return (cls.__module__, cls.__name__, args)
 
 
 class PipelinedParser:
@@ -87,6 +143,17 @@ class PipelinedParser:
         self._h_wait = reg.histogram(
             "dmlc_pipeline_consumer_wait_ns",
             "per-pop consumer wait on the queue head", pipe=pid)
+        # DMLC_TPU_PARSE_PROCS>0: worker threads submit chunks to a shared
+        # process pool and block on the future, so ordering, backpressure
+        # and poisoning ride the same OrderedWindow machinery. The pool is
+        # created lazily (first chunk) with the spawn start method — fork
+        # would duplicate JAX/native state. Latched at construction, like
+        # nthread.
+        self._procs = parse_procs()
+        self._proc_recipe = _proc_spec(base) if self._procs > 0 else None
+        if self._proc_recipe is None:
+            self._procs = 0  # non-rebuildable parser: parse on threads
+        self._executor = None
         self._win: Optional[OrderedWindow] = None
         self._seq = 0  # in-order chunk id (span labels), not telemetry
         self._eof = False
@@ -100,13 +167,35 @@ class PipelinedParser:
         )
         self._eof = False
 
+    def _ensure_executor(self):
+        if self._executor is None:
+            import concurrent.futures
+            import multiprocessing
+
+            self._executor = concurrent.futures.ProcessPoolExecutor(
+                max_workers=self._procs,
+                mp_context=multiprocessing.get_context("spawn"),
+            )
+        return self._executor
+
     def _parse_timed(self, task):
+        from dmlc_tpu.resilience import faultpoint
+
         seq, fid, chunk = task
         t0 = time.monotonic_ns()
         try:
             with obs.span("parse", chunk=seq, flow=fid):
                 obs.flow_step(fid, "chunk")
-                container = self._base.parse_chunk(chunk)
+                # fires on the parent's worker thread in both modes, so an
+                # injected fault poisons the window at the chunk's in-order
+                # position whether or not a process pool is behind it
+                faultpoint("parse.chunk")
+                if self._procs > 0:
+                    container = self._ensure_executor().submit(
+                        _proc_parse, self._proc_recipe, chunk
+                    ).result()
+                else:
+                    container = self._base.parse_chunk(chunk)
             container.flow_id = fid
             return container
         finally:
@@ -181,6 +270,7 @@ class PipelinedParser:
             "parse_ns": int(self._h_parse.sum),
             "consumer_wait_ns": int(self._h_wait.sum),
             "nthread": self._nthread,
+            "procs": self._procs,
             "window": self._win.window if self._win is not None else 0,
         }
 
@@ -189,6 +279,9 @@ class PipelinedParser:
             return
         self._closed = True
         self._win.close()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
         self._base.close()
 
     def __del__(self):  # pragma: no cover

@@ -253,53 +253,55 @@ class RowBlockContainer:
             field=block.field,
         )
 
+    @staticmethod
+    def _cat(parts, empty_dtype):
+        """Concatenate parts, returning the lone part itself when there is
+        exactly one — the whole-chunk vectorized parser pushes once, so the
+        common case hands its arrays to the RowBlock without a copy (parts
+        are append-only and never mutated after push, so sharing is safe)."""
+        if len(parts) == 1:
+            return parts[0]
+        if not parts:
+            return np.empty(0, dtype=empty_dtype)
+        return np.concatenate(parts)
+
     def to_block(self) -> RowBlock:
         """Finalize into a RowBlock view (row_block.h GetBlock :169-188)."""
         nrows = self._nrows
-        counts = (
-            np.concatenate(self._count_parts)
-            if self._count_parts
-            else np.empty(0, dtype=np.int64)
-        )
+        counts = self._cat(self._count_parts, np.int64)
         offset = np.zeros(nrows + 1, dtype=np.int64)
         np.cumsum(counts, out=offset[1:])
-        index = (
-            np.concatenate(self._index_parts)
-            if self._index_parts
-            else np.empty(0, dtype=self.index_dtype)
-        )
-        label = (
-            np.concatenate(self._label_parts)
-            if self._label_parts
-            else np.empty(0, dtype=REAL_DTYPE)
-        )
+        index = self._cat(self._index_parts, self.index_dtype)
+        label = self._cat(self._label_parts, REAL_DTYPE)
         # optional arrays: fill neutral defaults for parts that omitted them
         value = None
         if self._any_value:
-            value = np.concatenate(
+            value = self._cat(
                 [
                     np.ones(len(idx), dtype=REAL_DTYPE) if v is None else v
                     for v, idx in zip(self._value_parts, self._index_parts)
-                ]
-                or [np.empty(0, dtype=REAL_DTYPE)]
+                ],
+                REAL_DTYPE,
             )
         fields_present = [f for f in self._field_parts if f is not None]
-        field = np.concatenate(fields_present) if fields_present else None
+        field = self._cat(fields_present, INDEX_DTYPE) if fields_present else None
         weight = None
         if self._any_weight and nrows:
-            weight = np.concatenate(
+            weight = self._cat(
                 [
                     np.ones(len(lbl), dtype=REAL_DTYPE) if w is None else w
                     for w, lbl in zip(self._weight_parts, self._label_parts)
-                ]
+                ],
+                REAL_DTYPE,
             )
         qid = None
         if self._any_qid and nrows:
-            qid = np.concatenate(
+            qid = self._cat(
                 [
                     np.zeros(len(lbl), dtype=np.int64) if q is None else q
                     for q, lbl in zip(self._qid_parts, self._label_parts)
-                ]
+                ],
+                np.int64,
             )
         return RowBlock(
             offset=offset,

@@ -160,6 +160,8 @@ def _bind(lib) -> None:
     lib.ingest_close.argtypes = [ctypes.c_void_p]
     lib.dmlc_tpu_abi_version.restype = ctypes.c_int
     lib.dmlc_tpu_abi_version.argtypes = []
+    lib.dmlc_tpu_simd_level.restype = ctypes.c_int
+    lib.dmlc_tpu_simd_level.argtypes = []
 
 
 _build_attempted = False
@@ -231,8 +233,18 @@ def _expected_abi_version() -> int:
 
 # the ABI generation _bind's ctypes signatures target; the header is
 # authoritative in a checkout (see _expected_abi_version)
-_BOUND_ABI = 6
+_BOUND_ABI = 7
 _expected_abi = None
+
+
+def simd_level() -> int:
+    """SIMD tier the loaded parse engine actually selected (CPUID plus
+    the ``DMLC_TPU_SIMD`` env gate, params/knobs.py): 0 = portable
+    scalar, 2 = AVX2+BMI2. -1 when the native library is not loaded.
+    The tier is latched at first native parse, so set the knob before
+    touching data."""
+    lib = get_lib()
+    return int(lib.dmlc_tpu_simd_level()) if lib is not None else -1
 
 
 def _load(path: str):

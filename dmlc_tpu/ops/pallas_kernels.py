@@ -166,3 +166,83 @@ def fused_linear_grads(
         jnp.asarray(b, jnp.float32).reshape(1, 1),
     )
     return gw[0, :nfeat], gb[0, 0], loss_sum[0, 0], wsum[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# Byte tokenizer for the vectorized text-parse path (data/vparse.py)
+# ---------------------------------------------------------------------------
+#
+# Token boundaries are a pure elementwise problem once the one-byte
+# neighbor shifts are materialized: start = nonsep(cur) & sep(prev),
+# end = nonsep(cur) & sep(next). The wrapper builds the three shifted
+# views on the host (overlapping slices of one padded buffer — no extra
+# copies) so the kernel is shift-free and tiles cleanly on the VPU; the
+# 0x20 padding byte is a separator, so padded lanes produce no
+# boundaries. Semantics are pinned to vparse.token_boundary_masks by the
+# parity suite. Offset extraction (flatnonzero) stays on the host — it
+# has no fixed-shape device analog.
+
+_TOK_SEP = (0x20, 0x09, 0x3A, 0x0A, 0x0D)  # space tab colon \n \r
+_TOK_ROWS = 256  # uint8 sublane tile is 32; 256x128 rows/step = 32 KiB
+
+
+def _tokenize_kernel(cur_ref, prv_ref, nxt_ref, starts_ref, ends_ref):
+    def sep(v):
+        m = v == _TOK_SEP[0]
+        for code in _TOK_SEP[1:]:
+            m = m | (v == code)
+        return m
+
+    cur = cur_ref[...].astype(jnp.int32)
+    nonsep = ~sep(cur)
+    starts_ref[...] = (
+        nonsep & sep(prv_ref[...].astype(jnp.int32))
+    ).astype(jnp.uint8)
+    ends_ref[...] = (
+        nonsep & sep(nxt_ref[...].astype(jnp.int32))
+    ).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _tokenize_call(cur, prv, nxt, interpret: bool = False):
+    rows = cur.shape[0]
+    spec = pl.BlockSpec((_TOK_ROWS, _LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _tokenize_kernel,
+        grid=(rows // _TOK_ROWS,),
+        in_specs=[spec, spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, _LANE), jnp.uint8),
+            jax.ShapeDtypeStruct((rows, _LANE), jnp.uint8),
+        ],
+        interpret=interpret,
+    )(cur, prv, nxt)
+
+
+def tokenize_boundaries(a, interpret=None):
+    """(starts_mask, ends_mask) bool arrays for libsvm tokens over the
+    uint8 chunk ``a`` — the Pallas variant of
+    ``vparse.token_boundary_masks``, used when ``DMLC_TPU_PALLAS`` is
+    ``1``/``parse``. ``interpret=None`` auto-selects interpreter mode off
+    TPU (Mosaic targets TPU only)."""
+    import numpy as np
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = int(a.size)
+    if n == 0:
+        empty = np.zeros(0, dtype=bool)
+        return empty, empty.copy()
+    quantum = _TOK_ROWS * _LANE
+    pad = -(-n // quantum) * quantum
+    buf = np.full(pad + 2, 0x20, dtype=np.uint8)
+    buf[1 : 1 + n] = a
+    cur = buf[1 : 1 + pad].reshape(-1, _LANE)
+    prv = buf[0:pad].reshape(-1, _LANE)
+    nxt = buf[2 : 2 + pad].reshape(-1, _LANE)
+    starts, ends = _tokenize_call(cur, prv, nxt, interpret=interpret)
+    starts = np.asarray(starts).reshape(-1)[:n].astype(bool)
+    ends = np.asarray(ends).reshape(-1)[:n].astype(bool)
+    return starts, ends

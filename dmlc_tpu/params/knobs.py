@@ -109,6 +109,25 @@ Device telemetry (obs/device_telemetry.py, see docs/observability.md
   sampler thread (0 = no thread, the default; sampling still happens at
   payload-publish and bench boundaries)
 
+The vectorized text-parse path (data/vparse.py + cpp/parse_simd.cc, see
+docs/pipeline.md "Vectorized parse") adds three more:
+
+- ``DMLC_TPU_PARSE_BACKEND`` — chunk-parse implementation selector:
+  ``auto`` (default: native core when loadable, else the vectorized
+  numpy path), ``native`` (native-or-vector, never scalar), ``vector``
+  (numpy columnar path even when the native core is available — the
+  parity suite's workhorse), ``scalar`` (pure-Python reference oracle)
+- ``DMLC_TPU_PARSE_PROCS`` — when > 0, PipelinedParser routes chunk
+  parses through a pool of that many worker *processes* instead of
+  parsing on its worker threads (GIL-free scaling for the Python parse
+  backends; ordering, backpressure and error poisoning are unchanged
+  because the OrderedWindow threads block on the process futures)
+- ``DMLC_TPU_SIMD`` — native engine dispatch: unset/empty = adaptive
+  (a first-line probe routes long-feature-id corpora to the AVX2 tile
+  engine, short-id corpora to the scalar SWAR core), ``1`` = always use
+  the engine when the CPU supports it (parity tests force this),
+  anything else = engine off
+
 ``KNOWN_KNOBS`` below is the authoritative list of every
 ``DMLC_TPU_*`` variable the tree reads; ``scripts/check_faultpoints.py``
 fails CI when a knob is referenced anywhere without being registered
@@ -326,6 +345,23 @@ def hbm_poll_s() -> float:
     return max(0.0, float(get_env("DMLC_TPU_HBM_POLL_S", 0.0)))
 
 
+def parse_backend() -> str:
+    """Chunk-parse implementation (``DMLC_TPU_PARSE_BACKEND``): one of
+    ``auto`` (native when loadable, else vector — the default),
+    ``native``, ``vector``, ``scalar``. Unknown values read as auto."""
+    val = str(get_env("DMLC_TPU_PARSE_BACKEND", "auto")).strip().lower()
+    return val if val in ("auto", "native", "vector", "scalar") else "auto"
+
+
+def parse_procs() -> int:
+    """Process-pool parse workers (``DMLC_TPU_PARSE_PROCS``, default 0 =
+    parse on the PipelinedParser's own threads). When > 0 each worker
+    thread submits its chunk to a shared pool of this many processes and
+    blocks on the future, so window ordering, backpressure and error
+    poisoning behave exactly as in the threaded path."""
+    return max(0, get_env("DMLC_TPU_PARSE_PROCS", 0))
+
+
 def is_spare() -> bool:
     """Whether this process was launched as a warm spare
     (``DMLC_TPU_SPARE``, set by the launcher's ``--spares`` tasks).
@@ -346,6 +382,10 @@ KNOWN_KNOBS = (
     "DMLC_TPU_READAHEAD_MB",
     "DMLC_TPU_READAHEAD_CONNS",
     "DMLC_TPU_FEED_PUT",
+    # vectorized parse path
+    "DMLC_TPU_PARSE_BACKEND",
+    "DMLC_TPU_PARSE_PROCS",
+    "DMLC_TPU_SIMD",
     # native bridge
     "DMLC_TPU_NATIVE",
     "DMLC_TPU_NATIVE_LIB",

@@ -3,7 +3,7 @@
 // for the native ingest core).
 //
 // Build (from the repo root, after `make -C cpp`; one line):
-//   g++ -O2 -std=c++17 examples/native_ingest.cc
+//   g++ -O2 -std=c++17 -pthread examples/native_ingest.cc
 //       -Icpp -Lcpp -ldmlc_tpu -Wl,-rpath,$PWD/cpp -o native_ingest
 //   ./native_ingest data.svm            # local-file reader pipeline
 //   ./native_ingest --remote data.svm   # remote-shaped drive_push path

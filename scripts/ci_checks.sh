@@ -83,4 +83,66 @@ finally:
 print("ci_checks: dispatcher failover smoke OK")
 EOF
 
+# parse-parity smoke: the scalar oracle, the numpy vector path, and (when
+# loaded) the native core must produce byte-identical RowBlocks over a
+# canned corpus of grammar corner cases. A digest mismatch here means the
+# vectorized hot path and the reference parser have diverged.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import hashlib, sys
+
+import numpy as np
+
+from dmlc_tpu.data import vparse
+from dmlc_tpu.data.row_block import RowBlockContainer
+
+CORPUS = (
+    b"1 1:1.5 3:2\n0 2:4\n",
+    b"1:0.5 4:1e-3 7:2\n\n-1 12:3.25\n",          # blank line mid-chunk
+    b"0.5:2.5 1:1 2:2\n1 qid:7 3:4\n",           # weighted head + qid
+    b"1 1:1\r\n0 2:2\r\n",                        # CRLF
+    b"1 5:1e308 6:5e-324 7:-0.0\n",              # huge/denormal/signed zero
+    b"0 1048576:0.125 2097151:9\n",              # long feature ids
+    b"1 1:1\n0 2:2",                              # no trailing newline
+)
+
+def digest(parse):
+    h = hashlib.sha256()
+    for chunk in CORPUS:
+        out = RowBlockContainer()
+        parse(chunk, out)
+        blk = out.to_block()
+        for arr in (blk.offset, blk.index, blk.label, blk.value,
+                    blk.weight, blk.qid):
+            h.update(b"|" if arr is None else np.ascontiguousarray(
+                arr).tobytes())
+    return h.hexdigest()
+
+scalar = digest(vparse.parse_libsvm_scalar)
+vector = digest(vparse.parse_libsvm_vector)
+if scalar != vector:
+    sys.exit("ci_checks: parse parity FAILED (scalar %s != vector %s)"
+             % (scalar[:12], vector[:12]))
+
+from dmlc_tpu import native
+if native.available():
+    from dmlc_tpu.data.parsers import _native_libsvm
+
+    def native_parse(chunk, out):
+        got = _native_libsvm(chunk)
+        if got is None:
+            sys.exit("ci_checks: native core refused a corpus chunk")
+        blk = got.to_block()
+        out.push_arrays(
+            blk.label, np.diff(blk.offset), blk.index,
+            value=blk.value, weight=blk.weight, qid=blk.qid)
+
+    nat = digest(native_parse)
+    if nat != scalar:
+        sys.exit("ci_checks: parse parity FAILED (native %s != scalar %s)"
+                 % (nat[:12], scalar[:12]))
+    print("ci_checks: parse-parity smoke OK (scalar == vector == native)")
+else:
+    print("ci_checks: parse-parity smoke OK (scalar == vector; no native)")
+EOF
+
 echo "ci_checks: all checks passed"

@@ -61,7 +61,7 @@ def test_cpp_consumer_example_builds_and_runs(tmp_path):
     )
     exe = tmp_path / "native_ingest"
     build = subprocess.run(
-        ["g++", "-O2", "-std=c++17",
+        ["g++", "-O2", "-std=c++17", "-pthread",
          os.path.join(REPO, "examples", "native_ingest.cc"),
          "-I" + os.path.join(REPO, "cpp"),
          "-L" + os.path.join(REPO, "cpp"), "-ldmlc_tpu",
