@@ -909,6 +909,139 @@ def _remote_sweep(path: str) -> dict:
                 os.environ[k] = v
 
 
+class _TenantFeed:
+    """One tenant's DeviceFeed over a fresh two-job dispatcher fleet.
+
+    Each construction is one epoch of the multi-tenant shape: job
+    ``train`` drives the jitted SGD step through this feed while job
+    ``aux`` — same source, its own ledger — is drained concurrently by a
+    background thread. close() tears the whole fleet down, so the
+    _timed_sgd_epochs protocol (fresh feed per epoch) measures fleet
+    bring-up + contended serving, not a warm single-tenant pipe."""
+
+    def __init__(self, path, spec, nworkers=2, nchunks=8):
+        import threading
+
+        from dmlc_tpu.data import (BlockService, DataDispatcher,
+                                   RemoteBlockParser)
+        from dmlc_tpu.device.feed import DeviceFeed
+
+        self._disp = DataDispatcher()
+        self._disp.add_job("train", path, nchunks=nchunks)
+        self._disp.add_job("aux", path, nchunks=nchunks)
+        self._workers = [
+            BlockService(dispatcher=self._disp.address,
+                         nthread=_bench_nthread())
+            for _ in range(nworkers)
+        ]
+        self.aux_rows = 0
+
+        def _drain_aux():
+            try:
+                aux = RemoteBlockParser(self._disp.address, dispatcher=True,
+                                        job="aux")
+                for block in aux:
+                    self.aux_rows += len(block)
+                aux.close()
+            except Exception:  # the aux tenant must not fail the timing
+                pass
+
+        self._aux_thread = threading.Thread(target=_drain_aux, daemon=True)
+        self._aux_thread.start()
+        self._feed = DeviceFeed(
+            RemoteBlockParser(self._disp.address, dispatcher=True,
+                              job="train"),
+            spec,
+        )
+
+    def __iter__(self):
+        return iter(self._feed)
+
+    def stats(self):
+        return self._feed.stats()
+
+    def close(self):
+        self._feed.close()
+        self._aux_thread.join(timeout=60)
+        for svc in self._workers:
+            svc.close()
+        self._disp.close()
+
+
+def _bench_multijob(path: str) -> dict:
+    """Multi-tenant fleet tiers: ingest→SGD with a second tenant live on
+    the same dispatcher (sgd_e2e_multijob_mbps), and the cross-job
+    source-cache hit ratio — a fresh fleet serves the source to one job
+    cold, then to a second job that should parse NOTHING
+    (cache_cross_job_hit_ratio = 1.0 is the PR 12 acceptance bar)."""
+    import jax.numpy as jnp
+
+    from dmlc_tpu.data import (BlockService, DataDispatcher,
+                               RemoteBlockParser, reset_source_cache,
+                               source_cache)
+    from dmlc_tpu.device.feed import BatchSpec
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+    )
+
+    size_mb = os.path.getsize(path) / (1 << 20)
+    # the shared cache must hold the whole parsed source or the warm
+    # tenant re-parses evicted parts; budget ~4x text size, restored after
+    old_cache_mb = os.environ.get("DMLC_TPU_DATA_CACHE_MB")
+    os.environ["DMLC_TPU_DATA_CACHE_MB"] = str(
+        max(256, int(size_mb * 4) + 64))
+    reset_source_cache()
+    try:
+        spec = BatchSpec(batch_size=16384, layout="dense", num_features=29)
+        params = init_linear_params(29)
+        velocity = {"w": jnp.zeros_like(params["w"]),
+                    "b": jnp.zeros_like(params["b"])}
+        step = make_linear_train_step(None, learning_rate=0.1,
+                                      layout="dense", donate_batch=True)
+        runs = _timed_sgd_epochs(
+            lambda: _TenantFeed(path, spec), size_mb, step, "dense",
+            params, velocity,
+        )
+
+        # cold/warm cache pass on a fresh fleet: ONE worker so every part
+        # leased for the warm job is resident where it was parsed. Both
+        # ledgers are registered up front (a worker whose whole fleet
+        # drains retires its stream), then drained one after the other.
+        reset_source_cache()
+        nchunks = 8
+        with DataDispatcher() as disp:
+            disp.add_job("cold", path, nchunks=nchunks)
+            disp.add_job("warm", path, nchunks=nchunks)
+            with BlockService(dispatcher=disp.address,
+                              nthread=_bench_nthread()) as svc:
+                cold = RemoteBlockParser(disp.address, dispatcher=True,
+                                         job="cold")
+                cold_rows = sum(len(b) for b in cold)
+                cold.close()
+                hits_before = source_cache().hits
+                parsed_before = svc.chunks_parsed
+                warm = RemoteBlockParser(disp.address, dispatcher=True,
+                                         job="warm")
+                warm_rows = sum(len(b) for b in warm)
+                warm.close()
+                hit_ratio = (source_cache().hits - hits_before) / nchunks
+                warm_parsed = svc.chunks_parsed - parsed_before
+        assert warm_rows == cold_rows, "tenants saw different row counts"
+        return {
+            "sgd_e2e_multijob_mbps": round(statistics.median(runs[1:]), 1),
+            "sgd_e2e_multijob_trials_mbps": runs[1:],
+            "cache_cross_job_hit_ratio": round(hit_ratio, 3),
+            "cache_cross_job_warm_parses": warm_parsed,
+        }
+    finally:
+        if old_cache_mb is None:
+            os.environ.pop("DMLC_TPU_DATA_CACHE_MB", None)
+        else:
+            os.environ["DMLC_TPU_DATA_CACHE_MB"] = old_cache_mb
+        reset_source_cache()
+
+
 # keys lifted verbatim from the full record into the compact stdout line:
 # every tier median + device/collective status the verdict reads
 _COMPACT_KEYS = (
@@ -921,6 +1054,7 @@ _COMPACT_KEYS = (
     "sgd_e2e_pipelined_mbps", "sgd_e2e_cached_mbps",
     "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
     "gbdt_fit_mrows_s",
+    "sgd_e2e_multijob_mbps", "cache_cross_job_hit_ratio",
     "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",
     "device_tier_probes_gbps",
     "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
@@ -1175,6 +1309,7 @@ def main() -> None:
             (lambda: _bench_recordio_sgd(path), "recordio_sgd_error"),
             (_bench_criteo_sgd, "criteo_sgd_error"),
             (lambda: _bench_gbdt(path), "gbdt_error"),
+            (lambda: _bench_multijob(path), "multijob_error"),
         ):
             tier_probes[err_key.replace("_error", "_probe_gbps")] = (
                 _host_probe()

@@ -26,9 +26,13 @@ from dmlc_tpu.data.row_iter import (
     DiskRowIter,
     create_row_block_iter,
 )
-from dmlc_tpu.data.dispatcher import DataDispatcher, DispatcherClient
+from dmlc_tpu.data.dispatcher import (DataBusyError, DataDispatcher,
+                                      DispatcherClient, register_job)
 from dmlc_tpu.data.service import (BlockService, RemoteBlockParser,
                                    TruncatedFrame, reshard_split)
+from dmlc_tpu.data.source_cache import (SourceCache, reset_source_cache,
+                                        source_cache)
+from dmlc_tpu.data.autoscale import WorkerAutoscaler
 from dmlc_tpu.data.rowrec import (
     RecordIORowParser,
     convert_to_recordio,
@@ -62,7 +66,13 @@ __all__ = [
     "BlockService",
     "RemoteBlockParser",
     "TruncatedFrame",
+    "DataBusyError",
     "DataDispatcher",
     "DispatcherClient",
+    "register_job",
+    "SourceCache",
+    "source_cache",
+    "reset_source_cache",
+    "WorkerAutoscaler",
     "reshard_split",
 ]

@@ -1,0 +1,189 @@
+"""Backlog-driven worker autoscaling for the dispatcher fleet.
+
+The disaggregated-ingest pitch (tf.data service, arXiv 2210.14826) is
+that input workers are FUNGIBLE — any worker can parse any chunk — which
+makes the fleet elastically sizable: the dispatcher's backlog (queued
+chunks nobody is parsing) is a direct demand signal, and adding or
+removing a worker needs no data movement at all. This module is the
+controller half of that loop:
+
+- **Scale up** when the queued-chunk backlog exceeds
+  ``backlog_per_worker`` per live worker: ``spawn()`` (caller-supplied —
+  typically ``lambda: BlockService(dispatcher=disp.address)``) brings up
+  workers that register themselves through the ordinary PR 9 machinery;
+  from the dispatcher's view they are indistinguishable from hand-
+  started ones.
+- **Scale down** by DRAINING, never killing: the controller picks the
+  live worker with the fewest held leases and calls
+  :meth:`~dmlc_tpu.data.dispatcher.DataDispatcher.drain_worker` (the
+  ``scale.drain`` chaos site). A draining worker takes no new leases,
+  its in-flight leases settle or expire normally, and its next idle
+  lease poll is answered ``retire`` — so a scale-down event can never
+  lose or duplicate a chunk, and per-job aggregates stay bit-identical
+  across the scale event (tests/test_chaos.py proves this). Once the
+  dispatcher delists the worker, ``retire(handle)`` (default:
+  ``handle.close()``) reclaims the process-side resources.
+- **One worker per tick** in either direction: the backlog signal is
+  sampled, and reacting gradually keeps an ingest burst from
+  oscillating the fleet.
+
+``step()`` is one synchronous evaluation (unit-testable, no thread);
+``start()`` runs it every ``DMLC_TPU_DATA_SCALE_INTERVAL_S`` seconds on
+a daemon thread. Telemetry: the ``dmlc_dispatch_backlog_count`` gauge
+(the signal), the ``dmlc_dispatch_scale_events_total`` counter and
+``scale.up`` / ``scale.down`` flight events (the actions — ``scale.down``
+is recorded by the dispatcher when the drained worker actually retires).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Callable, Dict, Optional
+
+from dmlc_tpu import obs
+from dmlc_tpu.obs.flight import record_event
+from dmlc_tpu.params.knobs import data_scale_interval_s
+from dmlc_tpu.utils.logging import check, log_warning
+
+
+class WorkerAutoscaler:
+    """Size a dispatcher's worker fleet to its queued-chunk backlog."""
+
+    def __init__(
+        self,
+        dispatcher,
+        spawn: Callable[[], object],
+        retire: Optional[Callable[[object], None]] = None,
+        min_workers: int = 1,
+        max_workers: int = 4,
+        backlog_per_worker: int = 4,
+        interval_s: Optional[float] = None,
+    ):
+        check(min_workers >= 0, "min_workers must be >= 0")
+        check(max_workers >= max(1, min_workers),
+              "max_workers must be >= max(1, min_workers)")
+        check(backlog_per_worker >= 1, "backlog_per_worker must be >= 1")
+        self.dispatcher = dispatcher
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+        self.backlog_per_worker = int(backlog_per_worker)
+        self.interval_s = data_scale_interval_s(interval_s)
+        self._spawn = spawn
+        self._retire = retire
+        # worker id -> spawned handle; only workers THIS controller
+        # spawned are retired through retire() — hand-started workers
+        # can be drained but their lifecycle belongs to their starter
+        self._handles: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = obs.registry()
+        self._g_backlog = reg.gauge(
+            "dmlc_dispatch_backlog_count",
+            "queued chunks with no worker parsing them (the autoscaler's "
+            "demand signal)")
+        self._m_scale = reg.counter(
+            "dmlc_dispatch_scale_events_total",
+            "autoscaler actions taken (spawns + drains initiated)")
+
+    def step(self) -> Dict[str, int]:
+        """One control-loop evaluation: sample backlog, take at most one
+        scaling action, reap retired workers. Returns the decision for
+        tests/telemetry: ``{"backlog", "live", "want", "spawned",
+        "draining"}``."""
+        snap = self.dispatcher.snapshot()
+        backlog = int(snap["chunks"]["queued"])
+        self._g_backlog.set(backlog)
+        live = {int(wid): w for wid, w in snap["workers"].items()
+                if w.get("live")}
+        with self._lock:
+            for wid in [w for w in self._handles if w not in live]:
+                # the dispatcher delisted it (drain completed, or it
+                # died): reclaim the process-side handle
+                handle = self._handles.pop(wid)
+                try:
+                    if self._retire is not None:
+                        self._retire(handle)
+                    else:
+                        handle.close()
+                except Exception as err:  # noqa: BLE001 — reap must go on
+                    log_warning(
+                        "autoscaler: retiring worker %d handle failed: %s",
+                        wid, err)
+        want = max(self.min_workers,
+                   min(self.max_workers,
+                       math.ceil(backlog / self.backlog_per_worker)))
+        spawned = 0
+        draining = len([w for w in live.values() if w.get("draining")])
+        nlive = len(live)
+        if want > nlive:
+            handle = self._spawn()
+            wid = int(getattr(handle, "_worker_id", -1))
+            with self._lock:
+                self._handles[wid] = handle
+            record_event("scale.up", worker=wid, backlog=backlog,
+                         live=nlive + 1)
+            self._m_scale.inc()
+            spawned = 1
+        elif want < nlive - draining:
+            # drain the least-loaded live worker; ties to the highest id
+            # (the newest spawn retires first — hand-started seed
+            # workers survive the autoscaler's churn longest)
+            victim = max(
+                (wid for wid, w in live.items() if not w.get("draining")),
+                key=lambda wid: (-live[wid].get("leased", 0), wid),
+                default=None)
+            if victim is not None:
+                try:
+                    self.dispatcher.drain_worker(victim)
+                except OSError as err:
+                    # injected scale.drain fault: skip this tick, the
+                    # backlog signal re-triggers the drain on the next
+                    log_warning(
+                        "autoscaler: drain of worker %d failed "
+                        "(retrying next tick): %s", victim, err)
+                else:
+                    self._m_scale.inc()
+                    draining += 1
+        return {"backlog": backlog, "live": nlive, "want": want,
+                "spawned": spawned, "draining": draining}
+
+    def start(self) -> "WorkerAutoscaler":
+        check(self._thread is None, "autoscaler already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="data-autoscaler")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as err:  # noqa: BLE001 — the controller is
+                # advisory; a failed tick must not kill the loop
+                log_warning("autoscaler tick failed: %s", err)
+
+    def close(self, retire_spawned: bool = False) -> None:
+        """Stop the control loop. With ``retire_spawned`` the handles
+        this controller spawned are closed too (fleet teardown)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if retire_spawned:
+            with self._lock:
+                handles = list(self._handles.values())
+                self._handles.clear()
+            for handle in handles:
+                try:
+                    handle.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

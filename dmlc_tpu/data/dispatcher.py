@@ -28,6 +28,28 @@ The :class:`DataDispatcher` provides it:
   through every (re)assignment — a requeued chunk's Perfetto arrow chain
   shows both workers that touched it.
 
+**Multi-tenant fleet mode** (docs/distributed.md "Multi-tenant fleet"):
+one dispatcher carries N *jobs* over one shared worker pool. Each job is
+a named, independent chunk ledger with its own epoch counter and
+exactly-once visitation state; :meth:`DataDispatcher.add_job` registers
+one (idempotent by name — a client that re-registers after a crash
+resumes the existing ack frontier instead of minting a fresh ledger),
+:meth:`remove_job` tears one down by releasing its leases without
+touching any other ledger. Lease requests that name a job are scoped to
+it (per-job in-flight quotas answer ``busy`` — backpressure, not
+failure); requests that don't are scheduled weighted-fair-share across
+jobs with queued work (lowest ``granted/weight`` first), so a hot job
+degrades gracefully instead of monopolizing the fleet. Admission above
+``DMLC_TPU_DATA_MAX_JOBS`` is refused with :class:`DataBusyError` — an
+``OSError`` on purpose, so the client's ``RetryPolicy`` already
+classifies it transient. Lease grants are cache-aware: among a job's
+queued chunks, a worker that already parsed a chunk's source part (the
+shared :mod:`~dmlc_tpu.data.source_cache` tier keeps it hot) is
+preferred for the re-serve. Workers can be *drained* for scale-down
+(:meth:`drain_worker` → autoscaler, data/autoscale.py): a draining
+worker gets no new leases and its next idle lease poll is answered
+``retire``.
+
 Lease deadlines trade exactly-once bookkeeping for liveness under false
 suspicion: a worker that is merely slow past its lease gets its chunk
 requeued, and the late delivery is then rejected — the chunk is still
@@ -50,9 +72,12 @@ consumers both use it) with transparent reconnect under the resilience
 from ``DMLC_TPU_DATA_CHUNKS``.
 
 The live worker/lease/requeue view is exported two ways: ``snapshot()``
-(the ``/data`` status-plane endpoint — see ``attach_plane``) and the
-``dmlc_dispatch_*`` metrics; requeues and worker deaths are also flight-
-recorder events (``service.requeue`` / ``service.worker_dead``).
+(the ``/data`` status-plane endpoint — see ``attach_plane``; per-job
+ledgers under its ``jobs`` key, old top-level keys kept byte-stable as
+cross-job aggregates) and the ``dmlc_dispatch_*`` metrics (per-job
+counters labeled ``job=``); requeues, worker deaths, job registrations
+and scale events are also flight-recorder events (``service.requeue`` /
+``service.worker_dead`` / ``dispatch.job_register`` / ``scale.down``).
 """
 
 from __future__ import annotations
@@ -62,16 +87,18 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 from dmlc_tpu import obs
 from dmlc_tpu.obs.flight import record_event
 from dmlc_tpu.params.knobs import (
     data_chunks,
     data_dead_after_s,
+    data_job_inflight,
     data_lease_s,
+    data_max_jobs,
 )
-from dmlc_tpu.utils.logging import check, log_warning
+from dmlc_tpu.utils.logging import DMLCError, check, log_warning
 
 # one framed message: u32 little-endian byte length + a JSON object.
 # Length cap so a stray connection speaking another protocol cannot make
@@ -86,6 +113,21 @@ _ACKED = "acked"
 # rows the lease table ships to /data (full accounting stays in the
 # counters; the table is a human debugging view)
 _SNAPSHOT_ROWS = 512
+
+# the implicit job every single-tenant dispatcher carries (jid 0): the
+# pre-multi-tenant RPC surface maps onto it, so legacy workers/clients
+# keep working byte-for-byte
+DEFAULT_JOB = "default"
+
+
+class DataBusyError(OSError):
+    """Admission refused under load (job cap reached).
+
+    An ``OSError`` on purpose: the resilience layer's
+    ``classify_transient`` already marks OSErrors retryable, so a caller
+    registering a job under the shared ``RetryPolicy`` backs off and
+    retries without any new classification plumbing — backpressure,
+    not failure."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -172,22 +214,60 @@ class DispatcherClient:
             self._drop_locked()
 
 
-class DataDispatcher:
-    """Registry of data workers + the lease table for one epoch's chunks.
+def register_job(
+    client: DispatcherClient,
+    name: str,
+    uri: str,
+    nchunks: Optional[int] = None,
+    data_format: str = "auto",
+    weight: float = 1.0,
+    max_inflight: Optional[int] = None,
+) -> Dict:
+    """Register (or resume) job ``name`` over the RPC surface.
 
-    ``uri`` is the dataset every worker can reach; it is split into
-    ``nchunks`` InputSplit parts served as one response frame each.
+    Idempotent: an existing ledger under the same name is resumed — the
+    reply's ``created`` is False and ``acked`` lists the seqs already
+    past the ack frontier, so a crashed client picks up where it left
+    off instead of re-reading the epoch. Raises :class:`DataBusyError`
+    when the dispatcher is at its ``DMLC_TPU_DATA_MAX_JOBS`` cap (the
+    caller's RetryPolicy classifies it transient) and ``DMLCError`` on
+    any other refusal."""
+    req = {"op": "job", "name": str(name), "uri": str(uri),
+           "data_format": str(data_format), "weight": float(weight)}
+    if nchunks is not None:
+        req["nchunks"] = int(nchunks)
+    if max_inflight is not None:
+        req["max_inflight"] = int(max_inflight)
+    reply = client.call(req)
+    if reply.get("busy"):
+        raise DataBusyError(
+            "dispatcher refused job %r: at its job cap "
+            "(DMLC_TPU_DATA_MAX_JOBS)" % name)
+    if not reply.get("ok"):
+        raise DMLCError(
+            "job registration %r failed: %s" % (name, reply.get("error")))
+    return reply
+
+
+class DataDispatcher:
+    """Registry of data workers + per-job lease tables over one fleet.
+
+    ``uri`` is the single-tenant convenience: when given, it becomes the
+    ``default`` job (jid 0) split into ``nchunks`` InputSplit parts —
+    the exact pre-multi-tenant surface. ``uri=None`` starts an empty
+    fleet manager; jobs arrive via :meth:`add_job` or the ``job`` RPC.
     ``lease_s``/``dead_after_s`` default through the
     ``DMLC_TPU_DATA_LEASE_S``/``DMLC_TPU_DATA_DEAD_S`` knobs. Expiry is
     scanned on every RPC (workers poll ``lease`` while idle, so a
     dispatcher with any live worker needs no timer thread).
 
-    Like the service it coordinates, a dispatcher is ONE epoch's pass:
-    re-create it per epoch, exactly like ``create_parser``."""
+    A job's ledger is ONE epoch's pass; :meth:`reset_job` starts the
+    next epoch over the same ledger (all chunks requeued, epoch counter
+    bumped) once the previous one is fully acked."""
 
     def __init__(
         self,
-        uri: str,
+        uri: Optional[str] = None,
         nchunks: Optional[int] = None,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -195,32 +275,24 @@ class DataDispatcher:
         dead_after_s: Optional[float] = None,
         data_format: str = "auto",
         plane=None,
+        max_jobs: Optional[int] = None,
     ):
-        nchunks = data_chunks(nchunks)
-        check(nchunks >= 1, "nchunks must be >= 1, got %d", nchunks)
-        self.uri = str(uri)
+        self.uri = str(uri) if uri is not None else None
         self.lease_s = data_lease_s(lease_s)
         self.dead_after_s = data_dead_after_s(dead_after_s)
+        self.max_jobs = data_max_jobs(max_jobs)
         self._lock = threading.Lock()
-        self._chunks: List[Dict] = [
-            {
-                "seq": k,
-                "uri": self.uri,
-                "part": k,
-                "nparts": nchunks,
-                "format": data_format,
-                "state": _QUEUED,
-                "worker": -1,
-                "client": -1,
-                "deadline": 0.0,
-                "requeues": 0,
-                "flow": 0,
-            }
-            for k in range(nchunks)
-        ]
+        self._jobs: Dict[int, Dict] = {}
+        self._job_names: Dict[str, int] = {}
+        self._next_jid = 0
         self._workers: Dict[int, Dict] = {}
         self._next_worker = 0
         self._next_client = 0
+        # chunk-source key -> worker ids that parsed it (their shared
+        # source-cache tier holds it hot); lease grants prefer a hot
+        # worker's chunks so a second job re-reading a source lands on
+        # the worker that can serve it without re-parsing
+        self._hot: Dict[Tuple, set] = {}
         # client id -> ids of live dispatcher connections that spoke for
         # it. A DELIVERED chunk requeues only when its holder has NO live
         # connection: consumer death is a dropped session, consumer
@@ -238,7 +310,6 @@ class DataDispatcher:
         self._m_chunks = reg.counter(
             "dmlc_dispatch_chunks_total",
             "chunks registered for lease-based dispatch")
-        self._m_chunks.inc(nchunks)
         self._m_requeued = reg.counter(
             "dmlc_dispatch_requeued_total",
             "chunk leases requeued after expiry or worker death")
@@ -251,6 +322,12 @@ class DataDispatcher:
         self._g_workers = reg.gauge(
             "dmlc_dispatch_workers_count", "live registered data workers")
         self._g_workers.set(0)
+        self._g_jobs = reg.gauge(
+            "dmlc_dispatch_jobs_count", "registered tenant jobs")
+        self._g_jobs.set(0)
+        if uri is not None:
+            self.add_job(DEFAULT_JOB, uri, nchunks=nchunks,
+                         data_format=data_format)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -263,6 +340,156 @@ class DataDispatcher:
         self._accept_thread.start()
         if plane is not None:
             self.attach_plane(plane)
+
+    # ---- job ledgers -----------------------------------------------------
+
+    def add_job(
+        self,
+        name: str,
+        uri: str,
+        nchunks: Optional[int] = None,
+        data_format: str = "auto",
+        weight: float = 1.0,
+        max_inflight: Optional[int] = None,
+    ) -> Dict:
+        """Register job ``name`` (idempotent) and return its ledger info.
+
+        A name already registered resumes the EXISTING ledger — chunk
+        states, epoch counter and ack frontier intact — so a client that
+        crashed and re-registered continues the epoch instead of
+        corrupting it with a fresh one (``created`` False, ``acked``
+        lists the settled seqs). A genuinely new job above the
+        ``DMLC_TPU_DATA_MAX_JOBS`` cap raises :class:`DataBusyError`.
+        ``weight`` biases the fair-share lease scheduler;
+        ``max_inflight`` caps the job's leased+delivered chunks (default
+        via ``DMLC_TPU_DATA_JOB_INFLIGHT``; 0 = uncapped)."""
+        name = str(name)
+        with self._lock:
+            jid = self._job_names.get(name)
+            if jid is not None:
+                job = self._jobs[jid]
+                return {
+                    "jid": jid, "epoch": job["epoch"], "created": False,
+                    "acked": [c["seq"] for c in job["chunks"]
+                              if c["state"] == _ACKED],
+                }
+            if len(self._jobs) >= self.max_jobs:
+                raise DataBusyError(
+                    "job cap reached (%d; DMLC_TPU_DATA_MAX_JOBS): "
+                    "cannot admit %r" % (self.max_jobs, name))
+            n = data_chunks(nchunks)
+            check(n >= 1, "nchunks must be >= 1, got %d", n)
+            jid = self._next_jid
+            self._next_jid += 1
+            reg = obs.registry()
+            job = {
+                "jid": jid,
+                "name": name,
+                "uri": str(uri),
+                "format": str(data_format),
+                "weight": max(0.001, float(weight)),
+                "max_inflight": (data_job_inflight()
+                                 if max_inflight is None
+                                 else max(0, int(max_inflight))),
+                "epoch": 1,
+                "granted": 0,
+                "requeued": 0,
+                "rejects": 0,
+                "dup_acks": 0,
+                "busy": 0,
+                "all_acked": threading.Event(),
+                "chunks": [
+                    {
+                        "seq": k,
+                        "job": jid,
+                        "uri": str(uri),
+                        "part": k,
+                        "nparts": n,
+                        "format": str(data_format),
+                        "state": _QUEUED,
+                        "worker": -1,
+                        "client": -1,
+                        "deadline": 0.0,
+                        "requeues": 0,
+                        "flow": 0,
+                    }
+                    for k in range(n)
+                ],
+                "m_acked": reg.counter(
+                    "dmlc_dispatch_job_acked_total",
+                    "chunks acked per tenant job", job=name),
+                "m_requeued": reg.counter(
+                    "dmlc_dispatch_job_requeued_total",
+                    "chunk leases requeued per tenant job", job=name),
+                "m_busy": reg.counter(
+                    "dmlc_dispatch_job_busy_total",
+                    "lease requests deferred by the job's in-flight quota",
+                    job=name),
+            }
+            reg.counter(
+                "dmlc_dispatch_job_chunks_total",
+                "chunks registered per tenant job", job=name).inc(n)
+            self._jobs[jid] = job
+            self._job_names[name] = jid
+            self._m_chunks.inc(n)
+            self._g_jobs.set(len(self._jobs))
+            self._all_acked.clear()
+        record_event("dispatch.job_register", job=name, jid=jid, chunks=n)
+        return {"jid": jid, "epoch": 1, "created": True, "acked": []}
+
+    def remove_job(self, name: str) -> bool:
+        """Tear down job ``name``: drop its ledger and release its leases
+        without touching any other job's accounting. False when the name
+        is unknown (teardown is idempotent too)."""
+        with self._lock:
+            jid = self._job_names.pop(str(name), None)
+            if jid is None:
+                return False
+            del self._jobs[jid]
+            self._g_jobs.set(len(self._jobs))
+            self._update_all_acked_locked()
+        return True
+
+    def reset_job(self, name: str) -> int:
+        """Start job ``name``'s next epoch: requeue every chunk of a
+        FULLY-ACKED ledger and bump the epoch counter (fresh flows, fresh
+        requeue counts). Returns the new epoch number; raises when the
+        current epoch has unsettled chunks — an epoch boundary is an ack
+        frontier, not a reset button."""
+        with self._lock:
+            jid = self._job_names.get(str(name))
+            check(jid is not None, "unknown job %r", name)
+            job = self._jobs[jid]
+            check(all(c["state"] == _ACKED for c in job["chunks"]),
+                  "job %r has unacked chunks; an epoch resets only at a "
+                  "full ack frontier", name)
+            for c in job["chunks"]:
+                c["state"] = _QUEUED
+                c["worker"] = -1
+                c["client"] = -1
+                c["deadline"] = 0.0
+                c["requeues"] = 0
+                c["flow"] = 0
+            job["epoch"] += 1
+            job["granted"] = 0
+            job["all_acked"].clear()
+            self._all_acked.clear()
+            return job["epoch"]
+
+    def drain_worker(self, wid: int) -> None:
+        """Mark worker ``wid`` draining for scale-down: it gets no new
+        leases, and once its in-flight leases settle, its next idle
+        lease poll is answered ``retire`` (the worker ends its stream
+        and the dispatcher delists it). The autoscaler calls this before
+        retiring a worker so no leased chunk is lost to the retirement."""
+        from dmlc_tpu.resilience import faultpoint
+
+        faultpoint("scale.drain")
+        with self._lock:
+            w = self._workers.get(int(wid))
+            check(w is not None, "unknown worker %d", wid)
+            if not w["dead"]:
+                w["draining"] = True
 
     # ---- transport ------------------------------------------------------
 
@@ -295,9 +522,9 @@ class DataDispatcher:
                 try:
                     reply = self._handle(obj)
                 except InjectedFault:
-                    # service.lease fault: kill the connection, exactly
-                    # like a dispatcher transport failure — the peer's
-                    # DispatcherClient reconnects and retries
+                    # injected lease-path fault: kill the connection,
+                    # exactly like a dispatcher transport failure — the
+                    # peer's DispatcherClient reconnects and retries
                     return
                 except Exception as err:  # noqa: BLE001 — relay, don't die
                     reply = {"ok": False,
@@ -342,10 +569,9 @@ class DataDispatcher:
         if op == "register":
             return self._op_register(obj)
         if op == "client":
-            with self._lock:
-                cid = self._next_client
-                self._next_client += 1
-            return {"ok": True, "client_id": cid}
+            return self._op_client(obj)
+        if op == "job":
+            return self._op_job(obj)
         if op == "heartbeat":
             with self._lock:
                 w = self._workers.get(int(obj.get("worker", -1)))
@@ -372,6 +598,56 @@ class DataDispatcher:
             return dict(self.snapshot(), ok=True)
         return {"ok": False, "error": "unknown op %r" % (op,)}
 
+    def _op_job(self, obj: Dict) -> Dict:
+        name = str(obj.get("name") or "")
+        if not name:
+            return {"ok": False, "error": "job op needs a name"}
+        if obj.get("remove"):
+            return {"ok": True, "removed": self.remove_job(name)}
+        if obj.get("reset"):
+            return {"ok": True, "epoch": self.reset_job(name)}
+        uri = obj.get("uri")
+        if uri is None:
+            return {"ok": False, "error": "job registration needs a uri"}
+        try:
+            info = self.add_job(
+                name, str(uri),
+                nchunks=obj.get("nchunks"),
+                data_format=str(obj.get("data_format", "auto")),
+                weight=float(obj.get("weight", 1.0)),
+                max_inflight=obj.get("max_inflight"),
+            )
+        except DataBusyError:
+            # typed backpressure on the wire: the registering client's
+            # register_job() raises DataBusyError (an OSError) locally,
+            # which its RetryPolicy already classifies transient
+            return {"ok": False, "busy": True}
+        return dict(info, ok=True)
+
+    def _op_client(self, obj: Dict) -> Dict:
+        name = obj.get("job")
+        with self._lock:
+            jid = 0
+            epoch = 1
+            acked: List[int] = []
+            if name is not None:
+                jid = self._job_names.get(str(name), -1)
+                if jid < 0:
+                    return {"ok": False,
+                            "error": "unknown job %r" % (name,)}
+            job = self._jobs.get(jid)
+            if job is not None:
+                epoch = job["epoch"]
+                # the resumed ack frontier: a client re-registering after
+                # a crash seeds its seen-set from this instead of
+                # re-reading settled chunks
+                acked = [c["seq"] for c in job["chunks"]
+                         if c["state"] == _ACKED]
+            cid = self._next_client
+            self._next_client += 1
+        return {"ok": True, "client_id": cid, "jid": jid, "epoch": epoch,
+                "acked": acked}
+
     def _op_register(self, obj: Dict) -> Dict:
         raw = obj.get("addr") or ("", 0)
         addr = (str(raw[0]), int(raw[1]))
@@ -395,6 +671,7 @@ class DataDispatcher:
                     "addr": addr,
                     "last_seen": time.monotonic(),
                     "dead": False,
+                    "draining": False,
                 }
             self._expire_locked()
         return {
@@ -405,10 +682,56 @@ class DataDispatcher:
             "heartbeat_s": max(0.05, self.dead_after_s / 3.0),
         }
 
+    def _all_chunks_locked(self) -> Iterable[Dict]:
+        for jid in sorted(self._jobs):
+            for c in self._jobs[jid]["chunks"]:
+                yield c
+
+    @staticmethod
+    def _chunk_key(c: Dict) -> Tuple:
+        return (c["uri"], c["part"], c["nparts"], c["format"])
+
+    def _unhot_worker_locked(self, wid: int) -> None:
+        for wids in self._hot.values():
+            wids.discard(wid)
+
+    def _drained_locked(self) -> bool:
+        """Every job's every chunk is delivered-or-acked (EOF for an
+        unrestricted lease; True for an empty dispatcher too)."""
+        return all(c["state"] in (_ACKED, _DELIVERED)
+                   for c in self._all_chunks_locked())
+
+    def _pick_job_locked(self) -> Optional[Dict]:
+        """Weighted fair-share: among jobs with queued work and headroom
+        under their in-flight cap, the fewest granted-leases-per-weight
+        wins (ties to the lowest jid — deterministic)."""
+        best = None
+        best_key = None
+        for jid in sorted(self._jobs):
+            job = self._jobs[jid]
+            if not any(c["state"] == _QUEUED for c in job["chunks"]):
+                continue
+            cap = job["max_inflight"]
+            if cap > 0:
+                inflight = sum(1 for c in job["chunks"]
+                               if c["state"] in (_LEASED, _DELIVERED))
+                if inflight >= cap:
+                    continue
+            key = job["granted"] / job["weight"]
+            if best is None or key < best_key:
+                best, best_key = job, key
+        return best
+
     def _op_lease(self, obj: Dict) -> Dict:
         from dmlc_tpu.resilience import faultpoint
 
         faultpoint("service.lease")
+        jid = int(obj.get("job", -1))
+        if jid >= 0:
+            # the job-scoped admission path has its own chaos site: a
+            # fault here kills one tenant's lease RPC without touching
+            # the shared service.lease plumbing
+            faultpoint("dispatch.lease_job")
         wid = int(obj.get("worker", -1))
         with self._lock:
             now = time.monotonic()
@@ -420,23 +743,65 @@ class DataDispatcher:
                     return {"ok": False, "dead": True}
                 w["last_seen"] = now
             self._expire_locked()
+            if w is not None and w.get("draining"):
+                if any(c["state"] == _LEASED and c["worker"] == wid
+                       for c in self._all_chunks_locked()):
+                    # in-flight leases settle (deliver or expire) first;
+                    # the worker keeps polling, which keeps it live
+                    return {"ok": True, "wait": True}
+                w["draining"] = False
+                w["dead"] = True
+                self._unhot_worker_locked(wid)
+                record_event("scale.down", worker=wid,
+                             addr="%s:%d" % w["addr"])
+                self._g_workers.set(len(
+                    [x for x in self._workers.values() if not x["dead"]]))
+                return {"ok": True, "retire": True}
+            if jid >= 0:
+                job = self._jobs.get(jid)
+                if job is None:
+                    return {"ok": False, "error": "unknown job id %d" % jid}
+                queued = [c for c in job["chunks"] if c["state"] == _QUEUED]
+                if not queued:
+                    # EOF once every chunk is delivered-or-acked: an
+                    # explicit-ack consumer (DeviceFeed) may hold
+                    # received rows across many batches before acking,
+                    # and gating EOF on acks would deadlock it against
+                    # its own worker. join() still waits for the full
+                    # ack frontier. "all" tells the worker whether the
+                    # WHOLE fleet is drained (it may serve other jobs).
+                    if all(c["state"] in (_ACKED, _DELIVERED)
+                           for c in job["chunks"]):
+                        return {"ok": True, "eof": True,
+                                "all": self._drained_locked()}
+                    return {"ok": True, "wait": True}
+                cap = job["max_inflight"]
+                if cap > 0:
+                    inflight = sum(1 for c in job["chunks"]
+                                   if c["state"] in (_LEASED, _DELIVERED))
+                    if inflight >= cap:
+                        # quota backpressure, not an error: the worker
+                        # polls again, the consumer just waits
+                        job["busy"] += 1
+                        job["m_busy"].inc()
+                        return {"ok": True, "busy": True}
+            else:
+                job = self._pick_job_locked()
+                if job is None:
+                    if self._drained_locked():
+                        return {"ok": True, "eof": True, "all": True}
+                    # leased chunks may still requeue; the worker polls
+                    # (each poll doubles as a heartbeat + expiry scan)
+                    return {"ok": True, "wait": True}
+                queued = [c for c in job["chunks"] if c["state"] == _QUEUED]
+            # cache-aware routing: this worker's hot chunks first (its
+            # source-cache tier already holds the parsed part), lowest
+            # seq otherwise — which keeps a cold fleet's assignment
+            # order deterministic
             chunk = next(
-                (c for c in self._chunks if c["state"] == _QUEUED), None)
-            if chunk is None:
-                # EOF once every chunk is delivered-or-acked: an
-                # explicit-ack consumer (DeviceFeed) may hold received
-                # rows across many batches before acking, and gating EOF
-                # on acks would deadlock it against its own worker. The
-                # expiry scan above ran first, so every delivered chunk
-                # here is either within its deadline or held by a
-                # consumer whose session is still alive; join() still
-                # waits for the full ack frontier.
-                if all(c["state"] in (_ACKED, _DELIVERED)
-                       for c in self._chunks):
-                    return {"ok": True, "eof": True}
-                # leased chunks may still requeue; the worker polls
-                # (each poll doubles as a heartbeat + expiry scan)
-                return {"ok": True, "wait": True}
+                (c for c in queued
+                 if wid in self._hot.get(self._chunk_key(c), ())),
+                queued[0])
             if not chunk["flow"]:
                 # one flow per chunk, minted at FIRST lease and carried
                 # through every reassignment — the merged trace's arrow
@@ -447,10 +812,14 @@ class DataDispatcher:
             chunk["worker"] = wid
             chunk["client"] = -1
             chunk["deadline"] = now + self.lease_s
+            job["granted"] += 1
+            self._hot.setdefault(
+                self._chunk_key(chunk), set()).add(wid)
             return {
                 "ok": True,
                 "chunk": {
                     "seq": chunk["seq"],
+                    "job": job["jid"],
                     "uri": chunk["uri"],
                     "part": chunk["part"],
                     "nparts": chunk["nparts"],
@@ -459,22 +828,24 @@ class DataDispatcher:
                 },
             }
 
-    def _chunk_locked(self, seq: int) -> Optional[Dict]:
-        if 0 <= seq < len(self._chunks):
-            return self._chunks[seq]
+    def _chunk_locked(self, jid: int, seq: int) -> Optional[Dict]:
+        job = self._jobs.get(jid)
+        if job is not None and 0 <= seq < len(job["chunks"]):
+            return job["chunks"][seq]
         return None
 
     def _op_recv(self, obj: Dict) -> Dict:
         cid = int(obj.get("client", -1))
+        jid = int(obj.get("job", 0))
         seq = int(obj.get("seq", -1))
         with self._lock:
             self._expire_locked()
-            c = self._chunk_locked(seq)
+            c = self._chunk_locked(jid, seq)
             if c is None:
                 return {"ok": False, "reject": True,
                         "error": "unknown seq %d" % seq}
             if c["state"] in (_LEASED, _QUEUED):
-                # a requeued-but-not-relesed chunk whose original send
+                # a requeued-but-not-releases chunk whose original send
                 # did land is reclaimed here: the bytes arrived, so this
                 # delivery wins and the requeue is undone
                 c["state"] = _DELIVERED
@@ -486,18 +857,22 @@ class DataDispatcher:
             # delivered to someone else or already acked: the reporter
             # must DROP this copy — that is the exactly-once guarantee
             self._rejects += 1
+            self._jobs[jid]["rejects"] += 1
             self._m_rejects.inc()
             return {"ok": True, "reject": True}
 
     def _op_ack(self, obj: Dict) -> Dict:
+        jid = int(obj.get("job", 0))
         seq = int(obj.get("seq", -1))
         with self._lock:
             self._expire_locked()
-            c = self._chunk_locked(seq)
+            c = self._chunk_locked(jid, seq)
             if c is None:
                 return {"ok": False, "error": "unknown seq %d" % seq}
+            job = self._jobs[jid]
             if c["state"] == _ACKED:
                 self._dup_acks += 1
+                job["dup_acks"] += 1
                 return {"ok": True, "dup": True}
             # an ack is authoritative from ANY state: the consumer holds
             # the rows, so even a chunk the expiry scan already requeued
@@ -507,100 +882,145 @@ class DataDispatcher:
             c["deadline"] = 0.0
             self._acked += 1
             self._m_acked.inc()
+            job["m_acked"].inc()
             if c["flow"]:
                 obs.flow_step(c["flow"], "chunk")
-            if all(ch["state"] == _ACKED for ch in self._chunks):
-                self._all_acked.set()
+            if all(ch["state"] == _ACKED for ch in job["chunks"]):
+                job["all_acked"].set()
+            self._update_all_acked_locked()
             return {"ok": True}
+
+    def _update_all_acked_locked(self) -> None:
+        if self._jobs and all(c["state"] == _ACKED
+                              for c in self._all_chunks_locked()):
+            self._all_acked.set()
 
     def _expire_locked(self) -> None:
         now = time.monotonic()
         for wid, w in self._workers.items():
             if not w["dead"] and now - w["last_seen"] > self.dead_after_s:
                 w["dead"] = True
+                w["draining"] = False
+                self._unhot_worker_locked(wid)
                 record_event("service.worker_dead", worker=wid,
                              addr="%s:%d" % w["addr"])
                 log_warning(
                     "data worker %d (%s:%d) declared dead (%.1fs silent)",
                     wid, w["addr"][0], w["addr"][1], now - w["last_seen"])
-        for c in self._chunks:
-            if c["state"] == _LEASED:
-                w = self._workers.get(c["worker"])
-                expired = (now > c["deadline"] or w is None or w["dead"])
-            elif c["state"] == _DELIVERED:
-                # the holder already HAS the rows — requeueing while it
-                # is alive would serve them twice. Its dispatcher session
-                # is the liveness signal: a crashed consumer drops the
-                # TCP connection; a slow one (jit compiles take minutes)
-                # keeps it open and keeps the chunk, however long past
-                # the deadline. The deadline still applies once the
-                # holder is gone.
-                expired = (now > c["deadline"]
-                           and c["client"] not in self._client_conns)
-            else:
-                continue
-            if not expired:
-                continue
-            record_event("service.requeue", seq=c["seq"], state=c["state"],
-                         worker=c["worker"], client=c["client"],
-                         requeues=c["requeues"] + 1)
-            c["state"] = _QUEUED
-            c["worker"] = -1
-            c["client"] = -1
-            c["deadline"] = 0.0
-            c["requeues"] += 1
-            self._requeued += 1
-            self._m_requeued.inc()
+        for jid in sorted(self._jobs):
+            job = self._jobs[jid]
+            for c in job["chunks"]:
+                if c["state"] == _LEASED:
+                    w = self._workers.get(c["worker"])
+                    expired = (now > c["deadline"]
+                               or w is None or w["dead"])
+                elif c["state"] == _DELIVERED:
+                    # the holder already HAS the rows — requeueing while
+                    # it is alive would serve them twice. Its dispatcher
+                    # session is the liveness signal: a crashed consumer
+                    # drops the TCP connection; a slow one (jit compiles
+                    # take minutes) keeps it open and keeps the chunk,
+                    # however long past the deadline. The deadline still
+                    # applies once the holder is gone.
+                    expired = (now > c["deadline"]
+                               and c["client"] not in self._client_conns)
+                else:
+                    continue
+                if not expired:
+                    continue
+                record_event("service.requeue", seq=c["seq"],
+                             job=job["name"], state=c["state"],
+                             worker=c["worker"], client=c["client"],
+                             requeues=c["requeues"] + 1)
+                c["state"] = _QUEUED
+                c["worker"] = -1
+                c["client"] = -1
+                c["deadline"] = 0.0
+                c["requeues"] += 1
+                self._requeued += 1
+                job["requeued"] += 1
+                self._m_requeued.inc()
+                job["m_requeued"].inc()
         self._g_workers.set(
             len([w for w in self._workers.values() if not w["dead"]]))
 
     # ---- read side ------------------------------------------------------
 
+    @staticmethod
+    def _counts(chunks: List[Dict]) -> Dict[str, int]:
+        counts = {_QUEUED: 0, _LEASED: 0, _DELIVERED: 0, _ACKED: 0}
+        for c in chunks:
+            counts[c["state"]] += 1
+        return {
+            "total": len(chunks),
+            "queued": counts[_QUEUED],
+            "leased": counts[_LEASED],
+            "delivered": counts[_DELIVERED],
+            "acked": counts[_ACKED],
+        }
+
+    @staticmethod
+    def _table(chunks: List[Dict], cap: int = _SNAPSHOT_ROWS) -> List[Dict]:
+        return [
+            {
+                "seq": c["seq"],
+                "state": c["state"],
+                "worker": c["worker"],
+                "client": c["client"],
+                "requeues": c["requeues"],
+            }
+            for c in chunks[:cap]
+        ]
+
     def snapshot(self) -> Dict:
         """The live worker/lease/requeue view (the ``/data`` endpoint
-        body). Exactly-once invariant at end of epoch:
-        ``chunks.acked == chunks.total`` with ``queued == leased ==
-        delivered == 0`` and every requeue drained."""
+        body). Top-level keys are the pre-multi-tenant surface —
+        aggregates across every job, byte-stable for existing consumers;
+        per-job ledgers live under ``jobs``. Exactly-once invariant at
+        end of epoch: ``chunks.acked == chunks.total`` with ``queued ==
+        leased == delivered == 0`` and every requeue drained."""
         with self._lock:
             self._expire_locked()
             now = time.monotonic()
-            counts = {_QUEUED: 0, _LEASED: 0, _DELIVERED: 0, _ACKED: 0}
-            table = []
-            for c in self._chunks:
-                counts[c["state"]] += 1
-                if len(table) < _SNAPSHOT_ROWS:
-                    table.append({
-                        "seq": c["seq"],
-                        "state": c["state"],
-                        "worker": c["worker"],
-                        "client": c["client"],
-                        "requeues": c["requeues"],
-                    })
+            all_chunks = list(self._all_chunks_locked())
+            jobs = {}
+            for jid in sorted(self._jobs):
+                job = self._jobs[jid]
+                jobs[job["name"]] = {
+                    "jid": jid,
+                    "uri": job["uri"],
+                    "epoch": job["epoch"],
+                    "weight": job["weight"],
+                    "max_inflight": job["max_inflight"],
+                    "granted": job["granted"],
+                    "busy": job["busy"],
+                    "requeued": job["requeued"],
+                    "rejects": job["rejects"],
+                    "duplicate_acks": job["dup_acks"],
+                    "chunks": self._counts(job["chunks"]),
+                    "lease_table": self._table(job["chunks"]),
+                }
             workers = {
                 str(wid): {
                     "addr": "%s:%d" % w["addr"],
                     "live": not w["dead"],
+                    "draining": bool(w.get("draining")),
                     "lag_s": round(now - w["last_seen"], 3),
                     "leased": len([
-                        c for c in self._chunks
+                        c for c in all_chunks
                         if c["state"] == _LEASED and c["worker"] == wid
                     ]),
                 }
                 for wid, w in sorted(self._workers.items())
             }
         return {
-            "chunks": {
-                "total": len(self._chunks),
-                "queued": counts[_QUEUED],
-                "leased": counts[_LEASED],
-                "delivered": counts[_DELIVERED],
-                "acked": counts[_ACKED],
-            },
+            "chunks": self._counts(all_chunks),
             "requeued": self._requeued,
             "rejects": self._rejects,
             "duplicate_acks": self._dup_acks,
             "workers": workers,
-            "lease_table": table,
+            "jobs": jobs,
+            "lease_table": self._table(all_chunks),
         }
 
     def attach_plane(self, plane) -> None:
@@ -608,9 +1028,17 @@ class DataDispatcher:
         endpoint (``StatusPlane.set_data_provider``)."""
         plane.set_data_provider(self.snapshot)
 
-    def join(self, timeout: Optional[float] = None) -> bool:
-        """Block until every chunk is acked (the epoch is complete);
-        True on completion, False on timeout."""
+    def join(self, timeout: Optional[float] = None,
+             job: Optional[str] = None) -> bool:
+        """Block until every chunk is acked — of job ``job`` when named,
+        of EVERY registered job otherwise (the epoch is complete); True
+        on completion, False on timeout."""
+        if job is not None:
+            with self._lock:
+                jid = self._job_names.get(str(job))
+                check(jid is not None, "unknown job %r", job)
+                event = self._jobs[jid]["all_acked"]
+            return event.wait(timeout)
         return self._all_acked.wait(timeout)
 
     def close(self) -> None:

@@ -32,12 +32,25 @@ second worker. Undelivered-block requeues are bounded by
 ``DMLC_TPU_DATA_PENDING_CAP`` with backpressure, metered as
 ``dmlc_service_requeued_total`` (distinct from drops).
 
+Multi-tenant fleet mode stacks on top (docs/distributed.md
+"Multi-tenant fleet"): a worker serves EVERY job's chunks from one
+process, consulting the job-shared :mod:`~dmlc_tpu.data.source_cache` so
+N jobs reading the same dataset parse it once, and
+``RemoteBlockParser(addr, dispatcher=True, job="name")`` scopes a
+consumer to its job's ledger — its fetches carry the job id, so one
+tenant's backlog or death never bleeds into another's stream. A worker
+the dispatcher retires for scale-down (data/autoscale.py) ends with a
+connection drop, never a clean EOS — its consumers fail over to the
+surviving workers exactly as if it had died.
+
 Wire format (little-endian, per response): u32 field count (0 = end of
 stream), then per field u8 name length + name, u8 dtype-string length +
 dtype, u64 byte length + raw array bytes. All RowBlock fields are 1-D.
-Requests are a single u32: 1 = NEXT, 2 = CLOSE. The format is
-name-addressed, so the dispatcher-mode extras (``seq``, ``flow``) are
-invisible to legacy clients — they simply never ``.get()`` them.
+Requests are a single u32: 1 = NEXT, 2 = CLOSE, 3 = NEXT_JOB followed
+by one u32 job id (scope the pull to that job's ledger). The format is
+name-addressed, so the dispatcher-mode extras (``seq``, ``flow``,
+``job``) are invisible to legacy clients — they simply never ``.get()``
+them.
 
 Like the parsers it serves, a service is ONE streaming pass (Parser
 semantics, data.h:298: "streaming one-pass"); epochs re-create service and
@@ -59,11 +72,16 @@ from dmlc_tpu import obs
 from dmlc_tpu.data.dispatcher import DispatcherClient, dispatcher_address
 from dmlc_tpu.data.parsers import Parser, create_parser
 from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
+from dmlc_tpu.data.source_cache import source_cache
 from dmlc_tpu.params.knobs import data_hedge_s, data_pending_cap
 from dmlc_tpu.utils.logging import DMLCError, check, log_warning
 
 _REQ_NEXT = 1
 _REQ_CLOSE = 2
+# NEXT scoped to one tenant job: the u32 request code is followed by a
+# u32 job id. A source-mode service treats it as plain NEXT (it has no
+# job ledgers), so one client codepath speaks to both service shapes.
+_REQ_NEXT_JOB = 3
 
 # Response sentinel in the u32 field-count slot: server-side parse failure.
 # Followed by u32 message length + utf-8 message; consumers raise DMLCError.
@@ -185,9 +203,16 @@ class BlockService:
         self._crashed = False  # injected worker_crash fired: the worker is
         # simulating sudden death (sockets closed, heartbeats stopped)
         self._drained = threading.Event()  # set when the stream is exhausted
-        self._pending: list = []  # blocks pulled but undelivered (their
-        # consumer died mid-send); redelivered before the next parser pull
-        # so those rows stay in the epoch
+        self._pending: Dict[int, list] = {}  # job id (-1 = legacy/source
+        # mode) -> blocks pulled but undelivered (their consumer died
+        # mid-send); redelivered before the next parser pull so those rows
+        # stay in the epoch — and only to a consumer of the SAME job
+        self._done_jids: set = set()  # jobs whose ledger this worker saw
+        # EOF for while the fleet as a whole still has work (per-job EOS
+        # without ending the worker's stream)
+        self._chunks_parsed = 0  # chunks actually parsed by THIS worker
+        # (source-cache hits excluded) — the cross-job cache proof reads
+        # this: a second job over a cached source must not move it
         self._error: Optional[DMLCError] = None  # parser failure, relayed to
         # every consumer instead of an opaque mid-frame close
         self._error_msg: Optional[str] = None  # plain one-line form of the
@@ -311,10 +336,17 @@ class BlockService:
             except OSError:
                 pass
 
-    def _parse_chunk(self, chunk: Dict) -> Dict[str, np.ndarray]:
-        """Parse one leased chunk descriptor into a single response frame
-        tagged with its ``seq`` (and the chunk's flow, so a reassigned
-        chunk's trace chain spans every worker that touched it)."""
+    @property
+    def chunks_parsed(self) -> int:
+        """Chunks this worker parsed for real (cache hits excluded)."""
+        return self._chunks_parsed
+
+    def _parse_chunk_fields(self, chunk: Dict) -> Dict[str, np.ndarray]:
+        """The actual parse: one chunk descriptor -> the frame's field
+        arrays, WITHOUT the per-lease seq/job/flow tags (this is the
+        source-cache entry shape — the tags differ per job and per
+        lease, the parsed bytes do not)."""
+        self._chunks_parsed += 1
         parser = create_parser(
             chunk["uri"], chunk["part"], chunk["nparts"],
             data_format=chunk.get("format", "auto"), **self._parser_kwargs)
@@ -333,34 +365,86 @@ class BlockService:
             arr = getattr(block, name)
             if arr is not None:
                 out[name] = np.asarray(arr)
+        return out
+
+    def _parse_chunk(self, chunk: Dict) -> Dict[str, np.ndarray]:
+        """Parse one leased chunk descriptor into a single response frame
+        tagged with its ``seq``/``job`` (and the chunk's flow, so a
+        reassigned chunk's trace chain spans every worker that touched
+        it). Parses go through the job-shared source cache when it is
+        enabled: N jobs leasing the same source part pay one parse, and
+        an injected ``cache.populate`` fault degrades to a direct
+        uncached parse — the tier costs performance, never rows."""
+        from dmlc_tpu.resilience import InjectedFault
+
+        fields = None
+        cache = source_cache()
+        if cache.enabled:
+            key = cache.chunk_key(
+                chunk["uri"], chunk["part"], chunk["nparts"],
+                chunk.get("format", "auto"), self._parser_kwargs)
+            try:
+                fields = cache.get_or_populate(
+                    key, lambda: self._parse_chunk_fields(chunk))
+            except InjectedFault:
+                fields = None
+        if fields is None:
+            fields = self._parse_chunk_fields(chunk)
+        out = dict(fields)  # the cached dict is shared across jobs —
+        # tag a copy, never the entry itself
         out["seq"] = np.asarray([chunk["seq"]], dtype=np.int64)
+        out["job"] = np.asarray(
+            [int(chunk.get("job", 0))], dtype=np.int64)
         fid = int(chunk.get("flow") or 0)
         if fid:
             out["flow"] = np.asarray([fid], dtype=np.int64)
         return out
 
-    def _next_chunk_arrays(self) -> Optional[Dict[str, np.ndarray]]:
+    def _pop_pending_locked(self, jid: int) -> Optional[Dict]:
+        """Pop one stashed undelivered frame deliverable to a consumer of
+        job ``jid`` (-1 = any job: the unscoped legacy pull). Lock held."""
+        if jid >= 0:
+            stash = self._pending.get(jid)
+            if stash:
+                return stash.pop(0)
+            return None
+        for key in sorted(self._pending):
+            if self._pending[key]:
+                return self._pending[key].pop(0)
+        return None
+
+    def _pending_total_locked(self) -> int:
+        return sum(len(v) for v in self._pending.values())
+
+    def _next_chunk_arrays(self, jid: int = -1
+                           ) -> Optional[Dict[str, np.ndarray]]:
         """Dispatcher-mode source: lease → parse → serve, one chunk per
-        call. The dispatcher is the shard point here (its lease table
-        assigns each chunk exactly once), so the local lock is NOT held
-        across the lease RPC or the parse — two consumer connections can
-        parse two leased chunks concurrently."""
+        call, scoped to job ``jid`` when >= 0. The dispatcher is the
+        shard point here (its lease table assigns each chunk exactly
+        once), so the local lock is NOT held across the lease RPC or the
+        parse — two consumer connections can parse two leased chunks
+        concurrently."""
         from dmlc_tpu.resilience import InjectedFault, faultpoint
 
         while True:
             with self._lock:
-                if self._pending:
+                stashed = self._pop_pending_locked(jid)
+                if stashed is not None:
                     self._cond.notify()
-                    return self._pending.pop(0)
+                    return stashed
                 if self._error is not None:
                     raise self._error
                 if self._crashed:
                     raise OSError("block service worker crashed")
                 if self._done:
                     return None
+                if jid >= 0 and jid in self._done_jids:
+                    return None  # this job's ledger already hit EOF
+            req = {"op": "lease", "worker": self._worker_id}
+            if jid >= 0:
+                req["job"] = jid
             try:
-                reply = self._dispatch.call(
-                    {"op": "lease", "worker": self._worker_id})
+                reply = self._dispatch.call(req)
             except DMLCError as err:
                 # the dispatcher is unreachable past retries. Without the
                 # control plane no further lease can be granted, so the
@@ -376,17 +460,42 @@ class BlockService:
                     self._done = True
                     self._drained.set()
                 return None
-            if reply.get("eof") or reply.get("dead"):
-                # eof: every chunk acked. dead: the dispatcher declared
-                # this worker dead while it was merely slow — it must not
-                # serve leases the table already reassigned.
+            if reply.get("retire"):
+                # scale-down: the dispatcher drained and delisted this
+                # worker. End the worker's stream and CUT the consumer
+                # off (transport error, not clean EOS) — the fleet still
+                # has work, and the consumer must fail over to a
+                # surviving worker to find it.
+                log_warning(
+                    "block service %s:%d retired by the dispatcher "
+                    "(scale-down)", self.address[0], self.address[1])
+                self._hb_stop.set()
+                with self._lock:
+                    self._done = True
+                    self._drained.set()
+                raise OSError("data worker retired (scale-down)")
+            if reply.get("dead") or (
+                    reply.get("eof") and (jid < 0 or reply.get("all"))):
+                # eof with "all": EVERY job's chunks are delivered-or-
+                # acked — the worker's stream is over. dead: the
+                # dispatcher declared this worker dead while it was
+                # merely slow — it must not serve leases the table
+                # already reassigned.
                 with self._lock:
                     self._done = True
                     self._drained.set()
                 return None
-            if reply.get("wait"):
-                # chunks exist but are leased/delivered elsewhere; they
-                # may yet requeue — poll (each poll heartbeats too)
+            if reply.get("eof"):
+                # only THIS job is done; the worker keeps serving the
+                # rest of the fleet. EOS for this consumer alone.
+                with self._lock:
+                    self._done_jids.add(jid)
+                return None
+            if reply.get("wait") or reply.get("busy"):
+                # wait: chunks exist but are leased/delivered elsewhere
+                # and may yet requeue. busy: the job's in-flight quota is
+                # full — backpressure, not failure. Poll either way
+                # (each poll heartbeats too).
                 time.sleep(0.05)
                 continue
             chunk = reply.get("chunk")
@@ -412,13 +521,17 @@ class BlockService:
             self._m_served.inc()
             return arrays
 
-    def _next_block_arrays(self) -> Optional[Dict[str, np.ndarray]]:
+    def _next_block_arrays(self, jid: int = -1
+                           ) -> Optional[Dict[str, np.ndarray]]:
         if self._dispatch is not None:
-            return self._next_chunk_arrays()
+            return self._next_chunk_arrays(jid)
+        # source mode has no job ledgers: a job-scoped request behaves
+        # exactly like NEXT (the jid was consumed off the wire already)
         with self._lock:
-            if self._pending:
+            stashed = self._pop_pending_locked(-1)
+            if stashed is not None:
                 self._cond.notify()
-                return self._pending.pop(0)
+                return stashed
             if self._error is not None:
                 raise self._error
             if self._done:
@@ -461,24 +574,29 @@ class BlockService:
         backpressures the stashing connection thread for up to
         ``_PENDING_WAIT_S`` waiting for a surviving consumer to drain it,
         then drops the block (metered as a drop, not a requeue) — a crash
-        storm must not buffer the whole dataset in one worker's memory."""
+        storm must not buffer the whole dataset in one worker's memory.
+        Stashed per job (the frame's ``job`` tag; -1 in source mode) so a
+        redelivery can only reach a consumer of the same tenant — the cap
+        is fleet-wide across jobs."""
+        job = arrays.get("job")
+        jid = int(job[0]) if job is not None and len(job) else -1
         with self._cond:
             if self._pending_cap > 0:
                 deadline = time.monotonic() + _PENDING_WAIT_S
-                while (len(self._pending) >= self._pending_cap
+                while (self._pending_total_locked() >= self._pending_cap
                        and not self._done):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
-                if len(self._pending) >= self._pending_cap:
+                if self._pending_total_locked() >= self._pending_cap:
                     self._m_dropped.inc()
                     log_warning(
                         "block service pending stash full (cap %d); "
                         "dropping an undelivered block (%d rows)",
                         self._pending_cap, len(arrays["offset"]) - 1)
                     return
-            self._pending.append(arrays)
+            self._pending.setdefault(jid, []).append(arrays)
             self._m_requeued.inc()
 
     def _send_response(self, conn: socket.socket, data: bytes) -> None:
@@ -508,14 +626,18 @@ class BlockService:
                 (req,) = struct.unpack("<I", _recv_exact(conn, 4))
                 unconfirmed = False  # another request: the consumer read
                 # the previous frame (it asked for more on the same pipe)
+                jid = -1
+                if req == _REQ_NEXT_JOB:
+                    (jid,) = struct.unpack("<I", _recv_exact(conn, 4))
                 try:
                     if req == _REQ_CLOSE:
                         return
                     check(
-                        req == _REQ_NEXT, "bad block service request %d", req
+                        req in (_REQ_NEXT, _REQ_NEXT_JOB),
+                        "bad block service request %d", req
                     )
                     try:
-                        undelivered = self._next_block_arrays()
+                        undelivered = self._next_block_arrays(jid)
                     except DMLCError:  # parser failure (stream is over)
                         try:
                             _send_error(conn, self._error_msg or "parse "
@@ -662,14 +784,17 @@ class BlockService:
         # that can unblock such a reader)
         if self._lock.acquire(timeout=1.0):
             try:
-                if self._pending:  # redelivery never happened — those rows
+                npending = self._pending_total_locked()
+                if npending:  # redelivery never happened — those rows
                     # left the epoch; surface the loss, don't exit "clean"
-                    self._m_dropped.inc(len(self._pending))
-                    rows = sum(len(a["offset"]) - 1 for a in self._pending)
+                    self._m_dropped.inc(npending)
+                    rows = sum(len(a["offset"]) - 1
+                               for stash in self._pending.values()
+                               for a in stash)
                     log_warning(
                         "block service closing with %d undelivered "
                         "block(s) (%d rows never reached a consumer)",
-                        len(self._pending), rows,
+                        npending, rows,
                     )
                     self._pending.clear()
                 self._cond.notify_all()  # release any backpressured stash
@@ -702,8 +827,15 @@ class RemoteBlockParser:
     (``DMLCError``): a parse failure must surface, not retry.
 
     Dispatcher mode (``dispatcher=True``, ``address`` = the dispatcher):
-    a failover client. Live workers are discovered via the dispatcher;
-    a worker death mid-fetch rotates to the next live worker. Every
+    a failover client. ``job=`` names the tenant ledger this consumer
+    reads (default: the dispatcher's ``default`` job); every fetch is
+    scoped to it, so another tenant's chunks can never land here and
+    another tenant's EOF never ends this stream. Registration resumes
+    the job's ack frontier: seqs already acked by a previous incarnation
+    of this client seed the seen-set, so a crash-restart drops their
+    redeliveries instead of double-consuming. Live workers are
+    discovered via the dispatcher; a worker death mid-fetch rotates to
+    the next live worker. Every
     received chunk is receipt-reported (``recv``) — the dispatcher
     REJECTS duplicates of a chunk someone else already holds, and the
     client silently drops rejected copies (exactly-once). Consumed
@@ -720,8 +852,12 @@ class RemoteBlockParser:
         address: Tuple[str, int],
         timeout: float = 60.0,
         dispatcher: bool = False,
+        job: Optional[str] = None,
     ):
         from dmlc_tpu.resilience import RetryPolicy, faultpoint
+
+        check(job is None or dispatcher,
+              "job= names a dispatcher ledger; it needs dispatcher=True")
 
         self._timeout = float(timeout)
         self.bytes_read = 0  # Parser API surface; obs mirror below
@@ -743,14 +879,26 @@ class RemoteBlockParser:
             self.address = dispatcher_address(address)
             self._dispatch: Optional[DispatcherClient] = DispatcherClient(
                 self.address, timeout=timeout)
-            reply = self._dispatch.call({"op": "client"})
+            req = {"op": "client"}
+            if job is not None:
+                req["job"] = str(job)
+            reply = self._dispatch.call(req)
+            if not reply.get("ok", True):
+                raise DMLCError(
+                    "dispatcher refused client registration: %s"
+                    % reply.get("error"))
             self._client_id = int(reply.get("client_id", -1))
+            self._jid = int(reply.get("jid", 0))
+            # the resumed ack frontier: chunks a previous incarnation of
+            # this job's client already settled — drop their redeliveries
+            self._seen.update(int(s) for s in reply.get("acked", []))
             self._sock: Optional[socket.socket] = None
             self._worker_pos = 0
             self._hedge_s = data_hedge_s()
             return
         self._dispatch = None
         self._client_id = -1
+        self._jid = 0
         self._worker_pos = 0
         self._hedge_s = 0.0
         self.address = (str(address[0]), int(address[1]))
@@ -838,7 +986,8 @@ class RemoteBlockParser:
         except ValueError:
             pass
         self._dispatch.call(
-            {"op": "ack", "client": self._client_id, "seq": int(seq)})
+            {"op": "ack", "client": self._client_id, "job": self._jid,
+             "seq": int(seq)})
 
     def _flush_acks(self) -> None:
         """Implicit ack frontier: everything received before this fetch
@@ -849,7 +998,8 @@ class RemoteBlockParser:
         while self._unacked:
             sid = self._unacked[0]
             self._dispatch.call(
-                {"op": "ack", "client": self._client_id, "seq": sid})
+                {"op": "ack", "client": self._client_id, "job": self._jid,
+                 "seq": sid})
             self._unacked.pop(0)
 
     # ---- fetch path ------------------------------------------------------
@@ -871,7 +1021,8 @@ class RemoteBlockParser:
             sock = self._dial_once(workers[next(picks) % len(workers)])
             socks.append(sock)
             try:
-                sock.sendall(struct.pack("<I", _REQ_NEXT))
+                sock.sendall(
+                    struct.pack("<II", _REQ_NEXT_JOB, self._jid))
                 return sock, _recv_arrays(sock)
             except Exception:
                 try:
@@ -918,7 +1069,13 @@ class RemoteBlockParser:
             sock = self._ensure_sock()
             self._inflight = True
             try:
-                sock.sendall(struct.pack("<I", _REQ_NEXT))
+                if self._dispatch is not None:
+                    # job-scoped pull: the worker leases from THIS job's
+                    # ledger only, so tenants never cross streams
+                    sock.sendall(
+                        struct.pack("<II", _REQ_NEXT_JOB, self._jid))
+                else:
+                    sock.sendall(struct.pack("<I", _REQ_NEXT))
                 return _recv_arrays(sock)
             except OSError:
                 self._drop_sock(advance=True)
@@ -952,11 +1109,13 @@ class RemoteBlockParser:
                     pass
                 return None
             seq = arrays.pop("seq", None)
+            arrays.pop("job", None)  # per-job framing tag; this client
+            # only ever pulls its own job, so the value is redundant here
             sid = int(seq[0]) if seq is not None and len(seq) else None
             if self._dispatch is not None and sid is not None:
                 reply = self._dispatch.call(
-                    {"op": "recv",
-                     "client": self._client_id, "seq": sid})
+                    {"op": "recv", "client": self._client_id,
+                     "job": self._jid, "seq": sid})
                 if reply.get("reject") or sid in self._seen:
                     # reject: another client already owns this chunk —
                     # the dispatcher's lease table is the exactly-once

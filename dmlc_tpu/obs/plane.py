@@ -153,10 +153,15 @@ class ObsPublisher:
         rank: int,
         reg: Optional[Registry] = None,
         max_spans: int = 4096,
+        job: Optional[str] = None,
     ):
         self.tracker_uri = tracker_uri
         self.tracker_port = int(tracker_port)
         self.rank = int(rank)
+        # multi-tenant fleets: the data-service job this rank consumes;
+        # rides every heartbeat as a "job=<name>" token so obs-top /
+        # obs-report group per-rank tables by tenant
+        self.job = str(job) if job else None
         self._reg = reg
         self._spans: Deque[Dict] = collections.deque(maxlen=max_spans)
         self._rtt_ns = 0
@@ -193,6 +198,7 @@ class ObsPublisher:
         try:
             _, profile_word = send_heartbeat(
                 self.tracker_uri, self.tracker_port, self.rank, epoch=epoch,
+                metrics=(f"job={self.job}" if self.job else ""),
                 obs_json=blob, timeout=timeout, want_profile=True,
             )
         except (OSError, ValueError) as err:

@@ -14,7 +14,9 @@ metric:
 
 - the headline metric (``value``, e.g. ``higgs_libsvm_ingest`` MB/s) and
   every ``extra`` key ending ``_mbps``/``_gbps``/``_mrows_s`` are
-  higher-is-better throughputs;
+  higher-is-better throughputs, as is the suffix-less
+  ``cache_cross_job_hit_ratio`` (multijob bench tier — a drop below its
+  1.0 history means a second tenant started re-parsing shared chunks);
 - ``extra["pipelined_stall_stages"]`` keys ending ``_s`` are gated
   lower-is-better as ``stall.<key>`` (a stall stage growing is exactly
   the regression shape flow tracing exists to localize);
@@ -56,6 +58,10 @@ DEFAULT_MAD_MULT = 2.0
 DEFAULT_MIN_SAMPLES = 2
 
 _HIGHER_SUFFIXES = ("_mbps", "_gbps", "_mrows_s")
+# higher-is-better extras that carry no unit suffix: the cross-job
+# source-cache hit ratio from the multijob bench tier (1.0 = the second
+# tenant parsed nothing)
+_HIGHER_KEYS = ("cache_cross_job_hit_ratio",)
 _STALL_PREFIX = "stall."
 # lower-is-better key families: stall stages, XLA compile counts, and
 # peak HBM (device_telemetry section)
@@ -183,7 +189,8 @@ def record_values(rec: Dict) -> Dict[str, float]:
     if not isinstance(extra, dict):
         return vals
     for key, v in extra.items():
-        if _is_number(v) and key.endswith(_HIGHER_SUFFIXES):
+        if _is_number(v) and (key.endswith(_HIGHER_SUFFIXES)
+                              or key in _HIGHER_KEYS):
             vals[key] = float(v)
     stalls = extra.get("pipelined_stall_stages")
     if isinstance(stalls, dict):

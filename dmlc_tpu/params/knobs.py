@@ -98,6 +98,22 @@ see docs/distributed.md "Disaggregated ingest") adds five more:
   dispatcher-mode client hedges the fetch against a second live worker
   (0 = hedging off, the default)
 
+The multi-tenant fleet layer (data/dispatcher.py jobs + the shared
+source cache + the autoscaler, see docs/distributed.md "Multi-tenant
+fleet") adds four more:
+
+- ``DMLC_TPU_DATA_MAX_JOBS`` — tenant jobs one dispatcher admits before
+  refusing registration with typed backpressure (DataBusyError;
+  default 8)
+- ``DMLC_TPU_DATA_JOB_INFLIGHT`` — default per-job cap on
+  leased+delivered chunks in flight; the fair-share scheduler answers
+  ``busy`` above it (0 = uncapped, the default)
+- ``DMLC_TPU_DATA_CACHE_MB`` — byte budget (in MiB) of the per-worker
+  job-shared source cache: N jobs reading one dataset parse it once
+  (default 256; 0 disables the tier, every parse goes direct)
+- ``DMLC_TPU_DATA_SCALE_INTERVAL_S`` — seconds between worker-autoscaler
+  control-loop ticks (default 1.0)
+
 Device telemetry (obs/device_telemetry.py, see docs/observability.md
 "Device telemetry") adds two more:
 
@@ -323,6 +339,41 @@ def data_pending_cap() -> int:
     return get_env("DMLC_TPU_DATA_PENDING_CAP", 64)
 
 
+def data_max_jobs(explicit: Optional[int] = None) -> int:
+    """Tenant jobs one dispatcher admits: explicit argument, else
+    ``DMLC_TPU_DATA_MAX_JOBS``, else 8. Registration past the cap is
+    refused with ``DataBusyError`` — typed backpressure the client's
+    RetryPolicy already classifies transient. Floor 1."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    return max(1, get_env("DMLC_TPU_DATA_MAX_JOBS", 8))
+
+
+def data_job_inflight() -> int:
+    """Default per-job in-flight chunk cap (leased + delivered) for the
+    fair-share lease scheduler (``DMLC_TPU_DATA_JOB_INFLIGHT``; 0 =
+    uncapped, the default). ``add_job(max_inflight=...)`` overrides it
+    per job."""
+    return max(0, get_env("DMLC_TPU_DATA_JOB_INFLIGHT", 0))
+
+
+def data_cache_mb() -> int:
+    """Byte budget in MiB for the job-shared source cache
+    (``DMLC_TPU_DATA_CACHE_MB``, default 256; 0 disables the tier —
+    every chunk parse goes direct). Read once, at first cache use."""
+    return max(0, get_env("DMLC_TPU_DATA_CACHE_MB", 256))
+
+
+def data_scale_interval_s(explicit: Optional[float] = None) -> float:
+    """Worker-autoscaler control-loop period in seconds: explicit
+    argument, else ``DMLC_TPU_DATA_SCALE_INTERVAL_S``, else 1.0. Floor
+    0.05 — the loop samples a snapshot per tick and must not busy-spin
+    the dispatcher lock."""
+    if explicit is not None:
+        return max(0.05, float(explicit))
+    return max(0.05, float(get_env("DMLC_TPU_DATA_SCALE_INTERVAL_S", 1.0)))
+
+
 def data_hedge_s() -> float:
     """Fetch-hedging threshold for dispatcher-mode clients in seconds
     (``DMLC_TPU_DATA_HEDGE_S``; 0 = hedging off, the default). Distinct
@@ -410,6 +461,11 @@ KNOWN_KNOBS = (
     "DMLC_TPU_DATA_DEAD_S",
     "DMLC_TPU_DATA_PENDING_CAP",
     "DMLC_TPU_DATA_HEDGE_S",
+    # multi-tenant fleet: jobs, shared source cache, autoscaler
+    "DMLC_TPU_DATA_MAX_JOBS",
+    "DMLC_TPU_DATA_JOB_INFLIGHT",
+    "DMLC_TPU_DATA_CACHE_MB",
+    "DMLC_TPU_DATA_SCALE_INTERVAL_S",
     # device telemetry
     "DMLC_TPU_DEVICE_TELEMETRY",
     "DMLC_TPU_HBM_POLL_S",

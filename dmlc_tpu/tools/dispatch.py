@@ -12,12 +12,20 @@ Usage::
     python -m dmlc_tpu.tools dispatch <uri> [--nchunks N] [--host H]
         [--port P] [--format auto|libsvm|libfm|csv|recordio]
         [--lease-s SECS] [--dead-after-s SECS] [--status-port P]
+        [--job NAME=URI ...]
 
 Prints ``dispatching HOST PORT`` on stdout once listening, then blocks
 until every chunk is acked (the epoch is complete) and prints a summary
 with the requeue count. ``--status-port`` additionally serves the live
 ``/data`` worker/lease/requeue view over HTTP (obs/plane.py status
 server; 0 = ephemeral port, printed as ``status HOST PORT``).
+
+Multi-tenant fleets: repeat ``--job NAME=URI`` to register extra jobs
+over the same worker pool (the positional ``uri`` stays the ``default``
+job; pass ``-`` for it to run named jobs only). Consumers select a
+ledger with ``RemoteBlockParser(addr, dispatcher=True, job=NAME)``; the
+epoch completes when EVERY job's chunks are acked, and the summary adds
+one ``job NAME: ...`` line per named job.
 """
 
 from __future__ import annotations
@@ -31,7 +39,9 @@ from dmlc_tpu.data import DataDispatcher
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("uri")
+    ap.add_argument("uri",
+                    help="dataset for the implicit 'default' job, or '-' "
+                         "to start with --job registrations only")
     ap.add_argument("--nchunks", type=int, default=None,
                     help="chunks to split the dataset into (default: the "
                          "DMLC_TPU_DATA_CHUNKS knob, 16)")
@@ -48,12 +58,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--status-port", type=int, default=None,
                     help="serve the /data lease view over HTTP on this "
                          "port (0 = ephemeral; default: no server)")
+    ap.add_argument("--job", action="append", default=[],
+                    metavar="NAME=URI",
+                    help="register an extra tenant job over the same "
+                         "worker fleet (repeatable); same --nchunks / "
+                         "--format as the default job")
     args = ap.parse_args(argv)
 
+    jobs = []
+    for spec in args.job:
+        name, sep, uri = spec.partition("=")
+        if not sep or not name or not uri:
+            ap.error(f"--job wants NAME=URI, got {spec!r}")
+        jobs.append((name, uri))
+    root_uri = None if args.uri == "-" else args.uri
+    if root_uri is None and not jobs:
+        ap.error("uri '-' needs at least one --job NAME=URI")
+
     disp = DataDispatcher(
-        args.uri, nchunks=args.nchunks, host=args.host, port=args.port,
+        root_uri, nchunks=args.nchunks, host=args.host, port=args.port,
         lease_s=args.lease_s, dead_after_s=args.dead_after_s,
         data_format=args.format)
+    for name, uri in jobs:
+        disp.add_job(name, uri, nchunks=args.nchunks,
+                     data_format=args.format)
     status = None
     if args.status_port is not None:
         from dmlc_tpu.obs.plane import StatusPlane, StatusServer
@@ -80,6 +108,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "deliveries rejected)" % (chunks["total"], chunks["acked"],
                                   snap["requeued"], snap["rejects"]),
         flush=True)
+    for name, _ in jobs:
+        job = snap["jobs"].get(name)
+        if job is None:
+            continue
+        print("job %s: %d/%d acked, %d requeued" % (
+            name, job["chunks"]["acked"], job["chunks"]["total"],
+            job["requeued"]), flush=True)
     return 0
 
 

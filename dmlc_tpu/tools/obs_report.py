@@ -10,7 +10,8 @@ Renders a human-readable summary of a job's observability artifacts:
   download, or any Chrome-trace JSON): per-stage time by rank and the
   cross-rank slack table, widest stage first — the critical-path view.
 - ``--status HOST:PORT`` — fetch ``/workers``, ``/data`` (the data
-  dispatcher's worker/lease/requeue view, when one is attached), and
+  dispatcher's worker/lease/requeue view, when one is attached — plus a
+  per-job ledger table on multi-tenant fleets), and
   ``/trace`` from a *live* tracker status server instead of files; also
   renders the device
   telemetry section (per-rank XLA compiles / recompile anomalies, device
@@ -103,10 +104,13 @@ def _report_reassignments(dumps: List[Dict]) -> None:
         print(f"{'seq':>5} {'state':<10} {'worker':>6} {'client':>6} "
               f"{'requeues':>8}")
         for rec in rows:
+            # multi-tenant dispatchers tag the event with the job name;
+            # pre-fleet dumps have no tag and render exactly as before
+            job = f"  job={rec['job']}" if rec.get("job") else ""
             print(f"{str(rec.get('seq')):>5} {str(rec.get('state')):<10} "
                   f"{str(rec.get('worker')):>6} "
                   f"{str(rec.get('client')):>6} "
-                  f"{str(rec.get('requeues')):>8}")
+                  f"{str(rec.get('requeues')):>8}{job}")
 
 
 def _report_data(data: Dict) -> bool:
@@ -144,6 +148,27 @@ def _report_data(data: Dict) -> bool:
                   f"{str(row.get('worker')):>6} "
                   f"{str(row.get('client')):>6} "
                   f"{str(row.get('requeues')):>8}")
+    jobs = data.get("jobs", {})
+    if len(jobs) > 1 or (jobs and "default" not in jobs):
+        # multi-tenant fleet: one ledger line per job, so a stalled or
+        # throttled tenant is visible without untangling the aggregates
+        print("== data service jobs ==")
+        print(f"{'job':<14} {'epoch':>5} {'weight':>6} {'cap':>4} "
+              f"{'queued':>6} {'infl':>5} {'acked':>6} {'requeued':>8} "
+              f"{'busy':>5}")
+        for name, job in sorted(jobs.items(),
+                                key=lambda kv: kv[1].get("jid", 0)):
+            chunks = job.get("chunks", {})
+            inflight = (chunks.get("leased", 0) or 0) + \
+                (chunks.get("delivered", 0) or 0)
+            cap = job.get("max_inflight", 0)
+            print(f"{name:<14} {str(job.get('epoch')):>5} "
+                  f"{job.get('weight', 1.0):>6.1f} "
+                  f"{(str(cap) if cap else '-'):>4} "
+                  f"{str(chunks.get('queued')):>6} {inflight:>5} "
+                  f"{str(chunks.get('acked')):>6} "
+                  f"{str(job.get('requeued')):>8} "
+                  f"{str(job.get('busy')):>5}")
     return True
 
 

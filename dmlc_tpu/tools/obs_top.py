@@ -32,6 +32,9 @@ from typing import Dict, List, Optional, Tuple
 _LINE_RE = re.compile(
     r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)$')
 _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+# multi-tenant fleets: a rank heartbeating "... job=<name> ..." is labeled
+# with its data-service job, and the table groups by it
+_JOB_RE = re.compile(r"\bjob=([\w.\-/]+)")
 
 
 def parse_metrics(text: str) -> List[Tuple[str, Dict[str, str], float]]:
@@ -125,6 +128,8 @@ def build_rows(
     rows = []
     for rank in sorted(ranks):
         info = workers.get(str(rank), {})
+        m = _JOB_RE.search(str(info.get("info") or ""))
+        job = m.group(1) if m else None
         count = consume_count.get(rank, 0.0)
         step_ms = (consume_sum.get(rank, 0.0) / count / 1e6) if count else 0.0
         if prev_h2d is not None and dt_s > 0 and rank in prev_h2d:
@@ -138,6 +143,7 @@ def build_rows(
             hbm_bytes = live.get(rank, 0.0)  # cpu backends: census only
         rows.append({
             "rank": rank,
+            "job": job,
             "epoch": info.get("epoch"),
             "lag_s": info.get("lag_s"),
             "straggler": bool(info.get("straggler")),
@@ -147,6 +153,10 @@ def build_rows(
             "compiles": int(compiles.get(rank, 0)),
             "recompiles": int(recompiles.get(rank, 0)),
         })
+    # multi-tenant fleet: ranks serving the same job sit together
+    # (unlabeled ranks first, then jobs alphabetically, rank within)
+    rows.sort(key=lambda r: (r["job"] is not None, r["job"] or "",
+                             r["rank"]))
     return rows, h2d_bytes
 
 
@@ -154,8 +164,12 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
     lines = []
     if world_version is not None:
         lines.append(f"world_version={world_version}")
+    # the job column appears only when some rank is labeled, so the
+    # single-tenant frame stays byte-identical to the pre-fleet layout
+    with_jobs = any(r.get("job") for r in rows)
+    job_hdr = f"{'job':>10} " if with_jobs else ""
     lines.append(
-        f"{'rank':>4} {'epoch':>6} {'lag_s':>7} {'step_ms':>8} "
+        f"{'rank':>4} {job_hdr}{'epoch':>6} {'lag_s':>7} {'step_ms':>8} "
         f"{'h2d_MBps':>9} {'hbm_MB':>8} {'compiles':>8} {'recomp':>6}  flag")
     if not rows:
         lines.append("(no ranks reporting yet)")
@@ -163,8 +177,10 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
         epoch = "-" if r["epoch"] is None else str(r["epoch"])
         lag = "-" if r["lag_s"] is None else f"{r['lag_s']:.2f}"
         flag = "STRAGGLER" if r["straggler"] else ""
+        job_col = f"{(r.get('job') or '-'):>10} " if with_jobs else ""
         lines.append(
-            f"{r['rank']:>4} {epoch:>6} {lag:>7} {r['step_ms']:>8.1f} "
+            f"{r['rank']:>4} {job_col}{epoch:>6} {lag:>7} "
+            f"{r['step_ms']:>8.1f} "
             f"{r['h2d_mbps']:>9.1f} {r['hbm_mb']:>8.1f} "
             f"{r['compiles']:>8d} {r['recompiles']:>6d}  {flag}")
     return "\n".join(lines)

@@ -83,6 +83,57 @@ finally:
 print("ci_checks: dispatcher failover smoke OK")
 EOF
 
+# two-job shared-cache smoke: tenants A and B read the SAME source over
+# one fleet; job B must be served entirely from the shared source cache
+# (zero chunk parses) with bit-identical rows — the PR 12 acceptance bar.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import sys, tempfile, os
+
+from dmlc_tpu import resilience
+from dmlc_tpu.data import (BlockService, DataDispatcher, RemoteBlockParser,
+                           reset_source_cache, source_cache)
+
+fd, path = tempfile.mkstemp(suffix=".svm")
+with os.fdopen(fd, "w") as fh:
+    for i in range(20):
+        fh.write("%d 1:%d\n" % (i % 2, i))
+try:
+    resilience.reset()
+    reset_source_cache()
+    def drain(job):
+        p = RemoteBlockParser(disp.address, dispatcher=True, job=job)
+        sig = sorted((b.label.tobytes(), b.value.tobytes()) for b in p)
+        p.close()
+        return sig
+    with DataDispatcher() as disp:
+        disp.add_job("a", path, nchunks=4)
+        disp.add_job("b", path, nchunks=4)
+        with BlockService(dispatcher=disp.address, nthread=1) as svc:
+            sig_a = drain("a")
+            parsed_a = svc.chunks_parsed
+            sig_b = drain("b")
+            parsed_b = svc.chunks_parsed - parsed_a
+            hits = source_cache().hits
+        ok = disp.join(timeout=30, job="a") and disp.join(timeout=30,
+                                                          job="b")
+    if not ok:
+        sys.exit("ci_checks: two-job smoke never drained both ledgers")
+    if parsed_a != 4:
+        sys.exit("ci_checks: job A parsed %d chunks, wanted 4" % parsed_a)
+    if parsed_b != 0:
+        sys.exit("ci_checks: job B re-parsed %d chunks; the shared cache "
+                 "missed" % parsed_b)
+    if hits < 4:
+        sys.exit("ci_checks: cross-job hit count %d < 4" % hits)
+    if sig_a != sig_b:
+        sys.exit("ci_checks: tenants saw different bytes for one source")
+finally:
+    resilience.reset()
+    reset_source_cache()
+    os.unlink(path)
+print("ci_checks: two-job shared-cache smoke OK (job B parsed 0 chunks)")
+EOF
+
 # parse-parity smoke: the scalar oracle, the numpy vector path, and (when
 # loaded) the native core must produce byte-identical RowBlocks over a
 # canned corpus of grammar corner cases. A digest mismatch here means the

@@ -19,6 +19,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
@@ -383,6 +384,180 @@ def test_chaos_data_service_storm(tmp_path):
     assert chaos_digest == clean_digest
     assert snap["chunks"]["acked"] == 8
     assert snap["chunks"]["queued"] == snap["chunks"]["leased"] == 0
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant chaos (PR 12): two jobs over one fleet — a client kill,
+# a scale event, or a cache fault in one tenant never perturbs another
+# tenant's exactly-once aggregate
+# ---------------------------------------------------------------------------
+
+def _multijob_files(tmp_path):
+    out = []
+    for tag, scale in (("a", 1), ("b", 3)):
+        path = tmp_path / f"job_{tag}.svm"
+        with open(path, "w") as fh:
+            for i in range(40):
+                fh.write(f"{i % 3} 1:{scale * i}\n")
+        out.append(str(path))
+    return out
+
+
+def _aggregate_job(address, job):
+    """Drain one job's epoch through its own consumer; order-insensitive
+    digest (same construction as _run_data_epoch)."""
+    from dmlc_tpu.data import RemoteBlockParser
+
+    parser = RemoteBlockParser(address, dispatcher=True, job=job)
+    w = np.zeros(3)
+    for block in parser:
+        w[0] += np.sum(np.asarray(block.label))
+        w[1] += np.sum(np.asarray(block.value))
+        w[2] += len(block)
+    parser.close()
+    return hashlib.sha256(w.tobytes()).hexdigest()
+
+
+def _solo_job_digest(path, nworkers=1):
+    """Baseline: the same job run alone on a fresh single-tenant fleet."""
+    from dmlc_tpu.data import (BlockService, DataDispatcher,
+                               reset_source_cache)
+
+    reset_source_cache()
+    with DataDispatcher() as disp:
+        disp.add_job("solo", path, nchunks=8)
+        workers = [BlockService(dispatcher=disp.address, nthread=1)
+                   for _ in range(nworkers)]
+        try:
+            digest = _aggregate_job(disp.address, "solo")
+            assert disp.join(timeout=30, job="solo")
+        finally:
+            for svc in workers:
+                svc.close()
+    return digest
+
+
+def test_chaos_multijob_client_killed_mid_epoch(tmp_path):
+    """Satellite acceptance: jobs A and B share a 2-worker fleet; B's
+    client is killed mid-epoch (sockets cut, chunks unacked). Job A's
+    epoch aggregate is bit-identical to a solo run of A, and B's leases
+    are all reclaimed to queued within the lease deadline — the dead
+    tenant holds nothing back."""
+    from dmlc_tpu.data import (BlockService, DataDispatcher,
+                               RemoteBlockParser, reset_source_cache)
+
+    path_a, path_b = _multijob_files(tmp_path)
+    solo_a = _solo_job_digest(path_a)
+    reset_source_cache()
+    lease_s = 1.0
+    with DataDispatcher(lease_s=lease_s, dead_after_s=0.75) as disp:
+        disp.add_job("a", path_a, nchunks=8)
+        disp.add_job("b", path_b, nchunks=8)
+        workers = [BlockService(dispatcher=disp.address, nthread=1)
+                   for _ in range(2)]
+        try:
+            # job B's client reads one chunk, never acks, then dies hard:
+            # both its sockets are cut as if the process was SIGKILLed
+            victim = RemoteBlockParser(disp.address, dispatcher=True,
+                                       job="b")
+            victim.set_explicit_ack()
+            assert victim.next_block() is not None
+            victim._dispatch._sock.close()
+            if victim._sock is not None:
+                victim._sock.close()
+            # the surviving tenant's full epoch, over the SAME fleet
+            digest_a = _aggregate_job(disp.address, "a")
+            assert digest_a == solo_a
+            assert disp.join(timeout=30, job="a")
+            # B's delivered-but-unacked and leased chunks reclaim within
+            # the lease deadline once its client session is gone
+            deadline = time.time() + 8 * lease_s
+            while time.time() < deadline:
+                jb = disp.snapshot()["jobs"]["b"]
+                if jb["chunks"]["queued"] == 8:
+                    break
+                time.sleep(0.1)
+            snap = disp.snapshot()
+            assert snap["jobs"]["b"]["chunks"]["queued"] == 8, snap["jobs"]
+            assert snap["jobs"]["b"]["requeued"] >= 1
+            # the survivor's ledger never saw the neighbor's crash
+            assert snap["jobs"]["a"]["chunks"]["acked"] == 8
+            assert snap["jobs"]["a"]["rejects"] == 0
+        finally:
+            for svc in workers:
+                svc.close()
+
+
+def test_chaos_scale_event_bit_identical(tmp_path):
+    """Tentpole acceptance: the autoscaler grows the fleet on backlog and
+    drains a worker back down MID-epoch; the consumer fails over off the
+    retiring worker and the aggregate is bit-identical to a clean run."""
+    from dmlc_tpu.data import (BlockService, DataDispatcher,
+                               RemoteBlockParser, WorkerAutoscaler,
+                               reset_source_cache)
+
+    clean_digest, _ = _run_data_epoch(tmp_path, "", nworkers=1)
+    reset_source_cache()
+    path = tmp_path / "chaos_1w.svm"  # same bytes as the clean run
+    with DataDispatcher(str(path), nchunks=8, lease_s=1.0,
+                        dead_after_s=0.75) as disp:
+        seed = BlockService(dispatcher=disp.address, nthread=1)
+        scaler = WorkerAutoscaler(
+            disp,
+            spawn=lambda: BlockService(dispatcher=disp.address, nthread=1),
+            min_workers=1, max_workers=2, backlog_per_worker=4)
+        try:
+            assert scaler.step()["spawned"] == 1  # backlog 8 -> 2 workers
+            parser = RemoteBlockParser(disp.address, dispatcher=True)
+            w = np.zeros(3)
+            blocks = 0
+            for block in parser:
+                w[0] += np.sum(np.asarray(block.label))
+                w[1] += np.sum(np.asarray(block.value))
+                w[2] += len(block)
+                blocks += 1
+                if blocks >= 4:
+                    # backlog has fallen: the controller starts (and then
+                    # sees through) the drain while rows still flow
+                    scaler.step()
+            parser.close()
+            assert disp.join(timeout=30), disp.snapshot()
+            snap = disp.snapshot()
+        finally:
+            scaler.close(retire_spawned=True)
+            seed.close()
+    assert hashlib.sha256(w.tobytes()).hexdigest() == clean_digest
+    assert snap["chunks"] == {"total": 8, "queued": 0, "leased": 0,
+                              "delivered": 0, "acked": 8}
+    # the scale-down really engaged: a worker is draining or retired
+    assert any(w_["draining"] or not w_["live"]
+               for w_ in snap["workers"].values()), snap["workers"]
+
+
+def test_chaos_job_lease_faults_retry_clean(tmp_path):
+    """The job-scoped admission path's own chaos site
+    (dispatch.lease_job) kills a tenant's lease RPC: the worker's
+    RetryPolicy re-dials and the epoch completes exactly-once."""
+    clean_digest, _ = _run_data_epoch(tmp_path, "", nworkers=1)
+    chaos_digest, snap = _run_data_epoch(
+        tmp_path, "dispatch.lease_job:nth=2", nworkers=2)
+    assert chaos_digest == clean_digest
+    assert snap["chunks"]["acked"] == 8
+
+
+def test_chaos_cache_populate_fault_degrades_not_corrupts(tmp_path):
+    """An injected cache.populate fault mid-epoch: the worker falls back
+    to a direct uncached parse — slower, never wrong."""
+    from dmlc_tpu.data import reset_source_cache
+
+    reset_source_cache()
+    clean_digest, _ = _run_data_epoch(tmp_path, "", nworkers=1)
+    reset_source_cache()
+    chaos_digest, snap = _run_data_epoch(
+        tmp_path, "cache.populate:nth=2", nworkers=2)
+    assert chaos_digest == clean_digest
+    assert snap["chunks"]["acked"] == 8
+    reset_source_cache()
 
 
 # ---------------------------------------------------------------------------

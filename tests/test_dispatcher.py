@@ -389,6 +389,7 @@ class TestFleetIntegration:
 
         p._dispatch = _Dispatch()
         p._client_id = 0
+        p._jid = 0
 
         def frame():
             return {
@@ -467,3 +468,403 @@ def test_dispatch_cli_end_to_end(svm_file):
         for proc in (disp, serve):
             if proc is not None and proc.poll() is None:
                 proc.kill()
+
+
+class TestMultiTenantFleet:
+    """PR 12: per-job ledgers, fair-share admission, quotas, drain-based
+    scale-down, cache-aware routing — all over the same RPC surface."""
+
+    @pytest.fixture()
+    def svm_pair(self, tmp_path):
+        paths = []
+        for tag, scale in (("a", 1), ("b", 3)):
+            path = tmp_path / f"{tag}.svm"
+            with open(path, "w") as fh:
+                for i in range(ROWS):
+                    fh.write(f"{i % 3} 1:{scale * i}\n")
+            paths.append(str(path))
+        return paths
+
+    @staticmethod
+    def _worker(cli):
+        return cli.call({"op": "register",
+                         "addr": ("127.0.0.1", 1)})["worker_id"]
+
+    def test_add_job_idempotent_and_snapshot_sections(self, svm_pair):
+        a, b = svm_pair
+        with DataDispatcher() as d:
+            info = d.add_job("jobA", a, nchunks=4)
+            assert info["created"] and info["epoch"] == 1
+            assert d.add_job("jobB", b, nchunks=2, weight=2.0)["jid"] != \
+                info["jid"]
+            # same name again: resumed, not recreated
+            again = d.add_job("jobA", a, nchunks=4)
+            assert not again["created"] and again["jid"] == info["jid"]
+            snap = d.snapshot()
+            assert set(snap["jobs"]) == {"jobA", "jobB"}
+            assert snap["jobs"]["jobA"]["chunks"]["total"] == 4
+            assert snap["jobs"]["jobB"]["weight"] == 2.0
+            # top level aggregates across jobs (old dashboards keep working)
+            assert snap["chunks"]["total"] == 6
+            assert snap["chunks"]["queued"] == 6
+
+    def test_fair_share_weighted_lease_interleaving(self, svm_pair):
+        """Unrestricted (legacy worker) leases are granted min(granted /
+        weight) first: a 3:1 weight split yields a 3:1 grant split."""
+        a, b = svm_pair
+        with DataDispatcher() as d:
+            ja = d.add_job("heavy", a, nchunks=8, weight=3.0)["jid"]
+            jb = d.add_job("light", b, nchunks=8, weight=1.0)["jid"]
+            cli = DispatcherClient(d.address)
+            wid = self._worker(cli)
+            got = [cli.call({"op": "lease", "worker": wid})["chunk"]["job"]
+                   for _ in range(8)]
+            assert got.count(ja) == 6 and got.count(jb) == 2
+            cli.close()
+
+    def test_job_inflight_quota_backpressure(self, svm_file):
+        """A job at its in-flight cap gets a typed busy reply, not a
+        lease; settling a chunk reopens the window."""
+        with DataDispatcher() as d:
+            jid = d.add_job("q", svm_file, nchunks=4, max_inflight=2)["jid"]
+            cli = DispatcherClient(d.address)
+            wid = self._worker(cli)
+            cid = cli.call({"op": "client", "job": "q"})["client_id"]
+            seqs = [cli.call({"op": "lease", "worker": wid,
+                              "job": jid})["chunk"]["seq"]
+                    for _ in range(2)]
+            busy = cli.call({"op": "lease", "worker": wid, "job": jid})
+            assert busy.get("busy") and "chunk" not in busy
+            assert cli.call({"op": "recv", "client": cid, "job": jid,
+                             "seq": seqs[0]})["ok"]
+            assert cli.call({"op": "ack", "client": cid, "job": jid,
+                             "seq": seqs[0]})["ok"]
+            third = cli.call({"op": "lease", "worker": wid, "job": jid})
+            assert third["chunk"]["seq"] == 2
+            assert d.snapshot()["jobs"]["q"]["busy"] >= 1
+            cli.close()
+
+    def test_job_cap_is_typed_backpressure(self, svm_pair):
+        """DMLC_TPU_DATA_MAX_JOBS overflow surfaces as DataBusyError —
+        an OSError, so RetryPolicy already classifies it transient."""
+        from dmlc_tpu.data import DataBusyError, register_job
+        from dmlc_tpu.resilience import classify_transient
+
+        a, b = svm_pair
+        with DataDispatcher(max_jobs=1) as d:
+            d.add_job("only", a, nchunks=2)
+            with pytest.raises(DataBusyError):
+                d.add_job("extra", b, nchunks=2)
+            cli = DispatcherClient(d.address)
+            # over the wire too, via the client-side helper
+            with pytest.raises(DataBusyError) as err:
+                register_job(cli, "extra", b, nchunks=2)
+            assert classify_transient(err.value)
+            # re-registering the EXISTING job is not an admission
+            assert not register_job(cli, "only", a, nchunks=2)["created"]
+            cli.close()
+
+    def test_reregistration_resumes_ack_frontier(self, svm_file):
+        """Satellite: a job re-registered after a crash resumes exactly
+        at its ack frontier — acked seqs come back so a restarted client
+        pre-seeds its dedup set instead of re-reading chunks."""
+        from dmlc_tpu.data import register_job
+
+        with DataDispatcher() as d:
+            jid = d.add_job("j", svm_file, nchunks=4)["jid"]
+            cli = DispatcherClient(d.address)
+            wid = self._worker(cli)
+            cid = cli.call({"op": "client", "job": "j"})["client_id"]
+            for _ in range(2):
+                seq = cli.call({"op": "lease", "worker": wid,
+                                "job": jid})["chunk"]["seq"]
+                cli.call({"op": "recv", "client": cid, "job": jid,
+                          "seq": seq})
+                cli.call({"op": "ack", "client": cid, "job": jid,
+                          "seq": seq})
+            # "crash": the driver comes back and re-registers the job
+            again = register_job(cli, "j", svm_file, nchunks=4)
+            assert not again["created"] and again["epoch"] == 1
+            assert sorted(again["acked"]) == [0, 1]
+            # a fresh client session sees the same frontier
+            fresh = cli.call({"op": "client", "job": "j"})
+            assert sorted(fresh["acked"]) == [0, 1]
+            cli.close()
+
+    def test_remove_job_releases_leases_without_cross_talk(self, svm_pair):
+        a, b = svm_pair
+        with DataDispatcher() as d:
+            d.add_job("keep", a, nchunks=2)
+            jb = d.add_job("gone", b, nchunks=2)["jid"]
+            cli = DispatcherClient(d.address)
+            wid = self._worker(cli)
+            cid = cli.call({"op": "client", "job": "gone"})["client_id"]
+            seq = cli.call({"op": "lease", "worker": wid,
+                            "job": jb})["chunk"]["seq"]
+            assert d.remove_job("gone")
+            assert not d.remove_job("gone")  # idempotent
+            snap = d.snapshot()
+            assert set(snap["jobs"]) == {"keep"}
+            assert snap["chunks"]["total"] == 2  # survivor only
+            # late RPCs against the removed ledger are errors, not crashes
+            late = cli.call({"op": "ack", "client": cid, "job": jb,
+                             "seq": seq})
+            assert not late.get("ok")
+            # the survivor leases normally
+            assert "chunk" in cli.call({"op": "lease", "worker": wid})
+            cli.close()
+
+    def test_reset_job_starts_new_epoch(self, svm_file):
+        with DataDispatcher() as d:
+            jid = d.add_job("e", svm_file, nchunks=2)["jid"]
+            cli = DispatcherClient(d.address)
+            wid = self._worker(cli)
+            cid = cli.call({"op": "client", "job": "e"})["client_id"]
+            for _ in range(2):
+                seq = cli.call({"op": "lease", "worker": wid,
+                                "job": jid})["chunk"]["seq"]
+                cli.call({"op": "recv", "client": cid, "job": jid,
+                          "seq": seq})
+                cli.call({"op": "ack", "client": cid, "job": jid,
+                          "seq": seq})
+            assert d.join(timeout=5, job="e")
+            assert d.reset_job("e") == 2
+            snap = d.snapshot()["jobs"]["e"]
+            assert snap["epoch"] == 2
+            assert snap["chunks"]["queued"] == 2
+            # the frontier reset too: clients start the epoch clean
+            assert cli.call({"op": "client", "job": "e"})["acked"] == []
+            cli.close()
+
+    def test_drain_worker_retires_when_idle(self, svm_file):
+        """Scale-down path: a draining worker finishes its leases, then
+        its next idle poll is answered `retire` and it is delisted."""
+        with DataDispatcher(svm_file, nchunks=2) as d:
+            cli = DispatcherClient(d.address)
+            w0 = self._worker(cli)
+            w1 = cli.call({"op": "register",
+                           "addr": ("127.0.0.1", 2)})["worker_id"]
+            cid = cli.call({"op": "client"})["client_id"]
+            seq = cli.call({"op": "lease", "worker": w1})["chunk"]["seq"]
+            d.drain_worker(w1)
+            # still holding a lease: not retired yet, but takes no new work
+            snap = d.snapshot()
+            assert snap["workers"][str(w1)]["draining"]
+            cli.call({"op": "recv", "client": cid, "seq": seq})
+            cli.call({"op": "ack", "client": cid, "seq": seq})
+            assert cli.call({"op": "lease", "worker": w1}).get("retire")
+            assert not d.snapshot()["workers"][str(w1)]["live"]
+            # the rest of the epoch proceeds on the survivor
+            seq = cli.call({"op": "lease", "worker": w0})["chunk"]["seq"]
+            cli.call({"op": "recv", "client": cid, "seq": seq})
+            cli.call({"op": "ack", "client": cid, "seq": seq})
+            assert d.join(timeout=5)
+            cli.close()
+
+    def test_drain_worker_faultpoint(self, svm_file):
+        """`scale.drain` chaos site: an injected fault aborts the drain
+        (worker keeps its leases); the retry succeeds."""
+        with DataDispatcher(svm_file, nchunks=1) as d:
+            cli = DispatcherClient(d.address)
+            wid = self._worker(cli)
+            resilience.configure("scale.drain:nth=1")
+            with pytest.raises(OSError):
+                d.drain_worker(wid)
+            assert not d.snapshot()["workers"][str(wid)]["draining"]
+            d.drain_worker(wid)
+            assert d.snapshot()["workers"][str(wid)]["draining"]
+            cli.close()
+
+    def test_cache_aware_routing_prefers_hot_worker(self, svm_file):
+        """Two jobs over the SAME source: the lease scheduler hands a
+        worker the parts it already parsed for the other job first, so
+        the shared source cache hits instead of re-parsing."""
+        with DataDispatcher() as d:
+            ja = d.add_job("first", svm_file, nchunks=2)["jid"]
+            jb = d.add_job("second", svm_file, nchunks=2)["jid"]
+            cli = DispatcherClient(d.address)
+            w0 = self._worker(cli)
+            w1 = cli.call({"op": "register",
+                           "addr": ("127.0.0.1", 2)})["worker_id"]
+            cid = cli.call({"op": "client", "job": "first"})["client_id"]
+            # job "first": w0 parses part 0, w1 parses part 1
+            assert cli.call({"op": "lease", "worker": w0,
+                             "job": ja})["chunk"]["seq"] == 0
+            assert cli.call({"op": "lease", "worker": w1,
+                             "job": ja})["chunk"]["seq"] == 1
+            for seq in (0, 1):
+                cli.call({"op": "recv", "client": cid, "job": ja,
+                          "seq": seq})
+                cli.call({"op": "ack", "client": cid, "job": ja,
+                          "seq": seq})
+            # job "second", asked by w1 FIRST: seq 1 is hot on w1, so it
+            # gets part 1 even though part 0 is the lower queued seq
+            assert cli.call({"op": "lease", "worker": w1,
+                             "job": jb})["chunk"]["seq"] == 1
+            assert cli.call({"op": "lease", "worker": w0,
+                             "job": jb})["chunk"]["seq"] == 0
+            cli.close()
+
+    def test_unknown_job_client_is_rejected(self, svm_file):
+        with DataDispatcher(svm_file, nchunks=1) as d:
+            cli = DispatcherClient(d.address)
+            reply = cli.call({"op": "client", "job": "nope"})
+            assert not reply.get("ok") and "nope" in reply.get("error", "")
+            cli.close()
+
+
+class _FakeDispatcher:
+    """Just enough of DataDispatcher's surface for the autoscaler."""
+
+    def __init__(self, queued, workers):
+        self.queued = queued
+        self.workers = workers  # wid -> {"live","draining","leased"}
+        self.drained = []
+
+    def snapshot(self):
+        return {"chunks": {"queued": self.queued},
+                "workers": {str(w): dict(info)
+                            for w, info in self.workers.items()}}
+
+    def drain_worker(self, wid):
+        self.drained.append(wid)
+        self.workers[wid]["draining"] = True
+
+
+class _FakeWorker:
+    def __init__(self, wid):
+        self._worker_id = wid
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestWorkerAutoscaler:
+    def test_scales_up_one_per_tick_to_backlog(self):
+        from dmlc_tpu.data import WorkerAutoscaler
+
+        disp = _FakeDispatcher(queued=8, workers={
+            0: {"live": True, "draining": False, "leased": 0}})
+        spawned = []
+
+        def spawn():
+            wid = len(spawned) + 1
+            disp.workers[wid] = {"live": True, "draining": False,
+                                 "leased": 0}
+            handle = _FakeWorker(wid)
+            spawned.append(handle)
+            return handle
+
+        scaler = WorkerAutoscaler(disp, spawn, min_workers=1, max_workers=3,
+                                  backlog_per_worker=4)
+        step = scaler.step()
+        assert step["want"] == 2 and step["spawned"] == 1
+        assert scaler.step()["spawned"] == 0  # want == live: steady state
+        disp.queued = 40
+        assert scaler.step()["want"] == 3  # capped at max_workers
+        assert len(spawned) == 2
+        scaler.close()
+
+    def test_drains_least_loaded_and_reaps(self):
+        from dmlc_tpu.data import WorkerAutoscaler
+
+        disp = _FakeDispatcher(queued=0, workers={
+            0: {"live": True, "draining": False, "leased": 3},
+            1: {"live": True, "draining": False, "leased": 1},
+            2: {"live": True, "draining": False, "leased": 1}})
+        handle = _FakeWorker(2)
+        scaler = WorkerAutoscaler(disp, spawn=lambda: None, min_workers=1,
+                                  max_workers=3, backlog_per_worker=4)
+        scaler._handles[2] = handle
+        scaler.step()
+        # least leases, ties to the HIGHEST wid: 2 drains before 1
+        assert disp.drained == [2]
+        assert scaler.step()["draining"] >= 1
+        # the dispatcher delists it once drained; the reaper closes it
+        del disp.workers[2]
+        scaler.step()
+        assert handle.closed and 2 not in scaler._handles
+        scaler.close()
+
+    def test_drain_fault_is_retried_next_tick(self):
+        from dmlc_tpu.data import WorkerAutoscaler
+
+        disp = _FakeDispatcher(queued=0, workers={
+            0: {"live": True, "draining": False, "leased": 0},
+            1: {"live": True, "draining": False, "leased": 0}})
+        real_drain, calls = disp.drain_worker, []
+
+        def flaky_drain(wid):
+            calls.append(wid)
+            if len(calls) == 1:
+                raise OSError("injected fault: scale.drain")
+            real_drain(wid)
+
+        disp.drain_worker = flaky_drain
+        scaler = WorkerAutoscaler(disp, spawn=lambda: None, min_workers=1,
+                                  max_workers=2, backlog_per_worker=4)
+        scaler.step()   # drain raises: swallowed, no state change
+        assert not disp.drained
+        scaler.step()   # retried
+        assert disp.drained == [1]
+        scaler.close()
+
+
+class TestMultiTenantTools:
+    def test_obs_top_groups_ranks_by_job(self):
+        """Ranks heartbeating a job=<name> token are labeled and grouped;
+        a jobless fleet renders the exact pre-fleet header."""
+        from dmlc_tpu.tools.obs_top import build_rows, render_table
+
+        workers = {"workers": {
+            "0": {"info": "epoch=1 job=tenantB", "epoch": 1, "lag_s": 0.1},
+            "1": {"info": "epoch=1 job=tenantA", "epoch": 1, "lag_s": 0.1},
+            "2": {"info": "epoch=1", "epoch": 1, "lag_s": 0.1},
+        }}
+        rows, _ = build_rows("", workers)
+        # unlabeled first, then jobs alphabetically
+        assert [(r["rank"], r["job"]) for r in rows] == \
+            [(2, None), (1, "tenantA"), (0, "tenantB")]
+        table = render_table(rows)
+        assert "job" in table.splitlines()[0]
+        assert "tenantA" in table and "tenantB" in table
+        solo, _ = build_rows("", {"workers": {
+            "0": {"info": "epoch=1", "epoch": 1, "lag_s": 0.1}}})
+        assert "job" not in render_table(solo).splitlines()[0]
+
+    def test_obs_report_renders_per_job_ledgers(self, capsys):
+        from dmlc_tpu.tools.obs_report import _report_data
+
+        assert _report_data({
+            "attached": True,
+            "chunks": {"total": 4, "queued": 1, "leased": 1, "delivered": 0,
+                       "acked": 2},
+            "requeued": 0, "rejects": 0, "duplicate_acks": 0,
+            "workers": {}, "lease_table": [],
+            "jobs": {
+                "alpha": {"jid": 0, "epoch": 1, "weight": 3.0,
+                          "max_inflight": 2, "requeued": 0, "busy": 5,
+                          "chunks": {"total": 2, "queued": 0, "leased": 1,
+                                     "delivered": 0, "acked": 1}},
+                "beta": {"jid": 1, "epoch": 2, "weight": 1.0,
+                         "max_inflight": 0, "requeued": 1, "busy": 0,
+                         "chunks": {"total": 2, "queued": 1, "leased": 0,
+                                    "delivered": 0, "acked": 1}},
+            },
+        })
+        out = capsys.readouterr().out
+        assert "== data service jobs ==" in out
+        alpha = next(line for line in out.splitlines()
+                     if line.startswith("alpha"))
+        assert "3.0" in alpha and " 2 " in alpha  # weight + cap rendered
+        beta = next(line for line in out.splitlines()
+                    if line.startswith("beta"))
+        assert " - " in beta  # uncapped renders as '-'
+        # single default job: no jobs section, pre-fleet body unchanged
+        assert _report_data({
+            "attached": True, "chunks": {}, "workers": {},
+            "lease_table": [],
+            "jobs": {"default": {"jid": 0, "chunks": {}}},
+        })
+        assert "jobs" not in capsys.readouterr().out

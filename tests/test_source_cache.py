@@ -1,0 +1,197 @@
+"""Job-shared source cache (data/source_cache.py): spec-digest keying,
+LRU byte budget, single-flight population with leader re-election, and
+the end-to-end zero-parse guarantee for a second job over the same
+source.
+
+The chaos angle (``cache.populate`` faults degrade to a direct parse,
+never corrupt) is covered in tests/test_chaos.py.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import resilience
+from dmlc_tpu.data import (BlockService, DataDispatcher, RemoteBlockParser,
+                           SourceCache, reset_source_cache, source_cache)
+
+ROWS = 24
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    resilience.reset()
+    reset_source_cache()
+    yield
+    resilience.reset()
+    reset_source_cache()
+
+
+def _frame(nbytes):
+    return {"x": np.zeros(nbytes // 8, dtype=np.float64)}
+
+
+class TestChunkKey:
+    def test_digest_covers_full_source_spec(self):
+        base = SourceCache.chunk_key("a.svm", 0, 4, "libsvm", {"k": 1})
+        assert base == SourceCache.chunk_key("a.svm", 0, 4, "libsvm",
+                                             {"k": 1})
+        for other in (
+            SourceCache.chunk_key("b.svm", 0, 4, "libsvm", {"k": 1}),
+            SourceCache.chunk_key("a.svm", 1, 4, "libsvm", {"k": 1}),
+            SourceCache.chunk_key("a.svm", 0, 8, "libsvm", {"k": 1}),
+            SourceCache.chunk_key("a.svm", 0, 4, "csv", {"k": 1}),
+            SourceCache.chunk_key("a.svm", 0, 4, "libsvm", {"k": 2}),
+            SourceCache.chunk_key("a.svm", 0, 4, "libsvm"),
+        ):
+            assert other != base
+
+
+class TestLRUBudget:
+    def test_hit_miss_accounting_and_populate_once(self):
+        cache = SourceCache(cap_bytes=1 << 20)
+        calls = []
+
+        def populate():
+            calls.append(1)
+            return _frame(1024)
+
+        first = cache.get_or_populate("k", populate)
+        second = cache.get_or_populate("k", populate)
+        assert first is second and len(calls) == 1
+        assert cache.stats() == {"entries": 1, "bytes": 1024, "hits": 1,
+                                 "misses": 1, "evictions": 0}
+
+    def test_lru_evicts_coldest_first(self):
+        cache = SourceCache(cap_bytes=2048)
+        cache.get_or_populate("a", lambda: _frame(1024))
+        cache.get_or_populate("b", lambda: _frame(1024))
+        cache.get_or_populate("a", lambda: _frame(1024))  # refresh a
+        cache.get_or_populate("c", lambda: _frame(1024))  # evicts b
+        assert cache.evictions == 1
+        hits = cache.hits
+        cache.get_or_populate("a", lambda: _frame(1024))
+        assert cache.hits == hits + 1  # a survived: it was warmer than b
+        refilled = []
+        cache.get_or_populate("b", lambda: refilled.append(1) or
+                              _frame(1024))
+        assert refilled  # b really was evicted
+
+    def test_oversized_entry_served_uncached(self):
+        cache = SourceCache(cap_bytes=512)
+        cache.get_or_populate("small", lambda: _frame(256))
+        out = cache.get_or_populate("huge", lambda: _frame(4096))
+        assert len(out["x"]) == 4096 // 8
+        # the working set was NOT flushed for the one oversized entry
+        assert cache.stats()["entries"] == 1
+        assert cache.resident_bytes == 256
+
+    def test_cap_zero_disables_tier(self):
+        cache = SourceCache(cap_bytes=0)
+        assert not cache.enabled
+        assert SourceCache(cap_bytes=1).enabled
+
+
+class TestSingleFlight:
+    def test_concurrent_first_readers_parse_once(self):
+        cache = SourceCache(cap_bytes=1 << 20)
+        release = threading.Event()
+        calls = []
+
+        def populate():
+            calls.append(1)
+            release.wait(timeout=5)
+            return _frame(512)
+
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(
+                    cache.get_or_populate("k", populate)))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        while not calls:  # a leader is elected and inside populate()
+            pass
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(calls) == 1 and len(results) == 4
+        assert all(r is results[0] for r in results)
+        assert cache.hits == 3 and cache.misses == 1
+
+    def test_leader_failure_wakes_followers_to_reelect(self):
+        cache = SourceCache(cap_bytes=1 << 20)
+        entered = threading.Event()
+        release = threading.Event()
+        calls = []
+
+        def doomed():
+            calls.append("leader")
+            entered.set()
+            release.wait(timeout=5)
+            raise RuntimeError("parse blew up")
+
+        def fine():
+            calls.append("follower")
+            return _frame(512)
+
+        errs = []
+
+        def leader_thread():
+            try:
+                cache.get_or_populate("k", doomed)
+            except RuntimeError as err:
+                errs.append(err)
+
+        follower_out = []
+        leader = threading.Thread(target=leader_thread)
+        leader.start()
+        assert entered.wait(timeout=5)
+        follower = threading.Thread(
+            target=lambda: follower_out.append(
+                cache.get_or_populate("k", fine)))
+        follower.start()
+        release.set()
+        leader.join(timeout=10)
+        follower.join(timeout=10)
+        # the failure reached the leader, the follower re-elected and won
+        assert len(errs) == 1 and calls == ["leader", "follower"]
+        assert follower_out and cache.misses == 1
+
+
+class TestCrossJobZeroParse:
+    @pytest.fixture()
+    def svm_file(self, tmp_path):
+        path = tmp_path / "shared.svm"
+        with open(path, "w") as fh:
+            for i in range(ROWS):
+                fh.write(f"{i % 3} 1:{i} 2:{2 * i}\n")
+        return str(path)
+
+    def test_second_job_parses_zero_chunks(self, svm_file):
+        """The PR's acceptance bar: job B over the same source as job A
+        is served entirely from the shared cache — the worker performs
+        ZERO chunk parses for it, and the rows are bit-identical."""
+        def drain(parser):
+            sig = []
+            for block in parser:
+                sig.append((block.label.tobytes(), block.value.tobytes()))
+            parser.close()
+            return sorted(sig)
+
+        with DataDispatcher() as disp:
+            disp.add_job("a", svm_file, nchunks=4)
+            disp.add_job("b", svm_file, nchunks=4)
+            with BlockService(dispatcher=disp.address, nthread=1) as svc:
+                sig_a = drain(RemoteBlockParser(
+                    disp.address, dispatcher=True, job="a"))
+                parsed_after_a = svc.chunks_parsed
+                assert parsed_after_a == 4
+                sig_b = drain(RemoteBlockParser(
+                    disp.address, dispatcher=True, job="b"))
+                assert svc.chunks_parsed == parsed_after_a  # zero parses
+                assert sig_b == sig_a
+                assert source_cache().hits >= 4

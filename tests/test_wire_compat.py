@@ -210,3 +210,55 @@ def test_legacy_fields_unchanged_on_wire(tmp_path):
     assert "seq" not in fields  # legacy mode mints no sequence ids
     np.testing.assert_array_equal(fields["label"], [1.0, 0.0])
     np.testing.assert_allclose(fields["value"][::2], [0.5, 2.5])
+
+
+def test_data_snapshot_top_level_byte_stable_with_jobs(tmp_path):
+    """Satellite pin: the multi-tenant dispatcher's /data body keeps the
+    pre-PR-12 top-level keys with the exact same shapes and values (they
+    are now aggregates across jobs); the per-job ledgers are purely
+    ADDITIVE under the new "jobs" key. A dashboard built against the PR 9
+    schema parses this byte-for-byte."""
+    import json
+
+    from dmlc_tpu.data import DataDispatcher
+    from dmlc_tpu.data.dispatcher import DispatcherClient
+
+    path = tmp_path / "stable.svm"
+    with open(path, "w") as fh:
+        for i in range(8):
+            fh.write(f"{i % 2} 1:{i}\n")
+    with DataDispatcher(str(path), nchunks=2) as disp:
+        cli = DispatcherClient(disp.address)
+        wid = cli.call({"op": "register",
+                        "addr": ("127.0.0.1", 9)})["worker_id"]
+        cid = cli.call({"op": "client"})["client_id"]
+        seq = cli.call({"op": "lease", "worker": wid})["chunk"]["seq"]
+        assert cli.call({"op": "recv", "client": cid, "seq": seq})["ok"]
+        snap = disp.snapshot()
+        cli.close()
+    legacy_keys = ["chunks", "requeued", "rejects", "duplicate_acks",
+                   "workers", "lease_table"]
+    legacy = {k: snap[k] for k in legacy_keys}
+    legacy["workers"][str(wid)]["lag_s"] = 0.0  # wall-clock, not schema
+    expected = {
+        "chunks": {"total": 2, "queued": 1, "leased": 0, "delivered": 1,
+                   "acked": 0},
+        "requeued": 0,
+        "rejects": 0,
+        "duplicate_acks": 0,
+        "workers": {str(wid): {"addr": "127.0.0.1:9", "live": True,
+                               "draining": False, "lag_s": 0.0,
+                               "leased": 0}},
+        "lease_table": [
+            {"seq": 0, "state": "delivered", "worker": wid, "client": cid,
+             "requeues": 0},
+            {"seq": 1, "state": "queued", "worker": -1, "client": -1,
+             "requeues": 0},
+        ],
+    }
+    assert json.dumps(legacy, sort_keys=True) == \
+        json.dumps(expected, sort_keys=True)
+    # the implicit single job mirrors the aggregates exactly
+    job = snap["jobs"]["default"]
+    assert job["chunks"] == snap["chunks"]
+    assert job["lease_table"] == snap["lease_table"]
