@@ -1060,7 +1060,9 @@ _COMPACT_KEYS = (
     "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
     "socket_note", "psum_single_device_gbps", "psum_step_ms",
     "psum_devices", "psum_platform", "psum_algo_gbps",
-    "psum_ici_utilization", "bucket_fused_ms", "bucket_per_tensor_ms",
+    "psum_ici_utilization", "spmd_psum_step_gbps", "spmd_step_ms",
+    "spmd_devices", "spmd_platform", "ici_utilization",
+    "bucket_fused_ms", "bucket_per_tensor_ms",
     "engine_allreduce_gbps", "engine_reduce_single_process_gbps",
     "headline_cfg_nthread", "headline_spread_mbps", "headline_sweep",
 )

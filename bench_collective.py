@@ -3,15 +3,20 @@
 bandwidth utilization; reference analog: the tier-2 throughput harnesses,
 test/libsvm_parser_test.cc:23-35, rebuilt for the collective layer).
 
-Three measurements, all hermetic on one host:
+Four measurements, all hermetic on one host:
 
 - socket tree allreduce GB/s (loopback multi-process, latency-bound size)
 - socket ring allreduce GB/s (loopback multi-process, bandwidth-bound size)
 - device psum: jit-compiled allreduce step time and achieved bytes/s over
   the mesh axis on whatever devices exist (1 real TPU chip today; a virtual
-  CPU mesh covers the sharding shapes). When >1 real TPU device is present,
-  estimated ICI utilization = achieved algorithm bandwidth / peak
-  (``DMLC_TPU_ICI_PEAK_GBPS`` per-direction per-link, default 45 for v5e).
+  CPU mesh covers the sharding shapes) — payload re-staged from host numpy
+  each step, i.e. the legacy DeviceEngine round-trip shape. When >1 real
+  TPU device is present, estimated ICI utilization = achieved algorithm
+  bandwidth / peak (``DMLC_TPU_ICI_PEAK_GBPS`` per-direction per-link,
+  default 45 for v5e).
+- SPMD in-graph step (``spmd_psum_step_gbps``, ``ici_utilization``): the
+  training hot path — donated device-resident params, sharded grads, the
+  allreduce a psum traced INSIDE the jitted step; zero host bytes moved.
 
 ``collective_metrics()`` returns a flat dict merged into bench.py's JSON
 line; ``python bench_collective.py`` prints it standalone.
@@ -24,6 +29,7 @@ import multiprocessing as mp
 import os
 import sys
 import time
+from contextlib import contextmanager
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -39,6 +45,25 @@ DEFAULT_SOCKET_WORLD = int(os.environ.get("DMLC_TPU_BENCH_SOCKET_WORLD", 4))
 DEFAULT_SOCKET_ITERS = 10
 
 
+@contextmanager
+def forced_topology(engine, topo: str):
+    """Force one allreduce topology on ``engine`` for the block: "ring"
+    (threshold 0) or "tree" (threshold 2**62). Restores the CONSTRUCTED
+    ``ring_threshold_bytes`` on exit — including any
+    DMLC_TPU_RING_THRESHOLD_BYTES override the engine applied at build
+    time, and on the exception path — so collectives after the block
+    (the straggler-max allreduce below) honor the engine's real
+    crossover. Previously a comment-only contract inline in the bench
+    worker; as a context manager the restore is unit-testable
+    (tests/test_bench_collective.py)."""
+    constructed = engine.ring_threshold_bytes
+    engine.ring_threshold_bytes = 0 if topo == "ring" else (1 << 62)
+    try:
+        yield engine
+    finally:
+        engine.ring_threshold_bytes = constructed
+
+
 def _socket_bench_worker(uri, port, world, cases, iters, q):
     """Subprocess body: rendezvous, then timed allreduce loops per case.
     Per-case time is the max across ranks (allreduce 'max' of the local
@@ -51,21 +76,16 @@ def _socket_bench_worker(uri, port, world, cases, iters, q):
     engine = SocketEngine(
         tracker_uri=uri, tracker_port=port, world_size=world
     )
-    # the engine may have applied a DMLC_TPU_RING_THRESHOLD_BYTES override
-    # at construction; restore THAT after each forced-topology case, not
-    # the class default, so the straggler-max allreduce below honors it
-    constructed_threshold = engine.ring_threshold_bytes
     try:
         out = {}
         for name, nbytes, topo in cases:
             arr = np.ones(max(1, nbytes // 4), dtype=np.float32)
-            engine.ring_threshold_bytes = 0 if topo == "ring" else (1 << 62)
-            engine.allreduce(arr)  # warmup (first ring call opens buffers)
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                engine.allreduce(arr)
-            local_dt = (time.perf_counter() - t0) / iters
-            engine.ring_threshold_bytes = constructed_threshold
+            with forced_topology(engine, topo):
+                engine.allreduce(arr)  # warmup (first ring call opens buffers)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    engine.allreduce(arr)
+                local_dt = (time.perf_counter() - t0) / iters
             worst = float(
                 engine.allreduce(
                     np.array([local_dt], dtype=np.float64), op="max"
@@ -230,6 +250,93 @@ def device_psum_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
     return metrics
 
 
+def spmd_psum_step_metrics(payload_mb: float = 32.0, iters: int = 20) -> dict:
+    """The tentpole hot path in isolation: a jitted SPMD SGD-shaped step
+    whose gradient allreduce is an in-graph psum over the mesh axis.
+    Contrast ``device_psum_metrics``, which re-stages its payload from
+    host numpy every step (the legacy DeviceEngine round-trip): here the
+    params are DONATED and carried device-to-device across iterations and
+    the sharded grads stay resident, exactly like LinearLearner's fit
+    loop — the measured figure is the in-graph collective + update with
+    zero host bytes on the path.
+
+    Reports ``spmd_psum_step_gbps`` (achieved algorithm bytes/s through
+    the psum: ring volume 2(n-1)/n × payload per device) and, on real
+    multi-device TPU, ``ici_utilization`` (achieved / peak,
+    ``DMLC_TPU_ICI_PEAK_GBPS`` per-direction per-link, default 45 for
+    v5e). Both are gated higher-is-better by bench-gate
+    (obs/sentry.py)."""
+    import jax
+
+    _maybe_force_cpu_devices()
+
+    import numpy as np
+
+    from dmlc_tpu.obs.device_telemetry import instrumented_jit
+    from dmlc_tpu.parallel.mesh import (
+        batch_sharding, data_parallel_mesh, replicated_sharding,
+    )
+    from dmlc_tpu.utils.jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = data_parallel_mesh(devices)
+    elems = int(payload_mb * (1 << 20) // 4)
+
+    def _sharded(w, g):
+        # the train-step shape: in-graph allreduce then SGD apply; the
+        # reduced grads never exist on the host
+        red = jax.lax.psum(g, "dp")
+        return w - 0.01 * red[0]
+
+    step = instrumented_jit(
+        shard_map(
+            _sharded, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P()
+        ),
+        "bench.spmd_step",
+        donate_argnums=(0,),
+    )
+    w = jax.device_put(
+        np.zeros(elems, dtype=np.float32), replicated_sharding(mesh)
+    )
+    g = jax.device_put(
+        np.ones((n, elems), dtype=np.float32), batch_sharding(mesh)
+    )
+    w = step(w, g)
+    float(w[0])  # compile + warmup + readback fence
+    # amortized pipelined timing (see device_engine_allreduce_metrics):
+    # back-to-back dispatch, ended on a 1-element D2H read that cannot
+    # complete early
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            w = step(w, g)
+        float(w[0])
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+
+    nbytes = elems * 4
+    metrics = {
+        "spmd_devices": n,
+        "spmd_platform": devices[0].platform,
+        "spmd_payload_mb": round(nbytes / (1 << 20), 1),
+        "spmd_step_ms": round(best * 1e3, 3),
+    }
+    if n > 1:
+        algo_bytes = 2 * (n - 1) / n * nbytes
+        metrics["spmd_psum_step_gbps"] = round(algo_bytes / best / 1e9, 3)
+        if devices[0].platform == "tpu":
+            peak = float(os.environ.get("DMLC_TPU_ICI_PEAK_GBPS", 45.0)) * 1e9
+            metrics["ici_utilization"] = round((algo_bytes / best) / peak, 3)
+    else:
+        # size-1 axis: the psum is a pass-through — step dispatch + apply
+        # rate only, still useful as the key's single-device floor
+        metrics["spmd_psum_step_gbps"] = round(nbytes / best / 1e9, 3)
+    return metrics
+
+
 def grad_bucket_metrics(iters: int = 8) -> dict:  # min-of-8 from the tier's
     # first artifact on (r04): each iter moves a ~25 MB pytree, so 8 bounds
     # the tier's tunnel time; the within-run fused-vs-per-tensor A/B is the
@@ -365,6 +472,10 @@ def collective_metrics(device_ok: bool = True) -> dict:
         out.update(device_psum_metrics())
     except Exception as err:
         out["psum_error"] = str(err)
+    try:
+        out.update(spmd_psum_step_metrics())
+    except Exception as err:
+        out["spmd_step_error"] = str(err)
     try:
         out.update(grad_bucket_metrics())
     except Exception as err:

@@ -12,8 +12,17 @@ tracker_print``. Engines:
   bootstrapped by jax.distributed (the --cluster=tpu path)
 - "local": world-size-1 no-op engine
 
-``init()`` picks automatically: DMLC_TRACKER_URI set → socket; multi-process
-JAX runtime → device; else local.
+``init()`` picks automatically: the ``DMLC_TPU_COLLECTIVE`` knob when set
+(auto/device/socket/local — an explicit ``engine=`` argument still wins);
+else DMLC_TRACKER_URI set → socket; multi-process JAX runtime → device;
+else local.
+
+The host-array ``allreduce``/``broadcast`` façade is the COMPATIBILITY
+surface — training hot loops should keep gradients on device and reduce
+in-graph instead (``bucketed_psum`` inside a jitted/shard_map step; see
+models/linear.py and docs/distributed.md "Device collectives").
+``on_membership_change`` lets holders of mesh-placed state reshard when
+recovery or elastic re-entry rebuilds the world.
 
 Elastic membership (socket engine only; docs/robustness.md "Elastic
 membership"): with ``DMLC_TPU_ELASTIC`` set, a collective failure
@@ -30,7 +39,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 
@@ -38,7 +47,9 @@ from dmlc_tpu.collective import device as device_collectives
 from dmlc_tpu.collective.device import (
     DeviceEngine,
     all_gather,
+    bucketed_psum,
     make_allreduce_step,
+    pbitor,
     pmax,
     pmean,
     pmin,
@@ -50,13 +61,40 @@ from dmlc_tpu.collective.socket_engine import SocketEngine
 from dmlc_tpu.io.serializer import load_obj, save_obj
 from dmlc_tpu.io.stream import MemoryStream
 from dmlc_tpu.io.filesystem import create_stream
-from dmlc_tpu.params.knobs import elastic_enabled, is_spare
+from dmlc_tpu.params.knobs import collective_engine, elastic_enabled, is_spare
 from dmlc_tpu.utils.logging import DMLCError, check, log_info
 
 _engine = None
 _engine_lock = threading.Lock()
 _version = 0
 _checkpoint_blob: Optional[bytes] = None
+_membership_listeners: List = []
+
+
+def on_membership_change(fn) -> "Callable[[], None]":
+    """Register ``fn()`` to run after every membership rebuild — elastic
+    re-entry (``reenter_elastic``) and fixed-world recovery
+    (``reinit_recover``, both engine halves). This is the SPMD resharding
+    hook: a learner holding mesh-placed params registers a callback that
+    re-places them (``shard_params``) on a mesh rebuilt over the new
+    device set and drops its traced step. Returns an unregister callable.
+    Listeners run OUTSIDE the engine lock, after the new engine is live,
+    in registration order; a listener exception propagates to the
+    recovery caller (a half-resharded learner must not train on)."""
+    _membership_listeners.append(fn)
+
+    def _unregister():
+        try:
+            _membership_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    return _unregister
+
+
+def _notify_membership() -> None:
+    for fn in list(_membership_listeners):
+        fn()
 
 
 class _LocalEngine:
@@ -122,6 +160,11 @@ def init(engine: str = "auto", **kwargs) -> None:
         if _engine is not None:
             return
         if engine == "auto":
+            # the DMLC_TPU_COLLECTIVE knob beats auto-detection but never
+            # an explicit engine= argument (call sites that hard-pin an
+            # engine know something the deployment env does not)
+            engine = collective_engine()
+        if engine == "auto":
             if os.environ.get("DMLC_TRACKER_URI"):
                 engine = "socket"
             else:
@@ -144,6 +187,19 @@ def _get():
     if _engine is None:
         init()
     return _engine
+
+
+def engine_kind() -> str:
+    """The active engine's kind — "socket", "device", or "local" —
+    initializing through the auto path on first use. Callers branch on
+    this to pick a sync flavor (e.g. LinearLearner: host-allreduce loop
+    across socket processes vs the in-graph SPMD step on a mesh)."""
+    eng = _get()
+    if isinstance(eng, SocketEngine):
+        return "socket"
+    if isinstance(eng, DeviceEngine):
+        return "device"
+    return "local"
 
 
 def finalize() -> None:
@@ -301,23 +357,26 @@ def reinit_recover() -> None:
     with _engine_lock:
         if isinstance(_engine, DeviceEngine):
             _reinit_device_engine()
-            return
-        check(
-            isinstance(_engine, SocketEngine),
-            "reinit_recover requires an active socket or device engine",
-        )
-        old = _engine
-        old.abort()
-        _checkpoint_blob = None
-        _engine = SocketEngine(
-            tracker_uri=old.tracker_uri,
-            tracker_port=old.tracker_port,
-            rank=old.rank,
-            world_size=old.world_size,
-            jobid=old.jobid,
-            cmd="recover",
-            connect_retry=old.connect_retry,
-        )
+        else:
+            check(
+                isinstance(_engine, SocketEngine),
+                "reinit_recover requires an active socket or device engine",
+            )
+            old = _engine
+            old.abort()
+            _checkpoint_blob = None
+            _engine = SocketEngine(
+                tracker_uri=old.tracker_uri,
+                tracker_port=old.tracker_port,
+                rank=old.rank,
+                world_size=old.world_size,
+                jobid=old.jobid,
+                cmd="recover",
+                connect_retry=old.connect_retry,
+            )
+    # the world was rebuilt (same ranks, but a restarted peer means fresh
+    # device runtime state on the device path): let SPMD holders re-place
+    _notify_membership()
 
 
 def _reinit_device_engine() -> None:
@@ -454,6 +513,9 @@ def reenter_elastic() -> int:
             connect_retry=old.connect_retry,
         )
         eng = _engine
+    # new generation, possibly new world size: SPMD param holders rebuild
+    # their mesh sharding before anyone runs another step
+    _notify_membership()
     flight.record_event("collective.elastic", generation=eng.generation,
                         rank=eng.rank, world=eng.world_size)
     log_info("elastic re-entry: generation %d, rank %d of %d",
@@ -589,6 +651,7 @@ def run_with_recovery(round_fn, max_attempts: int = 3,
 __all__ = [
     "init",
     "finalize",
+    "engine_kind",
     "rank",
     "world_size",
     "allreduce",
@@ -600,6 +663,7 @@ __all__ = [
     "load_checkpoint",
     "version_number",
     "reinit_recover",
+    "on_membership_change",
     "run_with_recovery",
     "broadcast_state",
     "reenter_elastic",
@@ -608,8 +672,10 @@ __all__ = [
     "pmean",
     "pmax",
     "pmin",
+    "pbitor",
     "all_gather",
     "ppermute_next",
+    "bucketed_psum",
     "make_allreduce_step",
     "CheckpointManager",
     "DeviceEngine",

@@ -18,7 +18,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_tpu import obs
-from dmlc_tpu.obs.device_telemetry import instrumented_jit
+from dmlc_tpu.obs.device_telemetry import h2d_meter, instrumented_jit
 from dmlc_tpu.utils.jax_compat import axis_size, shard_map
 
 from dmlc_tpu.utils.logging import DMLCError
@@ -74,6 +74,52 @@ def ppermute_next(x, axis: str = "dp"):
     return jax.lax.ppermute(x, axis_name=axis, perm=perm)
 
 
+def pbitor(x, axis: str = "dp"):
+    """Cross-replica bitwise OR (rabit op::BitOR, in-graph). XLA has no
+    OR all-reduce primitive, so shards are gathered and folded over the
+    gathered dim — order-insensitive, so the result is bit-identical to
+    the socket tree's fold regardless of topology."""
+    return _bitor_reduce(jax.lax.all_gather(x, axis_name=axis), axis=0)
+
+
+def bucketed_psum(tree, axis="dp", bucket: bool = True):
+    """In-graph fused gradient allreduce: psum a pytree over ``axis`` with
+    ONE collective per dtype. Call inside a jit/shard_map-traced step —
+    this is the hot-path reduction the SPMD train steps use, so gradients
+    never round-trip through host numpy or ``collective.allreduce``.
+
+    ``bucket=True`` flattens the leaves and concatenates them into
+    contiguous per-dtype buffers (dtype-preserving — bf16 grads are never
+    silently upcast by a mixed concat), reduces each bucket with a single
+    ``lax.psum``, and splits back to the original shapes. Large fused
+    buckets are what push ICI utilization toward peak (SURVEY §7 hard
+    parts). ``bucket=False`` issues one psum per leaf and leans on XLA's
+    all-reduce combiner — kept for A/B measurement
+    (bench_collective.grad_bucket_metrics).
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not bucket or len(leaves) <= 1:
+        out = [jax.lax.psum(g, axis) for g in leaves]
+        return jax.tree.unflatten(treedef, out)
+    by_dtype: dict = {}
+    for i, g in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(g).dtype, []).append(i)
+    out = [None] * len(leaves)
+    for idxs in by_dtype.values():
+        flat = jnp.concatenate(
+            [jnp.reshape(leaves[i], (-1,)) for i in idxs]
+        )
+        reduced = jax.lax.psum(flat, axis)
+        offset = 0
+        for i in idxs:
+            size = leaves[i].size
+            out[i] = jnp.reshape(
+                reduced[offset:offset + size], jnp.shape(leaves[i])
+            )
+            offset += size
+    return jax.tree.unflatten(treedef, out)
+
+
 # ---- host-level collectives over the global device mesh -------------------
 
 
@@ -97,6 +143,22 @@ class DeviceEngine:
         self._aborted = False
         self._proc_mesh: Optional[Mesh] = None
         self._reduce_fns: dict = {}
+        # host-round-trip copy accounting (PR 8 H2D counters): every byte
+        # this legacy path stages H2D and copies back D2H is a byte the
+        # in-graph SPMD psum path does NOT move — obs-report reads these
+        # to attribute exactly what retiring the host path eliminates.
+        # None when device telemetry is off (no timing, no byte walk).
+        self._h2d = h2d_meter(feed="collective")
+        self._m_d2h = (
+            obs.registry().counter(
+                "dmlc_collective_d2h_bytes_total",
+                "device->host result bytes copied back by host-path "
+                "collectives (the copy the in-graph SPMD path eliminates)",
+                op="allreduce",
+            )
+            if self._h2d is not None
+            else None
+        )
 
     def _process_mesh(self) -> Mesh:
         """(nproc, local) mesh with processes contiguous on the first axis
@@ -200,15 +262,24 @@ class DeviceEngine:
             from jax.sharding import NamedSharding
 
             sharding = NamedSharding(self._process_mesh(), P("proc"))
+            t_h2d = time.monotonic_ns()
             garr = jax.make_array_from_process_local_data(
                 sharding, arr[None], (self.world_size,) + arr.shape
             )
+            if self._h2d is not None:
+                # the host round-trip's up-leg: this process's shard staged
+                # onto device before the reduction can run
+                self._h2d.note(int(arr.nbytes), time.monotonic_ns() - t_h2d)
             with obs.span("allreduce", op=op, nbytes=int(arr.nbytes)):
                 # mark the in-flight chunk (set by DeviceFeed around the
                 # consume yield) so the op slice joins its arrow chain
                 obs.flow_step(obs.current_flow(), "chunk")
                 out = self._reduce_fn(op)(garr)
             res = np.asarray(out)
+            if self._m_d2h is not None:
+                # ...and the down-leg: the replicated result copied back to
+                # host numpy
+                self._m_d2h.inc(int(res.nbytes))
             self._record("allreduce", int(arr.nbytes), t0)
             return res
         except Exception as err:  # noqa: BLE001 — backend error translation
@@ -342,30 +413,12 @@ def make_allreduce_step(mesh: Mesh, axis: str = "dp", bucket: bool = True):
     ``bucket=False`` issues one psum per leaf and leans on XLA's
     all-reduce combiner heuristics — kept for A/B measurement
     (bench_collective.grad_bucket_metrics) and for models whose step
-    already fuses everything into one psum call."""
-    
+    already fuses everything into one psum call. The reduction body is
+    :func:`bucketed_psum` — the same in-graph primitive the SPMD train
+    steps (models/linear.py, models/fm.py) trace directly."""
+
     def _sum(grads):
-        leaves, treedef = jax.tree.flatten(grads)
-        if not bucket or len(leaves) <= 1:
-            out = [jax.lax.psum(g, axis) for g in leaves]
-            return jax.tree.unflatten(treedef, out)
-        by_dtype: dict = {}
-        for i, g in enumerate(leaves):
-            by_dtype.setdefault(jnp.asarray(g).dtype, []).append(i)
-        out = [None] * len(leaves)
-        for idxs in by_dtype.values():
-            flat = jnp.concatenate(
-                [jnp.reshape(leaves[i], (-1,)) for i in idxs]
-            )
-            reduced = jax.lax.psum(flat, axis)
-            offset = 0
-            for i in idxs:
-                size = leaves[i].size
-                out[i] = jnp.reshape(
-                    reduced[offset:offset + size], jnp.shape(leaves[i])
-                )
-                offset += size
-        return jax.tree.unflatten(treedef, out)
+        return bucketed_psum(grads, axis=axis, bucket=bucket)
 
     spec = P(axis)
     return instrumented_jit(

@@ -15,13 +15,17 @@ so this package ships that learner family TPU-natively:
 """
 
 from dmlc_tpu.models.linear import (
+    LINEAR_PARTITION_RULES,
+    LINEAR_MP_PARTITION_RULES,
     LinearModelParam,
     LinearLearner,
     init_linear_params,
+    make_hostsync_train_step,
     make_linear_train_step,
     linear_predict_dense,
 )
 from dmlc_tpu.models.fm import (
+    FM_PARTITION_RULES,
     FMParam,
     FMLearner,
     init_fm_params,
@@ -38,11 +42,15 @@ from dmlc_tpu.models.gbdt import (
 )
 
 __all__ = [
+    "LINEAR_PARTITION_RULES",
+    "LINEAR_MP_PARTITION_RULES",
     "LinearModelParam",
     "LinearLearner",
     "init_linear_params",
+    "make_hostsync_train_step",
     "make_linear_train_step",
     "linear_predict_dense",
+    "FM_PARTITION_RULES",
     "FMParam",
     "FMLearner",
     "init_fm_params",

@@ -21,9 +21,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_tpu.utils.jax_compat import shard_map
 
+from dmlc_tpu.collective.device import bucketed_psum
 from dmlc_tpu.models.linear import _margin_grad, step_batch
 from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
+from dmlc_tpu.parallel.partition import match_partition_rules, shard_params
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import check
 
@@ -47,6 +49,12 @@ def init_fm_params(
         "v": init_scale
         * jax.random.normal(key, (num_features, num_factors), dtype=jnp.float32),
     }
+
+
+#: Data-parallel placement for {"w": [F], "b": scalar, "v": [F, K]}:
+#: everything replicated, the batch shards, grads psum in-graph. Linted
+#: by scripts/check_partition_rules.py like LINEAR_PARTITION_RULES.
+FM_PARTITION_RULES = ((r"^(w|b|v)$", P()),)
 
 
 def _fm_forward_grads(params, batch, objective: str, num_features: int):
@@ -85,8 +93,11 @@ def make_fm_train_step(
     learning_rate: float = 0.05,
     l2: float = 0.0,
     axis: str = "dp",
+    param_specs=None,
 ):
-    """Jitted FM SGD step over COO batches; one fused psum on the mesh."""
+    """Jitted FM SGD step over COO batches; ONE fused (dtype-bucketed)
+    in-graph psum on the mesh — the [F,K] factor grads, [F] linear grads
+    and loss scalars cross ICI as a single contiguous f32 buffer."""
     check(num_features > 0, "num_features required")
 
     def _apply(params, gw, gb, gv, wsum):
@@ -118,18 +129,28 @@ def make_fm_train_step(
         "offsets": P(axis),
     }
 
+    if param_specs is None:
+        param_specs = match_partition_rules(
+            FM_PARTITION_RULES,
+            jax.eval_shape(lambda: init_fm_params(max(num_features, 1), 2)),
+        )
+
     def _sharded(params, batch):
         gw, gb, gv, loss_sum, wsum = _fm_forward_grads(
             params, batch, objective, num_features
         )
-        gw, gb, gv, loss_sum, wsum = jax.lax.psum(
-            (gw, gb, gv, loss_sum, wsum), axis_name=axis
+        # gradients never round-trip through host numpy: one bucketed
+        # in-graph psum carries the whole gradient pytree across ICI
+        gw, gb, gv, loss_sum, wsum = bucketed_psum(
+            (gw, gb, gv, loss_sum, wsum), axis=axis
         )
         params = _apply(params, gw, gb, gv, wsum)
         return params, {"loss_sum": loss_sum, "weight_sum": wsum}
 
     step = shard_map(
-        _sharded, mesh=mesh, in_specs=(P(), batch_specs), out_specs=(P(), P())
+        _sharded, mesh=mesh,
+        in_specs=(param_specs, batch_specs),
+        out_specs=(param_specs, P()),
     )
     return instrumented_jit(step, "fm.step", donate_argnums=(0,))
 
@@ -143,21 +164,59 @@ class FMLearner:
         self.mesh = mesh
         self.params = None
         self._step = None
+        self._nf = None
+        self._unlisten = None
+        if mesh is not None:
+            import weakref
+
+            from dmlc_tpu import collective
+
+            ref = weakref.ref(self)
+
+            def _membership_cb():
+                learner = ref()
+                if learner is not None and learner.params is not None:
+                    learner.reshard()
+
+            self._unlisten = collective.on_membership_change(_membership_cb)
 
     def _ensure(self, num_features: int):
-        if self.params is not None:
+        if self.params is None:
+            nf = self.param.num_features or num_features
+            self.params = init_fm_params(
+                nf, self.param.num_factors, self.param.init_scale
+            )
+            self._nf = nf
+            if self.mesh is not None:
+                self.params = shard_params(
+                    self.params, self.mesh, rules=FM_PARTITION_RULES
+                )
+        if self._step is None:
+            self._step = make_fm_train_step(
+                self.mesh,
+                self._nf or self.param.num_features or num_features,
+                objective=self.param.objective,
+                learning_rate=self.param.learning_rate,
+                l2=self.param.l2,
+            )
+
+    def reshard(self, mesh: Optional[Mesh] = None) -> None:
+        """Elastic re-entry hook (see LinearLearner.reshard): re-place the
+        factor table + linear weights on a mesh rebuilt over the current
+        device set and drop the traced step."""
+        if self.mesh is None or self.params is None:
             return
-        nf = self.param.num_features or num_features
-        self.params = init_fm_params(
-            nf, self.param.num_factors, self.param.init_scale
+        if mesh is None:
+            check(
+                len(self.mesh.axis_names) == 1,
+                "pass mesh= to reshard a multi-axis mesh",
+            )
+            mesh = Mesh(np.asarray(jax.devices()), self.mesh.axis_names)
+        self.mesh = mesh
+        self.params = shard_params(
+            jax.device_get(self.params), mesh, rules=FM_PARTITION_RULES
         )
-        self._step = make_fm_train_step(
-            self.mesh,
-            nf,
-            objective=self.param.objective,
-            learning_rate=self.param.learning_rate,
-            l2=self.param.l2,
-        )
+        self._step = None
 
     def fit_feed(self, feed, epochs: int = 1, log_every: int = 0):
         """Train over a csr DeviceFeed; ``log_every`` (epochs) also logs

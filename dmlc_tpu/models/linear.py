@@ -27,9 +27,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu.utils.jax_compat import shard_map
 
+from dmlc_tpu.collective.device import bucketed_psum
 from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.ops.objectives import margin_loss_grad
 from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
+from dmlc_tpu.parallel.partition import match_partition_rules, shard_params
 from dmlc_tpu.params.parameter import Parameter, field
 from dmlc_tpu.utils.logging import DMLCError, check
 
@@ -65,6 +67,19 @@ def init_linear_params(num_features: int, dtype=jnp.float32) -> Dict:
         "w": jnp.zeros((num_features,), dtype=dtype),
         "b": jnp.zeros((), dtype=dtype),
     }
+
+
+#: Data-parallel placement for {"w": [F], "b": scalar}: everything
+#: replicated — only the BATCH shards over the mesh, and the in-graph
+#: psum lands identical grads on every device. Declared as a regex
+#: partition-rule table (parallel/partition.py) so the placement is
+#: data, linted by scripts/check_partition_rules.py, instead of being
+#: hard-coded into the step builder.
+LINEAR_PARTITION_RULES = ((r"^(w|b)$", P()),)
+
+#: Feature-sharded (dp×mp) placement: the weight vector splits over the
+#: model axis (make_feature_sharded_train_step's layout).
+LINEAR_MP_PARTITION_RULES = ((r"^w$", P("mp")), (r"^b$", P()))
 
 
 def linear_predict_dense(params: Dict, x):
@@ -121,49 +136,10 @@ def _suppress_donation_warnings(step):
     return wrapped
 
 
-def make_linear_train_step(
-    mesh: Optional[Mesh],
-    objective: str = "logistic",
-    learning_rate: float = 0.1,
-    l2: float = 0.0,
-    momentum: float = 0.0,
-    layout: str = "dense",
-    num_features: int = 0,
-    axis: str = "dp",
-    use_pallas: Optional[bool] = None,
-    donate_batch: bool = False,
-):
-    """Build the jitted allreduce-SGD step.
-
-    Returns step(params, velocity, batch) -> (params, velocity, metrics)
-    where metrics = {"loss_sum": Σ w·loss, "weight_sum": Σ w} (host divides).
-    With ``mesh`` the batch is consumed sharded over ``axis`` and gradients
-    cross ICI in one fused psum; without, it is a single-device step.
-
-    ``axis`` may be a tuple of mesh axis names for hybrid data
-    parallelism — e.g. ``("dcn", "dp")`` on a
-    :func:`~dmlc_tpu.parallel.make_multislice_mesh` shards batch rows over
-    slices × chips and the psum lowers to a per-slice ICI reduction plus
-    one small cross-slice DCN exchange (outer axis = slices).
-
-    ``use_pallas`` (default: env DMLC_TPU_PALLAS=1) routes the dense
-    gradient core through the fused Pallas kernel
-    (ops/pallas_kernels.fused_linear_grads). Measured at parity with XLA's
-    own fusion on v5e (BASELINE.md) — XLA stays the default.
-
-    ``donate_batch=True`` donates ALL step inputs — params, velocity, and
-    the batch arrays: the H2D landing buffers are released to XLA the
-    moment the step consumes them (HBM headroom for the next in-flight
-    transfer — SURVEY §7 hard parts: donation) and the parameter update
-    is in-place. Only for streaming callers that rebind params/velocity
-    each step and never touch a batch after its step (DeviceFeed loops,
-    the bench tiers, LinearLearner); reusing a donated input afterward is
-    an error by design. Default False keeps every input alive (the mesh
-    path has always donated params/velocity — that is unchanged).
-    """
-    check(layout in ("dense", "csr"), "layout must be dense or csr")
-    if layout == "csr":
-        check(num_features > 0, "csr layout requires num_features")
+def _resolve_pallas(use_pallas: Optional[bool], layout: str,
+                    objective: str) -> bool:
+    """Validate + default the Pallas fused-kernel switch (env
+    DMLC_TPU_PALLAS=1); shared by the mesh and hostsync step builders."""
     if use_pallas is None:
         import os
 
@@ -177,6 +153,16 @@ def make_linear_train_step(
             pallas_kernels.available and objective in OBJECTIVES,
             "pallas path unavailable for this configuration",
         )
+    return use_pallas
+
+
+def _build_local_grads(objective: str, layout: str, num_features: int,
+                       use_pallas: bool):
+    """The per-shard gradient core: f(params, batch) -> (gw, gb, loss_sum,
+    weight_sum), no cross-device communication. ONE definition feeds every
+    sync flavor — the in-graph SPMD step, the single-device step, and the
+    legacy host-allreduce twin — so their local math is identical by
+    construction (the parity suites lean on this)."""
     # Mosaic only targets TPU; elsewhere (CPU meshes in tests, the
     # dryrun_multichip virtual devices) the kernel runs interpreted.
     pallas_interpret = jax.default_backend() != "tpu"
@@ -229,6 +215,13 @@ def make_linear_train_step(
         weight_sum = jnp.sum(weight)
         return gw, gb, loss_sum, weight_sum
 
+    return _local_grads
+
+
+def _build_apply(learning_rate: float, l2: float, momentum: float):
+    """The SGD update: f(params, velocity, gw, gb, wsum) with the grads
+    already reduced. Shared across sync flavors like _build_local_grads."""
+
     def _apply(params, velocity, gw, gb, wsum):
         denom = jnp.maximum(wsum, 1e-12)
         gw = gw / denom + l2 * params["w"]
@@ -244,6 +237,58 @@ def make_linear_train_step(
             "b": params["b"] - learning_rate * gb,
         }
         return params, velocity
+
+    return _apply
+
+
+def make_linear_train_step(
+    mesh: Optional[Mesh],
+    objective: str = "logistic",
+    learning_rate: float = 0.1,
+    l2: float = 0.0,
+    momentum: float = 0.0,
+    layout: str = "dense",
+    num_features: int = 0,
+    axis: str = "dp",
+    use_pallas: Optional[bool] = None,
+    donate_batch: bool = False,
+    param_specs=None,
+):
+    """Build the jitted allreduce-SGD step.
+
+    Returns step(params, velocity, batch) -> (params, velocity, metrics)
+    where metrics = {"loss_sum": Σ w·loss, "weight_sum": Σ w} (host divides).
+    With ``mesh`` the batch is consumed sharded over ``axis`` and gradients
+    cross ICI in one fused psum; without, it is a single-device step.
+
+    ``axis`` may be a tuple of mesh axis names for hybrid data
+    parallelism — e.g. ``("dcn", "dp")`` on a
+    :func:`~dmlc_tpu.parallel.make_multislice_mesh` shards batch rows over
+    slices × chips and the psum lowers to a per-slice ICI reduction plus
+    one small cross-slice DCN exchange (outer axis = slices).
+
+    ``use_pallas`` (default: env DMLC_TPU_PALLAS=1) routes the dense
+    gradient core through the fused Pallas kernel
+    (ops/pallas_kernels.fused_linear_grads). Measured at parity with XLA's
+    own fusion on v5e (BASELINE.md) — XLA stays the default.
+
+    ``donate_batch=True`` donates ALL step inputs — params, velocity, and
+    the batch arrays: the H2D landing buffers are released to XLA the
+    moment the step consumes them (HBM headroom for the next in-flight
+    transfer — SURVEY §7 hard parts: donation) and the parameter update
+    is in-place. Only for streaming callers that rebind params/velocity
+    each step and never touch a batch after its step (DeviceFeed loops,
+    the bench tiers, LinearLearner); reusing a donated input afterward is
+    an error by design. Default False keeps every input alive (the mesh
+    path has always donated params/velocity — that is unchanged).
+    """
+    check(layout in ("dense", "csr"), "layout must be dense or csr")
+    if layout == "csr":
+        check(num_features > 0, "csr layout requires num_features")
+    use_pallas = _resolve_pallas(use_pallas, layout, objective)
+    _local_grads = _build_local_grads(objective, layout, num_features,
+                                      use_pallas)
+    _apply = _build_apply(learning_rate, l2, momentum)
 
     if mesh is None:
 
@@ -280,11 +325,23 @@ def make_linear_train_step(
             "offsets": P(axis),
         }
 
+    # parameter placement as DATA: the rule table (or a caller-supplied
+    # spec tree) drives both sides of the shard_map signature, so the
+    # step's layout contract and shard_params' placement cannot drift
+    if param_specs is None:
+        template = jax.eval_shape(
+            lambda: init_linear_params(max(num_features, 1))
+        )
+        param_specs = match_partition_rules(LINEAR_PARTITION_RULES, template)
+
     def _sharded(params, velocity, batch):
         gw, gb, loss_sum, wsum = _local_grads(params, batch)
-        # ONE fused allreduce for everything that crosses ICI.
-        gw, gb, loss_sum, wsum = jax.lax.psum(
-            (gw, gb, loss_sum, wsum), axis_name=axis
+        # ONE fused allreduce for everything that crosses ICI: grads and
+        # the loss/weight scalars ride a single dtype-bucketed in-graph
+        # psum (collective.bucketed_psum) — gradients never round-trip
+        # through host numpy or collective.allreduce.
+        gw, gb, loss_sum, wsum = bucketed_psum(
+            (gw, gb, loss_sum, wsum), axis=axis
         )
         params, velocity = _apply(params, velocity, gw, gb, wsum)
         return params, velocity, {"loss_sum": loss_sum, "weight_sum": wsum}
@@ -292,14 +349,75 @@ def make_linear_train_step(
     step = shard_map(
         _sharded,
         mesh=mesh,
-        in_specs=(P(), P(), batch_specs),
-        out_specs=(P(), P(), P()),
+        in_specs=(param_specs, param_specs, batch_specs),
+        out_specs=(param_specs, param_specs, P()),
     )
     fn = instrumented_jit(
         step, "linear.step",
         donate_argnums=(0, 1, 2) if donate_batch else (0, 1),
     )
     return _suppress_donation_warnings(fn) if donate_batch else fn
+
+
+def make_hostsync_train_step(
+    objective: str = "logistic",
+    learning_rate: float = 0.1,
+    l2: float = 0.0,
+    momentum: float = 0.0,
+    layout: str = "dense",
+    num_features: int = 0,
+    use_pallas: Optional[bool] = None,
+):
+    """The legacy host-round-trip twin of the mesh SPMD step: local grads
+    on device, ONE fused ``collective.allreduce`` over the active host
+    engine (socket tree/ring on CPU clusters), apply on device.
+
+    This is the rabit loop (examples/distributed_sgd.py) behind the
+    step(params, velocity, batch) signature, and the ONLY sync flavor
+    that works across socket-engine processes (no single ``Mesh`` spans
+    them). It shares ``_build_local_grads``/``_build_apply`` with the
+    SPMD step, and its reduction — one contiguous same-dtype buffer
+    through the engine — mirrors ``bucketed_psum``'s bucket layout, so
+    at world 2 (one addition per element on either path) the two sync
+    flavors are bit-identical; the ci_checks.sh SPMD smoke pins that.
+    In-mesh training should use :func:`make_linear_train_step` — see
+    docs/distributed.md "Device collectives" for the migration note.
+    """
+    check(layout in ("dense", "csr"), "layout must be dense or csr")
+    if layout == "csr":
+        check(num_features > 0, "csr layout requires num_features")
+    use_pallas = _resolve_pallas(use_pallas, layout, objective)
+    local = instrumented_jit(
+        _build_local_grads(objective, layout, num_features, use_pallas),
+        "linear.hostsync_grads",
+    )
+    apply_fn = instrumented_jit(
+        _build_apply(learning_rate, l2, momentum), "linear.hostsync_apply"
+    )
+
+    def step(params, velocity, batch):
+        from dmlc_tpu import collective
+
+        gw, gb, loss_sum, wsum = local(params, batch)
+        gw_h = np.asarray(gw)
+        scalars = np.asarray(
+            [gb, loss_sum, wsum], dtype=gw_h.dtype
+        )
+        # one fused buffer = one allreduce per step, the same bucket
+        # layout bucketed_psum traces in-graph
+        reduced = collective.allreduce(
+            np.concatenate([gw_h.ravel(), scalars])
+        )
+        gw_r = jnp.asarray(reduced[: gw_h.size].reshape(gw_h.shape))
+        gb_r = jnp.asarray(reduced[gw_h.size])
+        wsum_r = jnp.asarray(reduced[gw_h.size + 2])
+        params, velocity = apply_fn(params, velocity, gw_r, gb_r, wsum_r)
+        return params, velocity, {
+            "loss_sum": reduced[gw_h.size + 1],
+            "weight_sum": reduced[gw_h.size + 2],
+        }
+
+    return step
 
 
 def make_feature_sharded_train_step(
@@ -327,6 +445,15 @@ def make_feature_sharded_train_step(
     """
     dp = batch_axis
     mp = feature_axis
+    # the canonical axis name resolves through the linted rule table; a
+    # custom feature_axis keeps the same shape with the name swapped in
+    if mp == "mp":
+        param_specs = match_partition_rules(
+            LINEAR_MP_PARTITION_RULES,
+            jax.eval_shape(lambda: init_linear_params(2)),
+        )
+    else:
+        param_specs = {"w": P(mp), "b": P()}
 
     def _step(params, batch_x, batch_y, batch_w):
         # local shapes: x [B/dp, F/mp], w [F/mp]
@@ -350,8 +477,8 @@ def make_feature_sharded_train_step(
         shard_map(
             _step,
             mesh=mesh,
-            in_specs=({"w": P(mp), "b": P()}, P(dp, mp), P(dp), P(dp)),
-            out_specs=({"w": P(mp), "b": P()}, P()),
+            in_specs=(param_specs, P(dp, mp), P(dp), P(dp)),
+            out_specs=(param_specs, P()),
         ),
         "linear.step_mp",
         donate_argnums=(0,),
@@ -395,35 +522,156 @@ class EpochMetrics:
 
 
 class LinearLearner:
-    """Convenience trainer: uri → fitted params (the rabit-SGD loop)."""
+    """Convenience trainer: uri → fitted params (the rabit-SGD loop).
 
-    def __init__(self, mesh: Optional[Mesh] = None, **hyper):
+    ``sync`` picks the gradient-reduction flavor:
+
+    - ``"spmd"`` (default): the in-graph path — params live mesh-placed
+      (``shard_params`` over ``LINEAR_PARTITION_RULES``), the batch
+      shards over the mesh, and the allreduce is a bucketed psum traced
+      INSIDE the jitted step. Gradients never touch host numpy.
+    - ``"host"``: the legacy rabit loop (``make_hostsync_train_step``) —
+      the cross-host fallback when the socket engine spans processes no
+      single Mesh can.
+
+    A mesh learner registers a ``collective.on_membership_change``
+    listener: elastic re-entry / recovery re-places its params on a mesh
+    rebuilt over the surviving devices (:meth:`reshard`).
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, sync: str = "spmd",
+                 **hyper):
+        check(sync in ("spmd", "host"), "sync must be spmd or host")
         self.param = LinearModelParam()
         self.param.init(hyper)
         self.mesh = mesh
+        self.sync = sync
         self.params = None
         self.velocity = None
         self._step = None
+        self._layout = None
+        self._nf = None
+        self._unlisten = None
+        if mesh is not None:
+            import weakref
+
+            from dmlc_tpu import collective
+
+            ref = weakref.ref(self)
+
+            def _membership_cb():
+                learner = ref()
+                if learner is not None and learner.params is not None:
+                    learner.reshard()
+
+            self._unlisten = collective.on_membership_change(_membership_cb)
 
     def _ensure(self, num_features: int, layout: str):
-        if self.params is not None:
+        if self.params is None:
+            nf = self.param.num_features or num_features
+            self.params = init_linear_params(nf)
+            self.velocity = {
+                "w": jnp.zeros_like(self.params["w"]),
+                "b": jnp.zeros_like(self.params["b"]),
+            }
+            self._layout = layout
+            self._nf = nf
+            if self.mesh is not None and self.sync == "spmd":
+                # params live mesh-placed from step zero: the traced step
+                # consumes committed arrays, no per-call resharding
+                self.params = shard_params(
+                    self.params, self.mesh, rules=LINEAR_PARTITION_RULES
+                )
+                self.velocity = shard_params(
+                    self.velocity, self.mesh, rules=LINEAR_PARTITION_RULES
+                )
+        if self._step is None:
+            if self._layout is None:
+                # params came from load(): derive what init skipped
+                self._layout = layout
+                self._nf = (self.param.num_features or num_features
+                            or int(self.params["w"].shape[0]))
+            if self.sync == "host":
+                self._step = make_hostsync_train_step(
+                    objective=self.param.objective,
+                    learning_rate=self.param.learning_rate,
+                    l2=self.param.l2,
+                    momentum=self.param.momentum,
+                    layout=self._layout,
+                    num_features=self._nf,
+                )
+            else:
+                self._step = make_linear_train_step(
+                    self.mesh,
+                    objective=self.param.objective,
+                    learning_rate=self.param.learning_rate,
+                    l2=self.param.l2,
+                    momentum=self.param.momentum,
+                    layout=self._layout,
+                    num_features=self._nf,
+                    donate_batch=True,  # fit_feed consumes batches once
+                )
+
+    def reshard(self, mesh: Optional[Mesh] = None) -> None:
+        """Re-place params/velocity on ``mesh`` (default: a fresh mesh
+        over the CURRENT device set, same axis names) and drop the traced
+        step — the elastic re-entry hook. Leaves round-trip through host
+        copies because the old placement may reference devices that no
+        longer exist."""
+        if self.mesh is None or self.params is None:
             return
-        nf = self.param.num_features or num_features
-        self.params = init_linear_params(nf)
-        self.velocity = {
-            "w": jnp.zeros_like(self.params["w"]),
-            "b": jnp.zeros_like(self.params["b"]),
-        }
-        self._step = make_linear_train_step(
-            self.mesh,
-            objective=self.param.objective,
-            learning_rate=self.param.learning_rate,
-            l2=self.param.l2,
-            momentum=self.param.momentum,
-            layout=layout,
-            num_features=nf,
-            donate_batch=True,  # fit_feed consumes each feed batch once
+        if mesh is None:
+            check(
+                len(self.mesh.axis_names) == 1,
+                "pass mesh= to reshard a multi-axis mesh",
+            )
+            mesh = Mesh(np.asarray(jax.devices()), self.mesh.axis_names)
+        self.mesh = mesh
+        self.params = shard_params(
+            jax.device_get(self.params), mesh, rules=LINEAR_PARTITION_RULES
         )
+        if self.velocity is not None:
+            self.velocity = shard_params(
+                jax.device_get(self.velocity), mesh,
+                rules=LINEAR_PARTITION_RULES,
+            )
+        self._step = None  # retrace against the new mesh on next batch
+
+    def fit_uri(
+        self,
+        uri: str,
+        batch_size: int = 4096,
+        epochs: int = 1,
+        layout: str = "dense",
+        num_features: int = 0,
+        part_index: Optional[int] = None,
+        num_parts: Optional[int] = None,
+        drop_remainder: bool = False,
+        log_every: int = 0,
+    ):
+        """One call from data URI to fitted params: InputSplit part →
+        parser → DeviceFeed → fit_feed. The part defaults to this
+        worker's collective rank/world (each worker reads its own byte
+        range — the reference's ``InputSplit::Create(uri, rank, world)``
+        contract), so the same line works single-process, on a mesh, or
+        under dmlc-submit with the socket engine."""
+        from dmlc_tpu import collective
+        from dmlc_tpu.data import create_parser
+        from dmlc_tpu.device import BatchSpec, DeviceFeed
+
+        nf = num_features or self.param.num_features
+        check(nf > 0, "fit_uri requires num_features")
+        if part_index is None:
+            part_index = collective.rank()
+        if num_parts is None:
+            num_parts = collective.world_size()
+        feed = DeviceFeed(
+            create_parser(uri, part_index, num_parts),
+            BatchSpec(batch_size=batch_size, layout=layout,
+                      num_features=nf, drop_remainder=drop_remainder),
+            mesh=self.mesh,
+        )
+        return self.fit_feed(feed, epochs=epochs, log_every=log_every)
 
     def fit_feed(self, feed, epochs: int = 1, log_every: int = 0):
         """Train over a DeviceFeed for N epochs; returns per-epoch losses."""

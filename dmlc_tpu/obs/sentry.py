@@ -60,8 +60,15 @@ DEFAULT_MIN_SAMPLES = 2
 _HIGHER_SUFFIXES = ("_mbps", "_gbps", "_mrows_s")
 # higher-is-better extras that carry no unit suffix: the cross-job
 # source-cache hit ratio from the multijob bench tier (1.0 = the second
-# tenant parsed nothing)
-_HIGHER_KEYS = ("cache_cross_job_hit_ratio",)
+# tenant parsed nothing), and the SPMD in-graph step's ICI utilization
+# (achieved/peak on the gradient psum — the ≥90% ROADMAP target).
+# spmd_psum_step_gbps is listed too for explicitness, though the _gbps
+# suffix rule already gates it.
+_HIGHER_KEYS = (
+    "cache_cross_job_hit_ratio",
+    "ici_utilization",
+    "spmd_psum_step_gbps",
+)
 _STALL_PREFIX = "stall."
 # lower-is-better key families: stall stages, XLA compile counts, and
 # peak HBM (device_telemetry section)

@@ -17,6 +17,15 @@ from dmlc_tpu.parallel.mesh import (
     mesh_rank_info,
     local_axis_shards,
 )
+from dmlc_tpu.parallel.partition import (
+    REPLICATED_RULES,
+    leaf_names,
+    lint_partition_rules,
+    match_partition_rules,
+    named_tree_map,
+    shard_params,
+    sharding_tree,
+)
 
 __all__ = [
     "make_mesh",
@@ -27,4 +36,11 @@ __all__ = [
     "replicated_sharding",
     "mesh_rank_info",
     "local_axis_shards",
+    "REPLICATED_RULES",
+    "leaf_names",
+    "lint_partition_rules",
+    "match_partition_rules",
+    "named_tree_map",
+    "shard_params",
+    "sharding_tree",
 ]

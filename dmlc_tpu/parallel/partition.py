@@ -1,0 +1,158 @@
+"""Regex-driven parameter partitioning: rules → PartitionSpec/NamedSharding.
+
+The reference has no notion of parameter placement — rabit callers keep
+the model on the host and allreduce it over sockets. The SPMD training
+path inverts that: parameters LIVE sharded (or replicated) on the device
+mesh and the placement is declared once, as data, instead of hard-coded
+per step builder. A partition-rule table is a sequence of
+
+    (regex, PartitionSpec)
+
+pairs; a parameter pytree is flattened to ``/``-joined leaf names
+(``"w"``, ``"layers/0/kernel"``), each non-scalar leaf takes the spec of
+the FIRST rule whose regex ``re.search``-matches its name, and scalars
+are always replicated (``P()``) without consulting the table. An
+unmatched leaf is a hard error: silent replication of a tensor the
+author meant to shard is exactly the placement bug this layer exists to
+remove, and ``scripts/check_partition_rules.py`` lints the in-tree rule
+tables for both misses and ambiguous (multi-rule) matches.
+
+Built on the shape of the fmengine/EasyLM ``match_partition_rules``
+utilities (SNIPPETS.md [2]/[3]), grafted onto this package's mesh
+helpers (``parallel/mesh.py``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.utils.logging import DMLCError
+
+__all__ = [
+    "REPLICATED_RULES",
+    "leaf_names",
+    "named_tree_map",
+    "match_partition_rules",
+    "lint_partition_rules",
+    "sharding_tree",
+    "shard_params",
+]
+
+#: Catch-all table: every leaf replicated. The right default for small
+#: data-parallel models (linear/FM dp steps) where only the BATCH is
+#: sharded and the psum output must land identically on every device.
+REPLICATED_RULES: Tuple[Tuple[str, P], ...] = ((r".*", P()),)
+
+
+def _key_str(key: Any) -> str:
+    """One path entry → its name segment (dict key, attr name, index)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
+
+
+def _path_name(path, sep: str = "/") -> str:
+    return sep.join(_key_str(k) for k in path)
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree, sep: str = "/"):
+    """``tree_map`` where ``fn`` receives ``(leaf_name, leaf)`` — leaf
+    names are the ``sep``-joined pytree path (dict keys / attr names /
+    sequence indices)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: fn(_path_name(path, sep), leaf), tree
+    )
+
+
+def leaf_names(tree, sep: str = "/") -> List[str]:
+    """The ``sep``-joined path name of every leaf, in flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [_path_name(path, sep) for path, _ in flat]
+
+
+def _is_scalar(leaf) -> bool:
+    shape = tuple(getattr(leaf, "shape", ()))
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], params,
+                          sep: str = "/"):
+    """PartitionSpec pytree for ``params`` from a ``(regex, spec)`` table.
+
+    Scalar leaves (rank 0 or one element) are replicated without
+    consulting the rules; every other leaf takes the first rule whose
+    regex matches its ``sep``-joined name, and a leaf no rule matches
+    raises ``DMLCError`` (run ``lint_partition_rules`` — or the
+    ``scripts/check_partition_rules.py`` gate — to find ambiguous
+    tables before they ship).
+    """
+
+    def get_spec(name: str, leaf):
+        if _is_scalar(leaf):
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise DMLCError(
+            f"no partition rule matches param {name!r} "
+            f"(rules: {[r for r, _ in rules]!r})"
+        )
+
+    return named_tree_map(get_spec, params, sep=sep)
+
+
+def lint_partition_rules(rules: Sequence[Tuple[str, P]], params,
+                         sep: str = "/") -> List[str]:
+    """Problems list for ``scripts/check_partition_rules.py``: every
+    non-scalar leaf must match EXACTLY one rule. Zero matches is the
+    silent-replication bug; two or more means the table's first-match
+    order is load-bearing, which a later edit will break silently.
+    Scalars are exempt (the runtime replicates them before the table is
+    consulted). Returns [] for a clean table."""
+    problems: List[str] = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        name = _path_name(path, sep)
+        if _is_scalar(leaf):
+            continue
+        hits = [rule for rule, _ in rules if re.search(rule, name)]
+        if not hits:
+            problems.append(f"{name}: matched by no rule")
+        elif len(hits) > 1:
+            problems.append(
+                f"{name}: matched by {len(hits)} rules {hits!r} "
+                "(first-match order is load-bearing)"
+            )
+    return problems
+
+
+def sharding_tree(mesh: Mesh, specs):
+    """PartitionSpec pytree → NamedSharding pytree over ``mesh``."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params, mesh: Mesh,
+                 rules: Sequence[Tuple[str, P]] = REPLICATED_RULES,
+                 specs=None, sep: str = "/"):
+    """Place every leaf of ``params`` on ``mesh`` with its rule-derived
+    ``NamedSharding`` (or a precomputed ``specs`` tree). The returned
+    tree is committed — jit/shard_map steps consume it without a fresh
+    placement per call, and re-calling with a NEW mesh is the elastic
+    re-entry path (``collective.on_membership_change``): leaves are
+    re-placed onto the rebuilt mesh whatever device set it now spans."""
+    if specs is None:
+        specs = match_partition_rules(rules, params, sep=sep)
+    shardings = sharding_tree(mesh, specs)
+    return jax.tree_util.tree_map(
+        lambda leaf, sh: jax.device_put(leaf, sh), params, shardings
+    )

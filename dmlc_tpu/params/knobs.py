@@ -125,6 +125,15 @@ Device telemetry (obs/device_telemetry.py, see docs/observability.md
   sampler thread (0 = no thread, the default; sampling still happens at
   payload-publish and bench boundaries)
 
+The collective engine layer (collective/__init__.py, see
+docs/distributed.md "Device collectives") adds:
+
+- ``DMLC_TPU_COLLECTIVE`` — engine selection for ``collective.init``:
+  ``auto`` (default), ``device`` (in-mesh XLA collectives — the SPMD
+  training path), ``socket`` (reference rabit tree/ring, the
+  CPU/cross-host fallback), ``local``. An explicit ``engine=`` argument
+  to ``init`` always beats the env.
+
 The vectorized text-parse path (data/vparse.py + cpp/parse_simd.cc, see
 docs/pipeline.md "Vectorized parse") adds three more:
 
@@ -413,6 +422,19 @@ def parse_procs() -> int:
     return max(0, get_env("DMLC_TPU_PARSE_PROCS", 0))
 
 
+def collective_engine() -> str:
+    """Collective engine selection (``DMLC_TPU_COLLECTIVE``): one of
+    ``auto`` (the default — device when a multi-process mesh is up,
+    socket when a tracker URI is set, else local), ``device`` (in-mesh
+    XLA collectives — the SPMD training path), ``socket`` (the
+    reference rabit tree/ring over TCP — CPU/cross-host fallback),
+    ``local`` (single-process no-op world). Unknown values read as
+    auto. Consulted by ``collective.init(engine="auto")`` only — an
+    explicit ``engine=`` argument always wins over the env."""
+    val = str(get_env("DMLC_TPU_COLLECTIVE", "auto")).strip().lower()
+    return val if val in ("auto", "device", "socket", "local") else "auto"
+
+
 def is_spare() -> bool:
     """Whether this process was launched as a warm spare
     (``DMLC_TPU_SPARE``, set by the launcher's ``--spares`` tasks).
@@ -470,6 +492,7 @@ KNOWN_KNOBS = (
     "DMLC_TPU_DEVICE_TELEMETRY",
     "DMLC_TPU_HBM_POLL_S",
     # collective / distributed bootstrap
+    "DMLC_TPU_COLLECTIVE",
     "DMLC_TPU_RECOVER_TIMEOUT",
     "DMLC_TPU_RING_THRESHOLD_BYTES",
     "DMLC_TPU_COORDINATOR",

@@ -7,6 +7,7 @@ cd "$(dirname "$0")/.."
 
 python scripts/check_metric_names.py
 python scripts/check_faultpoints.py
+python scripts/check_partition_rules.py
 python -m dmlc_tpu.tools bench-gate --smoke
 
 # obs-top --once smoke against a local StatusServer fixture: exercises
@@ -194,6 +195,112 @@ if native.available():
     print("ci_checks: parse-parity smoke OK (scalar == vector == native)")
 else:
     print("ci_checks: parse-parity smoke OK (scalar == vector; no native)")
+EOF
+
+# SPMD collective smoke: the same short LibSVM fit run two ways — a
+# single-process 2-virtual-device mesh with DMLC_TPU_COLLECTIVE=device
+# (gradient allreduce as the in-graph bucketed psum) and a 2-process
+# socket-engine world on the hostsync fallback (fused-buffer
+# collective.allreduce). Loss history and final params must be
+# BIT-identical, and the SPMD run must move zero collective D2H bytes.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+DMLC_TPU_COLLECTIVE=device python - <<'EOF'
+import json, os, shutil, subprocess, sys, tempfile
+
+import numpy as np
+
+NF, ROWS, EPOCHS = 8, 64, 3
+HYPER = dict(objective="logistic", learning_rate=0.1, num_features=NF)
+
+# the full file for the mesh run plus a pre-split half per socket
+# worker: rank r must read EXACTLY the rows the mesh places on device r
+# (InputSplit's newline-seek hands a boundary row to part 0, which
+# would skew step counts and partial-sum row sets)
+workdir = tempfile.mkdtemp()
+data = os.path.join(workdir, "toy.svm")
+halves = [os.path.join(workdir, "toy.%d.svm" % r) for r in range(2)]
+rows = []
+for i in range(ROWS):
+    feats = " ".join(
+        "%d:%d" % (j + 1, (i * 7 + j * 3) % 10) for j in range(NF))
+    rows.append("%d %s\n" % (i % 2, feats))
+open(data, "w").write("".join(rows))
+open(halves[0], "w").write("".join(rows[: ROWS // 2]))
+open(halves[1], "w").write("".join(rows[ROWS // 2:]))
+
+WORKER = r'''
+import json, os, sys
+rank, port, data, out = (int(sys.argv[1]), int(sys.argv[2]),
+                         sys.argv[3], sys.argv[4])
+from dmlc_tpu import collective
+from dmlc_tpu.models import LinearLearner
+collective.init()  # DMLC_TPU_COLLECTIVE=socket forces the tree engine
+assert collective.engine_kind() == "socket", collective.engine_kind()
+learner = LinearLearner(sync="host", objective="logistic",
+                        learning_rate=0.1, num_features=8)
+hist = learner.fit_uri(data, batch_size=32, epochs=3, num_features=8,
+                       part_index=0, num_parts=1)
+import numpy as np
+json.dump({"hist": [h.hex() for h in map(float, hist)],
+           "w": np.asarray(learner.params["w"]).tobytes().hex(),
+           "b": np.asarray(learner.params["b"]).tobytes().hex()},
+          open(out, "w"))
+collective.finalize()
+'''
+
+worker_py = os.path.join(workdir, "worker.py")
+open(worker_py, "w").write(WORKER)
+
+from dmlc_tpu.tracker.rendezvous import RabitTracker
+tracker = RabitTracker("127.0.0.1", 2, port=19590, port_end=19690)
+tracker.start(2)
+procs, outs = [], []
+for rank in range(2):
+    out = os.path.join(workdir, "r%d.json" % rank)
+    outs.append(out)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="",
+               DMLC_TPU_COLLECTIVE="socket",
+               DMLC_TRACKER_URI="127.0.0.1",
+               DMLC_TRACKER_PORT=str(tracker.port),
+               DMLC_TASK_ID=str(rank), PYTHONPATH=os.getcwd())
+    procs.append(subprocess.Popen(
+        [sys.executable, worker_py, str(rank), str(tracker.port),
+         halves[rank], out], env=env))
+for p in procs:
+    if p.wait(timeout=240) != 0:
+        sys.exit("ci_checks: socket hostsync worker failed (rc=%d)"
+                 % p.returncode)
+tracker.join(); tracker.close()
+socket_runs = [json.load(open(o)) for o in outs]
+if socket_runs[0] != socket_runs[1]:
+    sys.exit("ci_checks: socket ranks disagree on the fitted model")
+
+# the mesh twin: whole file (world=1), global batch 64 sharded 32/32
+import jax
+from jax.sharding import Mesh
+from dmlc_tpu import collective, obs
+from dmlc_tpu.models import LinearLearner
+collective.init()  # DMLC_TPU_COLLECTIVE=device forces DeviceEngine
+assert collective.engine_kind() == "device", collective.engine_kind()
+mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+learner = LinearLearner(mesh=mesh, **HYPER)
+hist = learner.fit_uri(data, batch_size=ROWS, epochs=EPOCHS,
+                       num_features=NF)
+spmd = {"hist": [h.hex() for h in map(float, hist)],
+        "w": np.asarray(learner.params["w"]).tobytes().hex(),
+        "b": np.asarray(learner.params["b"]).tobytes().hex()}
+if spmd != socket_runs[0]:
+    sys.exit("ci_checks: SPMD psum run diverged from the socket tree:\n"
+             "  spmd   %r\n  socket %r" % (spmd, socket_runs[0]))
+# the acceptance claim in observable form: training's gradient sync
+# crossed ICI in-graph, so the host-path collective moved nothing back
+d2h = obs.registry().counter(
+    "dmlc_collective_d2h_bytes_total", "", op="allreduce").value
+if d2h != 0:
+    sys.exit("ci_checks: SPMD run copied %d collective D2H bytes" % d2h)
+shutil.rmtree(workdir, ignore_errors=True)
+print("ci_checks: SPMD collective smoke OK "
+      "(device psum == socket tree, bit-exact; 0 collective D2H bytes)")
 EOF
 
 echo "ci_checks: all checks passed"

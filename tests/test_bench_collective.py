@@ -1,8 +1,11 @@
 """The collective benchmark tier must stay runnable: tiny-size smoke of
-both measurements (socket loopback allreduce GB/s, device psum step)."""
+the measurements (socket loopback allreduce GB/s, device psum step, the
+in-graph SPMD step) plus the topology-override restore contract."""
 
 import os
 import sys
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -112,3 +115,57 @@ class TestBucketedAllreduce:
                 rtol=tol, atol=tol,
             )
             assert fused[k].dtype == grads[k].dtype
+
+
+class TestForcedTopology:
+    """The bench's topology override must restore the CONSTRUCTED
+    threshold — including env overrides and on the exception path —
+    so post-block collectives honor the engine's real crossover."""
+
+    class _FakeEngine:
+        ring_threshold_bytes = 12345  # stands in for a constructed value
+
+    def test_forces_and_restores(self):
+        eng = self._FakeEngine()
+        with bench_collective.forced_topology(eng, "ring"):
+            assert eng.ring_threshold_bytes == 0
+        assert eng.ring_threshold_bytes == 12345
+        with bench_collective.forced_topology(eng, "tree"):
+            assert eng.ring_threshold_bytes == 1 << 62
+        assert eng.ring_threshold_bytes == 12345
+
+    def test_restores_on_exception(self):
+        eng = self._FakeEngine()
+        with pytest.raises(RuntimeError):
+            with bench_collective.forced_topology(eng, "ring"):
+                raise RuntimeError("bench worker died mid-loop")
+        assert eng.ring_threshold_bytes == 12345
+
+
+class TestSpmdStepTier:
+    def test_spmd_psum_step_metrics_on_mesh(self):
+        out = bench_collective.spmd_psum_step_metrics(
+            payload_mb=0.5, iters=2)
+        assert out["spmd_devices"] == 8  # conftest's virtual CPU mesh
+        assert out["spmd_platform"] == "cpu"
+        assert out["spmd_step_ms"] > 0
+        assert out["spmd_psum_step_gbps"] > 0
+        assert "ici_utilization" not in out  # cpu: no ICI peak estimate
+
+    def test_sentry_gates_spmd_keys_higher_is_better(self):
+        """The new bench keys must be wired into the perf sentry as
+        higher-is-better: a drop past tolerance is a regression."""
+        from dmlc_tpu.obs import sentry
+
+        hist = [
+            {"metric": "m", "value": 1.0,
+             "extra": {"spmd_psum_step_gbps": g, "ici_utilization": u}}
+            for g, u in ((10.0, 0.9), (10.2, 0.91), (10.1, 0.92))
+        ]
+        series = sentry.metric_series(hist)
+        fresh = sentry.record_values(
+            {"metric": "m", "value": 1.0,
+             "extra": {"spmd_psum_step_gbps": 5.0,
+                       "ici_utilization": 0.4}})
+        names = {r["metric"] for r in sentry.gate(fresh, series)}
+        assert {"spmd_psum_step_gbps", "ici_utilization"} <= names
