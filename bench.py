@@ -1055,6 +1055,7 @@ _COMPACT_KEYS = (
     "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
     "gbdt_fit_mrows_s",
     "sgd_e2e_multijob_mbps", "cache_cross_job_hit_ratio",
+    "sgd_goodput_ratio",
     "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",
     "device_tier_probes_gbps",
     "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
@@ -1066,6 +1067,12 @@ _COMPACT_KEYS = (
     "engine_allreduce_gbps", "engine_reduce_single_process_gbps",
     "headline_cfg_nthread", "headline_spread_mbps", "headline_sweep",
 )
+
+
+# sentry direction registry carried on every record (obs/sentry.py
+# record_directions): extra keys the gate scores that no suffix rule
+# covers — sgd_goodput_ratio is a 0..1 fraction, higher is better
+BENCH_DIRECTIONS = {"sgd_goodput_ratio": "higher"}
 
 
 # a harvest is only worth embedding if it carries DEVICE evidence — every
@@ -1256,6 +1263,7 @@ def _compact_summary(headline: float, extra: dict) -> dict:
 
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    t_run0 = time.time()
     path = _ensure_data()
 
     _one_pass(path, 1)  # warmup: native build, page cache, allocators
@@ -1430,6 +1438,38 @@ def main() -> None:
         extra["device_telemetry_error"] = str(err)[:120]
 
     try:
+        # whole-run goodput attribution (obs/goodput.py): the run's
+        # registry totals ARE the delta-from-zero, the wall is this
+        # process's elapsed time, and the ceilings are the run's OWN
+        # measurements — parse_only tier for parse, the host H2D probe
+        # for h2d — so the binding verdict rides the artifact and
+        # sgd_goodput_ratio gates against history via the direction map
+        from dmlc_tpu import obs
+        from dmlc_tpu.obs import goodput as _goodput
+
+        flat = obs.registry().flat_values()
+        ceilings = _goodput.default_ceilings()
+        probe = extra.get("device_feed_probe_gbps")
+        if isinstance(probe, (int, float)) and probe > 0:
+            ceilings["h2d_mbps"] = round(float(probe) * 1000.0, 1)
+        parse_peak = max(
+            (float(v) for k, v in extra.items()
+             if k.startswith("parse_only_") and k.endswith("_gbps")
+             and isinstance(v, (int, float))),
+            default=0.0,
+        )
+        if parse_peak > 0:
+            ceilings["parse_mbps"] = round(parse_peak * 1000.0, 1)
+        att = _goodput.attribute(
+            flat, max(time.time() - t_run0, 1e-9),
+            ceilings=ceilings, current=flat,
+        )
+        extra["goodput"] = att
+        extra["sgd_goodput_ratio"] = att["goodput"]["ratio"]
+    except Exception as err:
+        extra["goodput_error"] = str(err)[:120]
+
+    try:
         # advisory perf-sentry pass (report-only — the blocking gate is
         # `dmlc_tpu.tools bench-gate` in scripts/ci_checks.sh): gate this
         # run against the committed round history so the regression
@@ -1442,9 +1482,13 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json"))))
         if hist:
             fresh_rec = {"metric": "higgs_libsvm_ingest",
-                         "value": round(headline, 1), "extra": extra}
-            regs = sentry.gate(sentry.record_values(fresh_rec),
-                               sentry.metric_series(hist))
+                         "value": round(headline, 1), "extra": extra,
+                         "directions": dict(BENCH_DIRECTIONS)}
+            regs = sentry.gate(
+                sentry.record_values(fresh_rec),
+                sentry.metric_series(hist),
+                directions=sentry.record_directions(hist + [fresh_rec]),
+            )
             extra["sentry"] = {
                 "history_records": len(hist),
                 "regressions": [
@@ -1467,6 +1511,9 @@ def main() -> None:
             "unit": "MB/s",
             "vs_baseline": round(headline / REFERENCE_MBPS, 3),
             "extra": extra,
+            # per-record sentry direction registry (obs/sentry.py):
+            # names extra keys the gate scores beyond the suffix rules
+            "directions": dict(BENCH_DIRECTIONS),
         }
     )
     try:

@@ -349,6 +349,11 @@ class DeviceFeed:
         }
         self._m_batches = reg.counter(
             "dmlc_feed_batches_total", "device batches delivered", feed=fid)
+        # rows delivered — the goodput ledger's examples/s numerator
+        # (obs/goodput.py windows it against wall time)
+        self._m_rows = reg.counter(
+            "dmlc_feed_rows_total", "examples delivered to device",
+            feed=fid)
         # H2D accounting around _put_tree: None when device telemetry is
         # off, and then the dispatch path has no byte walk and no timer.
         self._h2d = device_telemetry.h2d_meter(feed=fid)
@@ -715,6 +720,14 @@ class DeviceFeed:
                     pending.append(batch_bufs + (flows, seqs))
                 self._stage["dispatch_ns"].observe(time.monotonic_ns() - t1)
                 self._m_batches.inc()
+                # row accounting across block shapes: native dense tuple
+                # carries its count at [3], padded batches as num_rows,
+                # python RowBlocks via len()
+                if isinstance(block, tuple):
+                    self._m_rows.inc(int(block[3]))
+                else:
+                    self._m_rows.inc(
+                        int(getattr(block, "num_rows", 0) or len(block)))
                 nbatch += 1
             if len(pending) > window:
                 yield from _consume(pending.popleft())

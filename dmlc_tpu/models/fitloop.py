@@ -1,0 +1,91 @@
+"""Shared fit-loop observability: one epoch-boundary helper for every
+learner.
+
+Before this module each learner hand-rolled the same block — register
+the four ``dmlc_fit_*`` metrics, observe the epoch histogram, log the
+feed's stall breakdown (linear only, behind a function-local import),
+export the registry. :class:`FitLoopObs` is that block once, plus the
+runtime instruments this layer gained: a goodput ledger window per
+epoch (obs/goodput.py) and the SLO watchdog over those windows
+(obs/watchdog.py). linear, FM, and GBDT all funnel through it, so the
+epoch log line and the binding-constraint verdict are uniform across
+models.
+
+Usage::
+
+    fl = FitLoopObs("linear")
+    for epoch in range(epochs):
+        t0 = time.monotonic_ns()
+        for batch in feed:
+            ...
+            fl.note_step()
+        fl.end_epoch(epoch, nstep, t0, loss, feed=feed,
+                     log_every=log_every)
+
+Under ``DMLC_TPU_METRICS=0`` the registry hands back no-op children and
+the ledger/watchdog collapse to the shared no-op child, so the hot path
+stays allocation-free.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from dmlc_tpu import obs
+from dmlc_tpu.device.feed import stall_breakdown
+from dmlc_tpu.obs import goodput
+from dmlc_tpu.obs.watchdog import make_watchdog
+from dmlc_tpu.utils.logging import log_info
+
+
+class FitLoopObs:
+    """Per-fit observability bundle: fit metrics, stall logging, the
+    goodput ledger, and the runtime watchdog."""
+
+    def __init__(self, model: str, reg=None):
+        self.model = model
+        self.reg = reg if reg is not None else obs.registry()
+        self.m_steps = self.reg.counter(
+            "dmlc_fit_steps_total", "optimizer steps taken", model=model)
+        self.m_epochs = self.reg.counter(
+            "dmlc_fit_epochs_total", "epochs completed", model=model)
+        self.g_loss = self.reg.gauge(
+            "dmlc_fit_loss_value", "last epoch mean loss", model=model)
+        self.h_epoch = self.reg.histogram(
+            "dmlc_fit_epoch_ns", "wall time per epoch", model=model)
+        self.ledger = goodput.ledger(self.reg)
+        self.watchdog = make_watchdog(self.reg)
+
+    def note_step(self, n: int = 1) -> None:
+        """Hot-path progress marker (one no-op call under
+        ``DMLC_TPU_METRICS=0``)."""
+        self.ledger.note_step(n)
+
+    def end_epoch(self, epoch: int, nstep: int, t0_ns: int,
+                  loss: Optional[float], feed=None,
+                  log_every: int = 0) -> Optional[dict]:
+        """Close one epoch: fit metrics, a goodput-ledger window fed to
+        the watchdog, the unified stall/goodput log line (every
+        ``log_every``-th epoch), and the registry export. Returns the
+        ledger window (None when metrics are disabled)."""
+        self.h_epoch.observe(time.monotonic_ns() - t0_ns)
+        self.m_steps.inc(nstep)
+        self.m_epochs.inc()
+        if loss is not None:
+            self.g_loss.set(loss)
+        win = self.ledger.tick()
+        if win is not None:
+            self.watchdog.observe(win)
+        if log_every and (epoch + 1) % log_every == 0:
+            parts = ["%s epoch %d" % (self.model, epoch)]
+            if loss is not None:
+                parts.append("loss %.6f" % loss)
+            if feed is not None:
+                parts.append(stall_breakdown(feed.stats()))
+            if win is not None:
+                parts.append("goodput %.2f binding=%s" % (
+                    win["goodput"]["ratio"], win["binding"]))
+            log_info("%s", " ".join(parts))
+        obs.export_epoch(self.reg)
+        return win

@@ -231,16 +231,9 @@ class FMLearner:
             "differ between mesh and single-device runs)",
         )
         from dmlc_tpu import obs
+        from dmlc_tpu.models.fitloop import FitLoopObs
 
-        reg = obs.registry()
-        m_steps = reg.counter(
-            "dmlc_fit_steps_total", "optimizer steps taken", model="fm")
-        m_epochs = reg.counter(
-            "dmlc_fit_epochs_total", "epochs completed", model="fm")
-        g_loss = reg.gauge(
-            "dmlc_fit_loss_value", "last epoch mean loss", model="fm")
-        h_epoch = reg.histogram(
-            "dmlc_fit_epoch_ns", "wall time per epoch", model="fm")
+        fl = FitLoopObs("fm")
         history = []
         for epoch in range(epochs):
             acc = EpochMetrics()
@@ -255,20 +248,12 @@ class FMLearner:
                             self.params, step_batch(batch, "csr")
                         )
                     acc.add(metrics)
+                    fl.note_step()
                     nstep += 1
-            h_epoch.observe(time.monotonic_ns() - t0)
-            m_steps.inc(nstep)
-            m_epochs.inc()
             loss = acc.mean_loss()
-            g_loss.set(loss)
             history.append(loss)
-            if log_every and (epoch + 1) % log_every == 0:
-                from dmlc_tpu.device.feed import stall_breakdown
-                from dmlc_tpu.utils.logging import log_info
-
-                log_info("fm epoch %d loss %.6f %s", epoch, history[-1],
-                         stall_breakdown(feed.stats()))
-            obs.export_epoch(reg)
+            fl.end_epoch(epoch, nstep, t0, loss, feed=feed,
+                         log_every=log_every)
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

@@ -948,25 +948,18 @@ class GBDTLearner:
 
         p = self.param
         # one fit = one "epoch"; trees are the steps (both the fused-scan
-        # and the live-logging path funnel their history through _obs_fit)
-        reg = obs.registry()
+        # and the live-logging path funnel their history through _obs_fit,
+        # and both go through the shared fit-loop helper — same metrics,
+        # goodput window, and watchdog pass as the feed-driven learners)
+        from dmlc_tpu.models.fitloop import FitLoopObs
+
+        fl = FitLoopObs("gbdt")
         _t_fit = time.monotonic_ns()
 
         def _obs_fit(history):
-            reg.histogram(
-                "dmlc_fit_epoch_ns", "wall time per epoch",
-                model="gbdt").observe(time.monotonic_ns() - _t_fit)
-            reg.counter(
-                "dmlc_fit_steps_total", "optimizer steps taken",
-                model="gbdt").inc(len(history))
-            reg.counter(
-                "dmlc_fit_epochs_total", "epochs completed",
-                model="gbdt").inc()
-            if history:
-                reg.gauge(
-                    "dmlc_fit_loss_value", "last epoch mean loss",
-                    model="gbdt").set(history[-1])
-            obs.export_epoch(reg)
+            fl.note_step(len(history))
+            fl.end_epoch(0, len(history), _t_fit,
+                         history[-1] if history else None)
             return history
         if p.objective == "softmax":
             # the shared chokepoint: fit AND fit_uri funnel here, so both

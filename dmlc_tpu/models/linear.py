@@ -687,16 +687,9 @@ class LinearLearner:
             "differ between mesh and single-device runs)",
         )
         from dmlc_tpu import obs
+        from dmlc_tpu.models.fitloop import FitLoopObs
 
-        reg = obs.registry()
-        m_steps = reg.counter(
-            "dmlc_fit_steps_total", "optimizer steps taken", model="linear")
-        m_epochs = reg.counter(
-            "dmlc_fit_epochs_total", "epochs completed", model="linear")
-        g_loss = reg.gauge(
-            "dmlc_fit_loss_value", "last epoch mean loss", model="linear")
-        h_epoch = reg.histogram(
-            "dmlc_fit_epoch_ns", "wall time per epoch", model="linear")
+        fl = FitLoopObs("linear")
         history = []
         for epoch in range(epochs):
             acc = EpochMetrics()
@@ -714,23 +707,17 @@ class LinearLearner:
                             step_batch(batch, layout)
                         )
                     acc.add(metrics)
+                    fl.note_step()
                     nstep += 1
                     if log_every and nstep % log_every == 0:
                         log_info(
                             "epoch %d step %d loss %.6f",
                             epoch, nstep, acc.mean_loss(),
                         )
-            h_epoch.observe(time.monotonic_ns() - t0)
-            m_steps.inc(nstep)
-            m_epochs.inc()
             loss = acc.mean_loss()
-            g_loss.set(loss)
             history.append(loss)
-            if log_every:
-                from dmlc_tpu.device.feed import stall_breakdown
-
-                log_info("epoch %d %s", epoch, stall_breakdown(feed.stats()))
-            obs.export_epoch(reg)
+            fl.end_epoch(epoch, nstep, t0, loss, feed=feed,
+                         log_every=log_every)
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

@@ -30,6 +30,13 @@ bottleneck diagnosis and auto-tuning):
   recompile sentinel, HBM/live-buffer gauges, H2D bandwidth metering,
   and on-demand ``jax.profiler`` capture through the status plane
   (``DMLC_TPU_DEVICE_TELEMETRY``; see obs/device_telemetry.py)
+- ``obs.goodput`` — the runtime goodput ledger: per-window stage
+  budgets, roofline attribution, and the live binding-constraint
+  verdict served by ``/goodput``, obs-top, obs-report, and bench
+  (see obs/goodput.py)
+- ``obs.watchdog`` — the in-run SLO watchdog over ledger windows:
+  throughput collapse, recompile storms, pipeline stalls, straggler
+  ranks; fires ``watchdog.alert`` flight events (see obs/watchdog.py)
 
 Metric names follow ``dmlc_<area>_<name>_<unit>`` and every registered
 name is documented in docs/observability.md (enforced by
@@ -38,6 +45,8 @@ name is documented in docs/observability.md (enforced by
 
 from dmlc_tpu.obs.aggregate import cross_host_snapshot, report_skew
 from dmlc_tpu.obs.device_telemetry import instrumented_jit
+from dmlc_tpu.obs.goodput import GoodputLedger, attribute, ledger
+from dmlc_tpu.obs.watchdog import Watchdog, make_watchdog
 from dmlc_tpu.obs.exporters import (
     export_epoch,
     export_jsonl,
@@ -91,4 +100,9 @@ __all__ = [
     "cross_host_snapshot",
     "report_skew",
     "instrumented_jit",
+    "GoodputLedger",
+    "attribute",
+    "ledger",
+    "Watchdog",
+    "make_watchdog",
 ]

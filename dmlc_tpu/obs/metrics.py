@@ -244,6 +244,18 @@ class _Noop:
     def observe(self, v):
         pass
 
+    # goodput-ledger / watchdog surface (obs/goodput.py, obs/watchdog.py
+    # factories hand this same child out under DMLC_TPU_METRICS=0, so
+    # the fit-loop hot path stays one empty call, zero allocations)
+    windows = ()
+    alerts = ()
+
+    def note_step(self, n=1):
+        pass
+
+    def tick(self, *args, **kwargs):
+        return None
+
     def buckets(self):
         return {}
 

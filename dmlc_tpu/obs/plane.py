@@ -20,9 +20,11 @@ timelines — so:
   ``DMLC_TPU_STATUS_PORT``) serves it: ``/healthz``, ``/workers``
   (membership ``world_version`` + event log + rank →
   last-seen/lag/straggler), ``/metrics`` (Prometheus text merged
-  across ranks), ``/trace`` (job-wide Chrome-trace JSON), and ``/data``
+  across ranks), ``/trace`` (job-wide Chrome-trace JSON), ``/data``
   (the data dispatcher's worker/lease/requeue view, when one is
-  attached — see data/dispatcher.py).
+  attached — see data/dispatcher.py), and ``/goodput`` (per-rank +
+  job-rolled goodput attribution from consecutive metric snapshots —
+  obs/goodput.py).
 - **Clock skew** — each payload carries the worker's send wall-time and
   its last measured heartbeat RTT; the tracker estimates per-rank offset
   as ``recv − sent − rtt/2`` (the NTP/obs-aggregate midpoint idea) and
@@ -57,7 +59,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Deque, Dict, List, Optional, Tuple
 
-from dmlc_tpu.obs import trace
+from dmlc_tpu.obs import goodput, trace
 from dmlc_tpu.obs.exporters import prometheus_lines
 from dmlc_tpu.obs.metrics import Registry, registry
 from dmlc_tpu.params.knobs import obs_payload_max, obs_publish_enabled
@@ -294,7 +296,7 @@ def reset_default_publisher() -> None:
 class _WorkerView:
     __slots__ = ("rank", "last_seen_unix", "info", "epoch", "anchor_unix_ns",
                  "offset_ns", "rtt_ns", "metrics", "spans", "spans_dropped",
-                 "payloads")
+                 "payloads", "metrics_recv_unix_ns", "goodput")
 
     def __init__(self, rank: int, max_spans: int):
         self.rank = rank
@@ -308,6 +310,10 @@ class _WorkerView:
         self.spans: Deque[Dict] = collections.deque(maxlen=max_spans)
         self.spans_dropped = 0
         self.payloads = 0
+        # goodput attribution between consecutive metric snapshots
+        # (obs/goodput.py — the same code path every surface renders)
+        self.metrics_recv_unix_ns = 0
+        self.goodput: Optional[Dict] = None
 
 
 def _split_flat(flat: str) -> Tuple[str, str]:
@@ -389,7 +395,18 @@ class StatusPlane:
                 view.offset_ns = recv_unix_ns - int(sent) - view.rtt_ns // 2
             metrics = obj.get("metrics")
             if isinstance(metrics, dict) and metrics:
+                # attribute the delta between consecutive snapshots —
+                # the per-rank half of the /goodput endpoint (the job
+                # roll-up re-derives from these via goodput.rolled)
+                prev = view.metrics
+                prev_ns = view.metrics_recv_unix_ns
+                if prev and prev_ns and recv_unix_ns > prev_ns:
+                    view.goodput = goodput.attribute(
+                        goodput.flat_delta(metrics, prev),
+                        (recv_unix_ns - prev_ns) / 1e9,
+                        current=metrics)
                 view.metrics = dict(metrics)
+                view.metrics_recv_unix_ns = recv_unix_ns
             spans = obj.get("spans")
             if isinstance(spans, list):
                 view.spans.extend(
@@ -448,6 +465,23 @@ class StatusPlane:
         except Exception as err:  # noqa: BLE001 — a dying dispatcher must
             # not take the status server down with it
             return {"attached": True, "error": str(err)}
+
+    def goodput_view(self) -> Dict:
+        """The ``/goodput`` body: per-rank attribution windows plus the
+        job roll-up, all produced by obs/goodput.py's one code path
+        (``attribute`` per rank in :meth:`note_payload`, ``rolled``
+        across ranks here). Ranks appear once two metric snapshots have
+        landed (a window needs a delta)."""
+        with self._lock:
+            per_rank = {
+                str(rank): v.goodput
+                for rank, v in sorted(self._views.items())
+                if v.goodput is not None
+            }
+        return {
+            "ranks": per_rank,
+            "job": goodput.rolled(list(per_rank.values())),
+        }
 
     def membership(self) -> Dict:
         """``{"world_version": N, "events": [...]}`` — the elastic half of
@@ -648,6 +682,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 ctype = "application/json"
             elif path == "/data":
                 body = json.dumps(plane.data_view()).encode()
+                ctype = "application/json"
+            elif path == "/goodput":
+                body = json.dumps(plane.goodput_view()).encode()
                 ctype = "application/json"
             elif path == "/profile":
                 from urllib.parse import parse_qs

@@ -188,13 +188,23 @@ def load_records(paths: Sequence[str]) -> List[Dict]:
 
 def record_values(rec: Dict) -> Dict[str, float]:
     """The gateable metric values of one record (see module docstring
-    for the key→direction rules)."""
+    for the key→direction rules). Keys named in the record's optional
+    ``directions`` map are gated too, whatever their suffix — the
+    per-record direction registry that replaces growing
+    ``_HIGHER_KEYS`` (``record_directions`` collects the map for
+    :func:`gate`)."""
     vals: Dict[str, float] = {}
     if _is_number(rec.get("value")) and rec.get("metric"):
         vals[str(rec["metric"])] = float(rec["value"])
     extra = rec.get("extra") or {}
     if not isinstance(extra, dict):
         return vals
+    directions = rec.get("directions")
+    if isinstance(directions, dict):
+        for key in directions:
+            v = extra.get(key)
+            if _is_number(v):
+                vals[str(key)] = float(v)
     for key, v in extra.items():
         if _is_number(v) and (key.endswith(_HIGHER_SUFFIXES)
                               or key in _HIGHER_KEYS):
@@ -227,7 +237,31 @@ def metric_series(records: Sequence[Dict]) -> Dict[str, List[float]]:
     return series
 
 
-def lower_is_better(key: str) -> bool:
+def record_directions(records: Sequence[Dict]) -> Dict[str, str]:
+    """Merge the per-record ``directions`` maps of a history series
+    (latest record wins per key) — the second argument
+    :func:`lower_is_better` consults before its prefix rules."""
+    out: Dict[str, str] = {}
+    for rec in records:
+        d = rec.get("directions") if isinstance(rec, dict) else None
+        if isinstance(d, dict):
+            for key, v in d.items():
+                if v in ("higher", "lower"):
+                    out[str(key)] = v
+    return out
+
+
+def lower_is_better(key: str,
+                    directions: Optional[Dict[str, str]] = None) -> bool:
+    """Direction of one gated key: an explicit per-record ``directions``
+    entry wins; otherwise the suffix/prefix rules in the module
+    docstring decide (default: higher is better)."""
+    if directions:
+        d = directions.get(key)
+        if d == "lower":
+            return True
+        if d == "higher":
+            return False
     return key.startswith(_LOWER_PREFIXES)
 
 
@@ -238,6 +272,7 @@ def gate(
     mad_mult: float = DEFAULT_MAD_MULT,
     window: int = DEFAULT_WINDOW,
     min_samples: int = DEFAULT_MIN_SAMPLES,
+    directions: Optional[Dict[str, str]] = None,
 ) -> List[Dict]:
     """Compare fresh metric values against their history series.
 
@@ -254,7 +289,7 @@ def gate(
         med = _median(hist)
         tol = max(rel_tol * abs(med), mad_mult * _mad(hist, med))
         value = fresh[key]
-        if lower_is_better(key):
+        if lower_is_better(key, directions):
             breach = value - (med + tol)
         else:
             breach = (med - tol) - value
@@ -265,7 +300,8 @@ def gate(
             "value": value,
             "baseline": med,
             "tolerance": tol,
-            "direction": "lower" if lower_is_better(key) else "higher",
+            "direction": "lower" if lower_is_better(key, directions)
+            else "higher",
             "samples": len(hist),
             "severity": breach / tol if tol > 0 else float("inf"),
         }

@@ -153,6 +153,25 @@ docs/pipeline.md "Vectorized parse") adds three more:
   the engine when the CPU supports it (parity tests force this),
   anything else = engine off
 
+The goodput ledger and runtime watchdog (obs/goodput.py +
+obs/watchdog.py, see docs/observability.md "Goodput & attribution")
+add five more:
+
+- ``DMLC_TPU_WATCHDOG_STALL_S`` — cumulative seconds of zero ledger
+  progress before the watchdog fires a ``stall`` alert (default 60;
+  0 disables stall detection)
+- ``DMLC_TPU_WATCHDOG_PROFILE`` — when 1, a firing watchdog triggers
+  the on-demand device profiler capture for the regression window
+  (default off)
+- ``DMLC_TPU_PARSE_PEAK_MBPS`` — roofline ceiling for the parse stage
+  in MB/s (default 1000 — the vectorized parse_only tier)
+- ``DMLC_TPU_STEP_PEAK_MBPS`` — roofline ceiling for the device step's
+  byte rate in MB/s (default 0 = unknown; set from the model's measured
+  FLOP rate)
+- ``DMLC_TPU_ICI_PEAK_GBPS`` — per-direction per-link ICI peak in GB/s
+  (default 45; the same figure bench_collective.py scores utilization
+  against)
+
 ``KNOWN_KNOBS`` below is the authoritative list of every
 ``DMLC_TPU_*`` variable the tree reads; ``scripts/check_faultpoints.py``
 fails CI when a knob is referenced anywhere without being registered
@@ -405,6 +424,42 @@ def hbm_poll_s() -> float:
     return max(0.0, float(get_env("DMLC_TPU_HBM_POLL_S", 0.0)))
 
 
+def watchdog_stall_s() -> float:
+    """Cumulative seconds without goodput-ledger progress before the
+    runtime watchdog fires a ``stall`` alert
+    (``DMLC_TPU_WATCHDOG_STALL_S``, default 60; 0 = stall detection
+    off)."""
+    return max(0.0, float(get_env("DMLC_TPU_WATCHDOG_STALL_S", 60.0)))
+
+
+def watchdog_profile() -> bool:
+    """Whether a firing watchdog auto-triggers the on-demand device
+    profiler capture for the regression window
+    (``DMLC_TPU_WATCHDOG_PROFILE``, default off)."""
+    return get_env("DMLC_TPU_WATCHDOG_PROFILE", False)
+
+
+def parse_peak_mbps() -> float:
+    """Roofline ceiling for the parse stage in MB/s
+    (``DMLC_TPU_PARSE_PEAK_MBPS``, default 1000 — the vectorized
+    parse_only bench tier; 0 = unknown)."""
+    return max(0.0, float(get_env("DMLC_TPU_PARSE_PEAK_MBPS", 1000.0)))
+
+
+def step_peak_mbps() -> float:
+    """Roofline ceiling for the device step's consumed byte rate in
+    MB/s (``DMLC_TPU_STEP_PEAK_MBPS``, default 0 = unknown — set it
+    from the model's measured FLOP rate to score step utilization)."""
+    return max(0.0, float(get_env("DMLC_TPU_STEP_PEAK_MBPS", 0.0)))
+
+
+def ici_peak_gbps() -> float:
+    """Per-direction per-link ICI peak bandwidth in GB/s
+    (``DMLC_TPU_ICI_PEAK_GBPS``, default 45 — the figure
+    bench_collective.py scores utilization against)."""
+    return max(0.0, float(get_env("DMLC_TPU_ICI_PEAK_GBPS", 45.0)))
+
+
 def parse_backend() -> str:
     """Chunk-parse implementation (``DMLC_TPU_PARSE_BACKEND``): one of
     ``auto`` (native when loadable, else vector — the default),
@@ -491,6 +546,12 @@ KNOWN_KNOBS = (
     # device telemetry
     "DMLC_TPU_DEVICE_TELEMETRY",
     "DMLC_TPU_HBM_POLL_S",
+    # goodput ledger + runtime watchdog
+    "DMLC_TPU_WATCHDOG_STALL_S",
+    "DMLC_TPU_WATCHDOG_PROFILE",
+    "DMLC_TPU_PARSE_PEAK_MBPS",
+    "DMLC_TPU_STEP_PEAK_MBPS",
+    "DMLC_TPU_ICI_PEAK_GBPS",
     # collective / distributed bootstrap
     "DMLC_TPU_COLLECTIVE",
     "DMLC_TPU_RECOVER_TIMEOUT",

@@ -124,10 +124,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     series = sentry.metric_series(history)
+    # direction registry: fresh record's map wins, history fills gaps
+    directions = sentry.record_directions(history + [fresh_rec])
     regressions = sentry.gate(
         sentry.record_values(fresh_rec), series,
         rel_tol=args.rel_tol, mad_mult=args.mad_mult,
         window=args.window, min_samples=args.min_samples,
+        directions=directions,
     )
     if regressions:
         print(sentry.format_report(regressions, fresh_source=fresh_path))

@@ -18,6 +18,10 @@ Renders a human-readable summary of a job's observability artifacts:
   memory, H2D bandwidth — obs/device_telemetry.py) from ``/metrics``.
 - ``--top`` — with ``--status``: render the same per-rank table the live
   ``obs-top`` tool shows, once (the non-live fallback).
+- ``--attribution`` — with ``--status``: fetch ``/goodput`` and render
+  the per-rank + job-rolled stage-budget/roofline attribution tables
+  (obs/goodput.py — the same code path the bench detail record and
+  obs-top's goodput column use), binding constraint flagged per window.
 - ``--diff A B`` — compare two traces (e.g. the last good run's
   ``/trace`` download vs the regressed run's): per-stage total time
   delta, biggest eater first — "which stage ate the regression", the
@@ -251,10 +255,14 @@ def _report_diff(path_a: str, path_b: str) -> bool:
 
 
 def _report_workers(workers: Dict[str, Dict]) -> None:
+    # /workers nests the per-rank map under "workers" next to the
+    # membership header (world_version, ...); older flat payloads keep
+    # the ranks at top level
+    ranks = workers.get("workers", workers)
     print("== workers ==")
     print(f"{'rank':>4} {'lag_s':>8} {'straggler':>9} {'epoch':>6} "
           f"{'spans':>6} {'dropped':>7}")
-    for rank, info in sorted(workers.items(), key=lambda kv: int(kv[0])):
+    for rank, info in sorted(ranks.items(), key=lambda kv: int(kv[0])):
         print(f"{rank:>4} {str(info.get('lag_s')):>8} "
               f"{str(info.get('straggler')):>9} "
               f"{str(info.get('epoch')):>6} {str(info.get('spans')):>6} "
@@ -328,6 +336,27 @@ def _report_device(metrics_text: str) -> bool:
     return True
 
 
+def _report_attribution(goodput_obj: Dict) -> bool:
+    """The ``/goodput`` endpoint rendered: one stage-budget/roofline
+    table per reporting rank plus the job-rolled view, through the one
+    shared formatter (goodput.format_attribution) every surface uses."""
+    from dmlc_tpu.obs import goodput
+
+    ranks = goodput_obj.get("ranks") or {}
+    job = goodput_obj.get("job")
+    if not ranks and not job:
+        print("== goodput: no attribution windows yet ==")
+        return False
+    print("== goodput attribution ==")
+    for rank in sorted(ranks, key=lambda r: int(r)):
+        att = ranks[rank]
+        if att:
+            print(goodput.format_attribution(att, label=f"rank {rank}"))
+    if job:
+        print(goodput.format_attribution(job, label="job"))
+    return True
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="obs-report", description="Render a post-run job report from "
@@ -346,9 +375,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--top", action="store_true",
                         help="With --status: render the obs-top per-rank "
                         "table once (non-live fallback).")
+    parser.add_argument("--attribution", action="store_true",
+                        help="With --status: render the /goodput per-rank "
+                        "+ job-rolled stage-budget attribution tables.")
     args = parser.parse_args(argv)
-    if args.top and not args.status:
-        print("obs-report: --top needs --status", file=sys.stderr)
+    if (args.top or args.attribution) and not args.status:
+        print("obs-report: --top/--attribution need --status",
+              file=sys.stderr)
         return 2
     reported = False
     if args.diff:
@@ -369,6 +402,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("== obs-top (one frame) ==")
                 print(render_table(rows, world_version=wv))
                 reported = True
+        if args.attribution:
+            goodput_obj = _fetch(args.status, "/goodput")
+            if goodput_obj is not None:
+                reported = _report_attribution(goodput_obj) or reported
         data = _fetch(args.status, "/data")
         if data is not None:
             reported = _report_data(data) or reported

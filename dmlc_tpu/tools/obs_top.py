@@ -99,12 +99,18 @@ def build_rows(
     workers_obj: Optional[Dict],
     prev_h2d: Optional[Dict[int, float]] = None,
     dt_s: float = 0.0,
+    goodput_obj: Optional[Dict] = None,
 ) -> Tuple[List[Dict], Dict[int, float]]:
     """One table frame from a ``/metrics`` + ``/workers`` fetch.
 
     Returns ``(rows, h2d_bytes_by_rank)`` — callers in live mode feed the
     byte totals back in as ``prev_h2d`` so the next frame shows the true
-    inter-poll transfer rate instead of the per-put histogram mean."""
+    inter-poll transfer rate instead of the per-put histogram mean.
+
+    ``goodput_obj`` is the tracker's ``/goodput`` JSON (obs/plane.py):
+    when a rank has a window there, its row carries the goodput ratio and
+    the live binding-stage verdict — same attribution code path as
+    ``obs-report --attribution`` and the bench detail record."""
     samples = parse_metrics(metrics_text)
     consume_sum = _rank_sums(samples, "dmlc_feed_consume_ns_sum")
     consume_count = _rank_sums(samples, "dmlc_feed_consume_ns_count")
@@ -125,11 +131,15 @@ def build_rows(
         except ValueError:
             continue
 
+    goodput_ranks = (goodput_obj or {}).get("ranks") or {}
+
     rows = []
     for rank in sorted(ranks):
         info = workers.get(str(rank), {})
         m = _JOB_RE.search(str(info.get("info") or ""))
         job = m.group(1) if m else None
+        att = goodput_ranks.get(str(rank)) or {}
+        gp = att.get("goodput") or {}
         count = consume_count.get(rank, 0.0)
         step_ms = (consume_sum.get(rank, 0.0) / count / 1e6) if count else 0.0
         if prev_h2d is not None and dt_s > 0 and rank in prev_h2d:
@@ -152,6 +162,8 @@ def build_rows(
             "hbm_mb": hbm_bytes / 1e6,
             "compiles": int(compiles.get(rank, 0)),
             "recompiles": int(recompiles.get(rank, 0)),
+            "goodput_ratio": gp.get("ratio"),
+            "binding": att.get("binding"),
         })
     # multi-tenant fleet: ranks serving the same job sit together
     # (unlabeled ranks first, then jobs alphabetically, rank within)
@@ -165,12 +177,17 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
     if world_version is not None:
         lines.append(f"world_version={world_version}")
     # the job column appears only when some rank is labeled, so the
-    # single-tenant frame stays byte-identical to the pre-fleet layout
+    # single-tenant frame stays byte-identical to the pre-fleet layout;
+    # same contract for the goodput/binding pair — they render only once
+    # the plane has two metric snapshots to attribute between
     with_jobs = any(r.get("job") for r in rows)
+    with_goodput = any(r.get("binding") for r in rows)
     job_hdr = f"{'job':>10} " if with_jobs else ""
+    gp_hdr = f"{'goodput':>7} {'binding':>11} " if with_goodput else ""
     lines.append(
         f"{'rank':>4} {job_hdr}{'epoch':>6} {'lag_s':>7} {'step_ms':>8} "
-        f"{'h2d_MBps':>9} {'hbm_MB':>8} {'compiles':>8} {'recomp':>6}  flag")
+        f"{'h2d_MBps':>9} {'hbm_MB':>8} {'compiles':>8} {'recomp':>6} "
+        f"{gp_hdr} flag")
     if not rows:
         lines.append("(no ranks reporting yet)")
     for r in rows:
@@ -178,11 +195,17 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
         lag = "-" if r["lag_s"] is None else f"{r['lag_s']:.2f}"
         flag = "STRAGGLER" if r["straggler"] else ""
         job_col = f"{(r.get('job') or '-'):>10} " if with_jobs else ""
+        if with_goodput:
+            ratio = r.get("goodput_ratio")
+            gp = f"{ratio * 100.0:.0f}%" if ratio is not None else "-"
+            gp_col = f"{gp:>7} {(r.get('binding') or '-'):>11} "
+        else:
+            gp_col = ""
         lines.append(
             f"{r['rank']:>4} {job_col}{epoch:>6} {lag:>7} "
             f"{r['step_ms']:>8.1f} "
             f"{r['h2d_mbps']:>9.1f} {r['hbm_mb']:>8.1f} "
-            f"{r['compiles']:>8d} {r['recompiles']:>6d}  {flag}")
+            f"{r['compiles']:>8d} {r['recompiles']:>6d} {gp_col} {flag}")
     return "\n".join(lines)
 
 
@@ -198,18 +221,23 @@ def _fetch_text(status: str, endpoint: str) -> Optional[str]:
         return None
 
 
-def _fetch_frame(status: str) -> Optional[Tuple[str, Optional[Dict]]]:
+def _fetch_frame(
+    status: str,
+) -> Optional[Tuple[str, Optional[Dict], Optional[Dict]]]:
     metrics_text = _fetch_text(status, "/metrics")
     if metrics_text is None:
         return None
-    workers_text = _fetch_text(status, "/workers")
-    workers_obj = None
-    if workers_text is not None:
+
+    def _json(endpoint: str) -> Optional[Dict]:
+        text = _fetch_text(status, endpoint)
+        if text is None:
+            return None
         try:
-            workers_obj = json.loads(workers_text)
+            return json.loads(text)
         except ValueError:
-            workers_obj = None
-    return metrics_text, workers_obj
+            return None
+
+    return metrics_text, _json("/workers"), _json("/goodput")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -228,8 +256,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     frame = _fetch_frame(args.status)
     if frame is None:
         return 2
-    metrics_text, workers_obj = frame
-    rows, h2d_prev = build_rows(metrics_text, workers_obj)
+    metrics_text, workers_obj, goodput_obj = frame
+    rows, h2d_prev = build_rows(metrics_text, workers_obj,
+                                goodput_obj=goodput_obj)
     wv = (workers_obj or {}).get("world_version")
     table = render_table(rows, world_version=wv)
     if args.once:
@@ -247,10 +276,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             frame = _fetch_frame(args.status)
             if frame is None:
                 return 2
-            metrics_text, workers_obj = frame
+            metrics_text, workers_obj, goodput_obj = frame
             rows, h2d_prev = build_rows(
                 metrics_text, workers_obj,
-                prev_h2d=h2d_prev, dt_s=max(0.1, args.interval))
+                prev_h2d=h2d_prev, dt_s=max(0.1, args.interval),
+                goodput_obj=goodput_obj)
             wv = (workers_obj or {}).get("world_version")
             table = render_table(rows, world_version=wv)
     except KeyboardInterrupt:

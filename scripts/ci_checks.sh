@@ -303,4 +303,113 @@ print("ci_checks: SPMD collective smoke OK "
       "(device psum == socket tree, bit-exact; 0 collective D2H bytes)")
 EOF
 
+# watchdog/goodput smoke: a short linear fit with a scripted mid-run
+# slowdown (the feed throttled from epoch 4 on) must trip the collapse
+# watchdog through the fit loop's own ledger — exactly one
+# watchdog.alert in the flight-recorder dump plus the
+# dmlc_watchdog_alerts_total{kind="collapse"} bump — and the status
+# plane must serve the run's roofline attribution at /goodput.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import json, os, shutil, sys, tempfile, time, urllib.request
+
+import numpy as np
+
+from dmlc_tpu import obs
+from dmlc_tpu.data.parsers import LibSVMParser
+from dmlc_tpu.device.feed import BatchSpec, DeviceFeed
+from dmlc_tpu.io.input_split import create_input_split
+from dmlc_tpu.models.linear import LinearLearner
+from dmlc_tpu.obs import flight, plane
+
+workdir = tempfile.mkdtemp(prefix="dmlc_wd_smoke_")
+rec = flight.configure(workdir, capacity=64, rank=0, install=False)
+
+NF, ROWS, EPOCHS, SLOW_FROM = 16, 600, 6, 4
+rng = np.random.RandomState(0)
+lines = []
+for i in range(ROWS):
+    ids = np.sort(rng.choice(NF, size=1 + i % 5, replace=False))
+    lines.append("%d %s" % (i % 2, " ".join(
+        "%d:%.4f" % (j, rng.rand()) for j in ids)))
+svm = os.path.join(workdir, "t.svm")
+with open(svm, "w") as fh:
+    fh.write("\n".join(lines) + "\n")
+
+
+class ThrottledFeed:
+    """The scripted regression: from epoch SLOW_FROM on every batch
+    costs an extra 250 ms, collapsing rows/s ~100x mid-run."""
+
+    def __init__(self, feed):
+        self._feed = feed
+        self._epoch = -1
+
+    def __getattr__(self, name):
+        return getattr(self._feed, name)
+
+    def __iter__(self):
+        self._epoch += 1
+        for batch in self._feed:
+            if self._epoch >= SLOW_FROM:
+                time.sleep(0.25)
+            yield batch
+
+
+reg = obs.registry()
+t0_ns = time.time_ns()
+m0 = reg.flat_values()
+
+split = create_input_split(svm, 0, 1, "text", threaded=False)
+feed = DeviceFeed(
+    LibSVMParser(split, nthread=1),
+    BatchSpec(batch_size=128, layout="dense", num_features=NF))
+learner = LinearLearner(learning_rate=0.1)
+learner.fit_feed(ThrottledFeed(feed), epochs=EPOCHS)
+feed.close()
+t1_ns = time.time_ns()
+m1 = reg.flat_values()
+
+# the collapse must have fired exactly once (fire-once hysteresis:
+# epoch 4 trips it, epoch 5 stays silent) and landed in the dump
+alerts = [r for r in rec.records() if r["kind"] == "watchdog.alert"]
+if [a.get("alert") for a in alerts] != ["collapse"]:
+    sys.exit("ci_checks: expected one collapse alert, got %r" % alerts)
+bumped = reg.counter(
+    "dmlc_watchdog_alerts_total", "", kind="collapse").value
+if bumped != 1:
+    sys.exit("ci_checks: alerts counter = %r, want 1" % bumped)
+dump_path = rec.dump("watchdog_smoke")
+dumped = json.load(open(dump_path))["records"]
+if not any(r["kind"] == "watchdog.alert" and r.get("alert") == "collapse"
+           for r in dumped):
+    sys.exit("ci_checks: collapse alert missing from flight dump")
+
+# the plane rolls the same run's heartbeat delta into /goodput
+sp = plane.StatusPlane(num_workers=1, heartbeat_gap=60.0)
+sp.note_payload(0, {"sent_unix_ns": t0_ns, "anchor_unix_ns": 1,
+                    "metrics": m0, "spans": []}, recv_unix_ns=t0_ns)
+sp.note_payload(0, {"sent_unix_ns": t1_ns, "anchor_unix_ns": 1,
+                    "metrics": m1, "spans": []}, recv_unix_ns=t1_ns)
+srv = plane.StatusServer(sp, port=0)
+srv.start()
+try:
+    url = "http://127.0.0.1:%d/goodput" % srv.port
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        body = json.loads(resp.read())
+finally:
+    srv.close()
+att = body["ranks"]["0"]
+if att["binding"] != "device_step":
+    sys.exit("ci_checks: /goodput binding = %r, want device_step "
+             "(the throttle rides the consume span)" % att["binding"])
+if att["counters"]["rows"] != ROWS * EPOCHS:
+    sys.exit("ci_checks: /goodput rows = %r" % att["counters"]["rows"])
+if not body["job"] or body["job"]["binding"] != "device_step":
+    sys.exit("ci_checks: job roll-up missing or wrong: %r" % body["job"])
+flight.reset()
+shutil.rmtree(workdir, ignore_errors=True)
+print("ci_checks: watchdog smoke OK "
+      "(collapse fired once, dumped; /goodput names device_step)")
+EOF
+
 echo "ci_checks: all checks passed"

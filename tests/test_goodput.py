@@ -1,0 +1,418 @@
+"""Runtime goodput ledger + roofline attribution (obs/goodput.py) and
+the in-run SLO watchdog (obs/watchdog.py).
+
+Attribution correctness is pinned in BOTH throttle directions — a
+throttled parser must name ``parse`` binding, a throttled device step
+must name ``device_step`` — and the same verdict must render through
+every surface (``/goodput``, obs-top, obs-report --attribution) because
+they share one code path. The watchdog's fire-once/re-arm hysteresis
+and the ``DMLC_TPU_METRICS=0`` zero-allocation collapse are pinned the
+same way the flow-id disabled path is in test_obs.py.
+"""
+
+import gc
+import json
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import obs
+from dmlc_tpu.obs import flight, goodput, plane
+from dmlc_tpu.obs.metrics import NOOP, Registry
+from dmlc_tpu.obs.watchdog import Watchdog, make_watchdog
+from dmlc_tpu.tools import obs_report, obs_top
+
+
+def _observe(reg, parse_ns=0, h2d_ns=0, wait_ns=0, consume_ns=0,
+             coll_ns=0, rows=0, h2d_bytes=0, steps=0, epoch_ns=0):
+    """Plant one window's worth of stage timings/counters on ``reg``
+    under the exact metric names the runtime records."""
+    fams = (("dmlc_feed_host_batch_ns", parse_ns),
+            ("dmlc_feed_dispatch_ns", h2d_ns),
+            ("dmlc_feed_host_wait_ns", wait_ns),
+            ("dmlc_feed_consume_ns", consume_ns),
+            ("dmlc_collective_op_ns", coll_ns),
+            ("dmlc_fit_epoch_ns", epoch_ns))
+    for name, v in fams:
+        if v:
+            reg.histogram(name, feed="t").observe(v)
+    if rows:
+        reg.counter("dmlc_feed_rows_total", feed="t").inc(rows)
+    if h2d_bytes:
+        reg.counter("dmlc_feed_h2d_bytes_total").inc(h2d_bytes)
+    if steps:
+        reg.counter("dmlc_fit_steps_total", model="t").inc(steps)
+
+
+class TestAttribution:
+    def test_throttled_parse_names_parse(self):
+        reg = Registry()
+        led = goodput.GoodputLedger(reg)
+        _observe(reg, parse_ns=int(7e9), wait_ns=int(1e9),
+                 consume_ns=int(0.5e9), rows=1000, h2d_bytes=10_000_000,
+                 steps=10)
+        att = led.tick(wall_ns=int(10e9))
+        assert att["binding"] == "parse"
+        # parse score folds in the consumer's wait on the host
+        assert att["budget_s"]["parse"] == pytest.approx(7.0)
+        assert att["budget_s"]["host_wait"] == pytest.approx(1.0)
+        assert att["goodput"]["rows_s"] == pytest.approx(100.0)
+        assert att["goodput"]["mbps"] == pytest.approx(1.0)
+        # goodput = device-side useful fraction, so a parse-bound
+        # window reports LOW goodput
+        assert att["goodput"]["ratio"] == pytest.approx(0.05)
+        assert led.windows[-1] is att
+        assert reg.gauge("dmlc_goodput_ratio_value").value == \
+            pytest.approx(0.05)
+
+    def test_throttled_step_names_device_step(self):
+        reg = Registry()
+        led = goodput.GoodputLedger(reg)
+        _observe(reg, parse_ns=int(0.5e9), consume_ns=int(8e9),
+                 h2d_ns=int(0.5e9), rows=1000, h2d_bytes=10_000_000,
+                 steps=10)
+        att = led.tick(wall_ns=int(10e9))
+        assert att["binding"] == "device_step"
+        assert att["goodput"]["ratio"] == pytest.approx(0.85)
+
+    def test_windowed_deltas_not_totals(self):
+        reg = Registry()
+        led = goodput.GoodputLedger(reg)
+        _observe(reg, parse_ns=int(8e9))
+        assert led.tick(wall_ns=int(10e9))["binding"] == "parse"
+        # next window: only the NEW consume time counts, not the old
+        # parse total still sitting in the registry
+        _observe(reg, consume_ns=int(8e9))
+        assert led.tick(wall_ns=int(10e9))["binding"] == "device_step"
+
+    def test_gbdt_epoch_fallback_books_device_step(self):
+        att = goodput.attribute(
+            {"dmlc_fit_epoch_ns:sum": 8e9}, wall_s=10.0)
+        assert att["budget_s"]["device_step"] == pytest.approx(8.0)
+        assert att["binding"] == "device_step"
+
+    def test_idle_binding_and_empty_window(self):
+        att = goodput.attribute({}, wall_s=5.0)
+        assert att["binding"] == "idle"
+        assert att["budget_s"]["idle"] == pytest.approx(5.0)
+        assert att["goodput"]["ratio"] == 0.0
+
+    def test_roofline_utilization_and_at_roof(self):
+        delta = {"dmlc_feed_host_batch_ns:sum": 8e9,
+                 "dmlc_feed_h2d_bytes_total": 800e6}
+        att = goodput.attribute(delta, wall_s=10.0,
+                                ceilings={"parse_mbps": 110.0})
+        roof = att["roofline"]["parse"]
+        assert roof["achieved_mbps"] == pytest.approx(100.0)
+        assert roof["utilization"] == pytest.approx(100.0 / 110.0,
+                                                    abs=1e-4)
+        assert att["binding"] == "parse" and att["at_roof"] is True
+        # unknown ceiling (0) reports utilization None, never infinity
+        att2 = goodput.attribute(delta, wall_s=10.0,
+                                 ceilings={"parse_mbps": 0.0})
+        assert att2["roofline"]["parse"]["utilization"] is None
+        assert att2["at_roof"] is False
+
+    def test_counter_reset_clamps_to_zero(self):
+        delta = goodput.flat_delta({"dmlc_feed_rows_total": 5.0},
+                                   {"dmlc_feed_rows_total": 100.0})
+        assert delta["dmlc_feed_rows_total"] == 0.0
+
+    def test_rolled_job_view_rederives_binding(self):
+        r0 = goodput.attribute({"dmlc_feed_host_batch_ns:sum": 6e9,
+                                "dmlc_feed_rows_total": 100.0},
+                               wall_s=10.0)
+        r1 = goodput.attribute({"dmlc_feed_consume_ns:sum": 2e9,
+                                "dmlc_feed_rows_total": 100.0},
+                               wall_s=10.0)
+        r1["straggler_rank"] = 1
+        job = goodput.rolled([r0, r1])
+        assert job["ranks"] == 2
+        assert job["binding"] == "parse"  # 6s parse > 2s step summed
+        assert job["counters"]["rows"] == pytest.approx(200.0)
+        assert job["window_s"] == pytest.approx(10.0)
+        assert job["straggler_rank"] == 1
+        assert goodput.rolled([]) is None
+
+    def test_format_attribution_marks_binding(self):
+        att = goodput.attribute({"dmlc_feed_host_batch_ns:sum": 8e9},
+                                wall_s=10.0)
+        text = goodput.format_attribution(att, label="rank 0")
+        lines = text.splitlines()
+        assert lines[0].startswith("rank 0: binding=parse")
+        marked = [ln for ln in lines if "<- binding" in ln]
+        assert len(marked) == 1 and marked[0].startswith("parse")
+
+    def test_ledger_steps_fallback_when_registry_lags(self):
+        reg = Registry()
+        led = goodput.GoodputLedger(reg)
+        led.note_step(7)
+        att = led.tick(wall_ns=int(1e9))
+        assert att["counters"]["steps"] == 7.0
+
+
+class TestFeedThrottleIntegration:
+    """The two throttle directions through a REAL DeviceFeed, and the
+    same verdict through every rendering surface."""
+
+    def _split(self, tmp_path):
+        from dmlc_tpu.io.input_split import create_input_split
+
+        rng = np.random.RandomState(0)
+        lines = []
+        for i in range(600):
+            ids = np.sort(rng.choice(40, size=1 + i % 7, replace=False))
+            feats = " ".join("%d:%.6f" % (j, rng.rand()) for j in ids)
+            lines.append("%d %s" % (i % 2, feats))
+        path = tmp_path / "t.svm"
+        path.write_text("\n".join(lines) + "\n")
+        return create_input_split(str(path), 0, 1, "text", threaded=False)
+
+    def _run(self, tmp_path, parser_delay=0.0, consume_delay=0.0):
+        from dmlc_tpu.data.parsers import LibSVMParser
+        from dmlc_tpu.device.feed import BatchSpec, DeviceFeed
+
+        class SlowChunks:
+            """Parser proxy that throttles host production (the sleep
+            lands inside the feed's host_batch span)."""
+
+            supports_batch_fetch = False
+
+            def __init__(self, parser, delay):
+                self._parser = parser
+                self._delay = delay
+
+            def __getattr__(self, name):
+                return getattr(self._parser, name)
+
+            def __iter__(self):
+                for block in self._parser:
+                    if self._delay:
+                        time.sleep(self._delay)
+                    yield block
+
+        parser = SlowChunks(LibSVMParser(self._split(tmp_path), nthread=1),
+                            parser_delay)
+        spec = BatchSpec(batch_size=128, layout="dense", num_features=40)
+        feed = DeviceFeed(parser, spec)
+        led = goodput.GoodputLedger()  # global registry, like the runtime
+        for batch in feed:
+            np.asarray(batch["label"])
+            if consume_delay:
+                time.sleep(consume_delay)
+            led.note_step()
+        att = led.tick()
+        feed.close()
+        return att
+
+    def test_throttled_parser_names_parse_everywhere(self, tmp_path,
+                                                     capsys):
+        att = self._run(tmp_path, parser_delay=0.05)
+        assert att["binding"] == "parse"
+        assert att["counters"]["rows"] == pytest.approx(600.0)
+        # the SAME dict renders through every surface
+        view = {"ranks": {"0": att}, "job": goodput.rolled([att])}
+        rows, _ = obs_top.build_rows("", {"workers": {"0": {}}},
+                                     goodput_obj=view)
+        table = obs_top.render_table(rows)
+        assert "binding" in table.splitlines()[0]
+        assert "parse" in table
+        assert obs_report._report_attribution(view) is True
+        out = capsys.readouterr().out
+        assert "rank 0: binding=parse" in out
+        assert "job: binding=parse" in out
+
+    def test_throttled_consumer_names_device_step(self, tmp_path):
+        att = self._run(tmp_path, consume_delay=0.02)
+        assert att["binding"] == "device_step"
+
+
+def _win(rows_s=0.0, mbps=0.0, ratio=0.5, recompiles=0, steps=1,
+         nbytes=0, window_s=10.0, straggler=-1, binding="parse"):
+    return {
+        "window_s": window_s,
+        "goodput": {"rows_s": rows_s, "mbps": mbps, "ratio": ratio},
+        "counters": {"recompiles": float(recompiles),
+                     "steps": float(steps), "batches": 0.0,
+                     "bytes": float(nbytes)},
+        "straggler_rank": straggler,
+        "binding": binding,
+    }
+
+
+class TestWatchdog:
+    def test_collapse_fires_once_and_rearms(self, tmp_path):
+        rec = flight.configure(str(tmp_path), capacity=32, rank=0,
+                               install=False)
+        try:
+            reg = Registry()
+            wd = Watchdog(reg=reg, stall_s=0)
+            for v in (1000.0, 1005.0, 995.0):
+                assert wd.observe(_win(rows_s=v)) == []
+            # scripted collapse: detected on its FIRST window (well
+            # inside the 3-window acceptance bound), then silent while
+            # the collapse persists
+            fired = wd.observe(_win(rows_s=10.0))
+            assert [a["kind"] for a in fired] == ["collapse"]
+            assert fired[0]["baseline"] == pytest.approx(1000.0)
+            for _ in range(3):
+                assert wd.observe(_win(rows_s=10.0)) == []
+            counter = reg.counter("dmlc_watchdog_alerts_total",
+                                  kind="collapse")
+            assert counter.value == 1
+            events = [r for r in rec.records()
+                      if r["kind"] == "watchdog.alert"]
+            assert len(events) == 1
+            # recovery re-arms; a second excursion fires a second alert
+            assert wd.observe(_win(rows_s=1000.0)) == []
+            fired = wd.observe(_win(rows_s=10.0))
+            assert [a["kind"] for a in fired] == ["collapse"]
+            assert counter.value == 2
+        finally:
+            flight.reset()
+
+    def test_collapsed_windows_stay_out_of_baseline(self):
+        wd = Watchdog(reg=Registry(), stall_s=0)
+        for v in (1000.0, 1000.0, 1000.0):
+            wd.observe(_win(rows_s=v))
+        for _ in range(10):
+            wd.observe(_win(rows_s=10.0))
+        # the band never eroded toward 10: history is still healthy
+        assert min(wd._signal_hist) == pytest.approx(1000.0)
+
+    def test_mbps_signal_when_rows_unavailable(self):
+        wd = Watchdog(reg=Registry(), stall_s=0)
+        for v in (500.0, 500.0):
+            wd.observe(_win(mbps=v))
+        fired = wd.observe(_win(mbps=5.0))
+        assert [a["kind"] for a in fired] == ["collapse"]
+
+    def test_recompile_storm_and_straggler(self):
+        wd = Watchdog(reg=Registry(), stall_s=0)
+        fired = wd.observe(_win(recompiles=5, straggler=2))
+        kinds = sorted(a["kind"] for a in fired)
+        assert kinds == ["recompile_storm", "straggler"]
+        assert wd.observe(_win(recompiles=5, straggler=2)) == []
+        # both clear, both re-arm
+        assert wd.observe(_win()) == []
+        fired = wd.observe(_win(recompiles=5, straggler=2))
+        assert sorted(a["kind"] for a in fired) == kinds
+
+    def test_stall_accumulates_across_windows(self):
+        wd = Watchdog(reg=Registry(), stall_s=50.0)
+        assert wd.observe(_win(steps=0, window_s=30.0)) == []
+        fired = wd.observe(_win(steps=0, window_s=30.0))
+        assert [a["kind"] for a in fired] == ["stall"]
+        assert fired[0]["stalled_s"] == pytest.approx(60.0)
+        # progress resets the clock and re-arms
+        assert wd.observe(_win(steps=3)) == []
+        assert wd._stalled_s == 0.0
+
+    def test_profile_capture_on_fire(self, monkeypatch):
+        from dmlc_tpu.obs import device_telemetry
+
+        calls = []
+        monkeypatch.setattr(device_telemetry, "capture_profile",
+                            lambda seconds: calls.append(seconds))
+        wd = Watchdog(reg=Registry(), stall_s=0, profile=True,
+                      profile_seconds=1.5)
+        wd.observe(_win(recompiles=9))
+        assert calls == [1.5]
+
+
+class TestPlaneGoodput:
+    def _two_heartbeats(self, sp):
+        t0 = time.time_ns()
+        m0 = {"dmlc_feed_consume_ns:sum": 0.1e9,
+              "dmlc_feed_rows_total": 100.0}
+        sp.note_payload(0, {"sent_unix_ns": t0, "anchor_unix_ns": 1,
+                            "metrics": m0, "spans": []},
+                        recv_unix_ns=t0)
+        m1 = {"dmlc_feed_consume_ns:sum": 1.7e9,
+              "dmlc_feed_rows_total": 3300.0,
+              'dmlc_fit_steps_total{model="linear"}': 25.0}
+        sp.note_payload(0, {"sent_unix_ns": t0 + 2_000_000_000,
+                            "anchor_unix_ns": 1, "metrics": m1,
+                            "spans": []},
+                        recv_unix_ns=t0 + 2_000_000_000)
+        return m0, m1
+
+    def test_goodput_view_matches_attribute(self):
+        sp = plane.StatusPlane(num_workers=1, heartbeat_gap=60.0)
+        assert sp.goodput_view() == {"ranks": {}, "job": None}
+        m0, m1 = self._two_heartbeats(sp)
+        view = sp.goodput_view()
+        att = view["ranks"]["0"]
+        # one code path: the plane's verdict IS goodput.attribute over
+        # the same heartbeat delta
+        expected = goodput.attribute(goodput.flat_delta(m1, m0), 2.0,
+                                     current=m1)
+        assert att["binding"] == expected["binding"] == "device_step"
+        assert att["counters"]["rows"] == pytest.approx(3200.0)
+        assert att["goodput"]["rows_s"] == pytest.approx(1600.0)
+        assert view["job"]["ranks"] == 1
+        assert view["job"]["binding"] == "device_step"
+
+    def test_goodput_endpoint_served(self):
+        sp = plane.StatusPlane(num_workers=1, heartbeat_gap=60.0)
+        self._two_heartbeats(sp)
+        srv = plane.StatusServer(sp, port=0)
+        srv.start()
+        try:
+            url = "http://127.0.0.1:%d/goodput" % srv.port
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read())
+            assert body["ranks"]["0"]["binding"] == "device_step"
+            assert body["job"]["binding"] == "device_step"
+        finally:
+            srv.close()
+
+    def test_obs_top_layout_unchanged_without_goodput(self):
+        workers = {"workers": {"0": {}}}
+        rows, _ = obs_top.build_rows("", workers)
+        header = obs_top.render_table(rows).splitlines()[0]
+        assert "goodput" not in header and "binding" not in header
+
+
+class TestMetricsDisabled:
+    def test_factories_collapse_to_shared_noop(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_METRICS", "0")
+        led = goodput.ledger()
+        wd = make_watchdog()
+        assert led is NOOP and wd is NOOP
+        led.note_step()
+        assert led.tick() is None
+        assert led.windows == ()
+        assert wd.alerts == ()
+
+    def test_disabled_hot_path_allocation_free(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_METRICS", "0")
+        led = goodput.ledger()
+        wd = make_watchdog()
+
+        def burst(n=2000):
+            for _ in range(n):
+                led.note_step()
+                wd.observe(None)
+
+        burst()  # warm caches before measuring
+        deltas = []
+        for _ in range(5):
+            gc.collect()
+            before = sys.getallocatedblocks()
+            burst()
+            gc.collect()
+            deltas.append(sys.getallocatedblocks() - before)
+        assert min(deltas) <= 0
+
+    def test_fit_loop_obs_runs_disabled(self, monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_METRICS", "0")
+        from dmlc_tpu.models.fitloop import FitLoopObs
+
+        fl = FitLoopObs("t")
+        fl.note_step()
+        assert fl.end_epoch(0, 1, time.monotonic_ns(), 0.5) is None

@@ -85,6 +85,53 @@ class TestGateMath:
         assert sentry.lower_is_better("stall.host_wait_s")
         assert not sentry.lower_is_better("recordio_ingest_mbps")
 
+    def test_direction_registry_gates_unsuffixed_keys(self, tmp_path):
+        # sgd_goodput_ratio has no throughput suffix: invisible to the
+        # gate until the record's directions map names it
+        rec = {"metric": "x_ingest", "value": 100.0,
+               "extra": {"sgd_goodput_ratio": 0.4}}
+        assert "sgd_goodput_ratio" not in sentry.record_values(rec)
+        rec["directions"] = {"sgd_goodput_ratio": "higher"}
+        vals = sentry.record_values(rec)
+        assert vals["sgd_goodput_ratio"] == 0.4
+
+        directions = sentry.record_directions([rec])
+        assert directions == {"sgd_goodput_ratio": "higher"}
+        assert not sentry.lower_is_better("sgd_goodput_ratio", directions)
+        assert sentry.lower_is_better("q_s", {"q_s": "lower"})
+        # the map overrides the prefix rules, both ways
+        assert not sentry.lower_is_better("stall.x_s",
+                                          {"stall.x_s": "higher"})
+
+        # a goodput-ratio collapse now trips the gate, direction "higher"
+        series = {"sgd_goodput_ratio": [0.9, 0.88, 0.92]}
+        regs = sentry.gate({"sgd_goodput_ratio": 0.4}, series,
+                           directions=directions)
+        assert [r["metric"] for r in regs] == ["sgd_goodput_ratio"]
+        assert regs[0]["direction"] == "higher"
+        # and an "improvement" in a lower-is-better mapped key passes
+        assert sentry.gate({"sgd_goodput_ratio": 0.4}, series,
+                           directions={"sgd_goodput_ratio": "lower"}) == []
+
+    def test_bench_gate_cli_threads_directions(self, tmp_path, capsys):
+        base = {"metric": "x_ingest", "value": 100.0,
+                "directions": {"sgd_goodput_ratio": "higher"}}
+        hist_paths = []
+        for i, ratio in enumerate((0.9, 0.88, 0.92)):
+            p = tmp_path / f"BENCH_r{i}.json"
+            p.write_text(json.dumps(
+                {**base, "extra": {"sgd_goodput_ratio": ratio}}))
+            hist_paths.append(str(p))
+        fresh = tmp_path / "detail.json"
+        fresh.write_text(json.dumps(
+            {**base, "extra": {"sgd_goodput_ratio": 0.4}}))
+        rc = bench_gate.main(
+            ["--fresh", str(fresh),
+             "--history", os.path.join(str(tmp_path), "BENCH_r*.json")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "sgd_goodput_ratio" in out
+
 
 class TestLoadRecords:
     def test_null_parsed_round_yields_no_record(self):
