@@ -33,17 +33,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu import obs
-from dmlc_tpu.obs import device_telemetry
+from dmlc_tpu.obs import device_telemetry, flight
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
 from dmlc_tpu.data.row_block import RowBlockContainer
 from dmlc_tpu.device.csr import (
     DeviceCSRBatch,
     ShardedCSRBatch,
     block_to_dense,
+    emit_to_bucket,
     pad_to_bucket,
     pad_to_bucket_sharded,
 )
-from dmlc_tpu.params.knobs import default_host_prefetch, default_prefetch
+from dmlc_tpu.params.knobs import (
+    default_host_prefetch,
+    default_prefetch,
+    device_resident,
+)
 from dmlc_tpu.utils.logging import check
 from dmlc_tpu.utils.threaded_iter import ThreadedIter
 
@@ -109,6 +114,18 @@ class BatchSpec:
     prefetch: Optional[int] = None
 
 
+@dataclass
+class _ResidentDense:
+    """A dense batch already scattered into pooled staging by the
+    device-resident producer (``RowBlockContainer.emit_dense_into``) —
+    carries its staging arrays so ``_to_device`` can retire them."""
+
+    x: np.ndarray  # [batch, num_features] f32
+    labels: np.ndarray  # [batch] f32
+    weights: np.ndarray  # [batch] f32 (0.0 for padded rows)
+    num_rows: int
+
+
 def _transfer_done(arr) -> bool:
     """True once ``arr``'s async H2D copy no longer reads its host source
     (jax.Array.is_ready without blocking; absent API → assume in flight)."""
@@ -151,6 +168,12 @@ class FixedShapePool:
     # beyond this depth so a readiness-API-less runtime degrades to plain
     # allocation, not a leak
     MAX_RETIRED = 32
+    # leak sentinel: every this many acquires, compare the outstanding
+    # buffer count (handed out, not yet returned) against its previous
+    # high-water mark; this many CONSECUTIVE new highs means a consumer
+    # is acquiring without ever retiring — a staging leak, not churn
+    LEAK_CHECK_EVERY = 64
+    LEAK_STRIKES = 4
 
     def __init__(self, recycle: bool = True):
         self.recycle = recycle
@@ -168,7 +191,17 @@ class FixedShapePool:
         # (stats(), tests, bench) stays truthful under DMLC_TPU_METRICS=0
         self.allocated = 0
         self.reused = 0
+        self.retired = 0  # buffers accepted back through retire()
+        self.double_retired = 0  # duplicate retire() offers rejected
         self._shapes: set = set()
+        # id()s of buffers currently owned by the pool (_free/_retired):
+        # a second retire() of one of these would hand the same memory to
+        # two future acquirers — the guard drops the duplicate instead
+        self._pooled_ids: set = set()
+        self._acquires = 0
+        self._leak_high = 0
+        self._leak_strikes = 0
+        self._leak_reported = False
 
     @staticmethod
     def _key(shape, dtype):
@@ -184,24 +217,77 @@ class FixedShapePool:
         key = self._key(shape, dtype)
         self._shapes.add(key)
         if self.recycle:
+            self._acquires += 1
+            if self._acquires % self.LEAK_CHECK_EVERY == 0:
+                self._leak_check()
             self._drain()
             free = self._free.get(key)
             if free:
+                buf = free.pop()
+                self._pooled_ids.discard(id(buf))
                 self.reused += 1
                 self._m_reused.inc()
-                return free.pop()
+                return buf
         self.allocated += 1
         self._m_allocated.inc()
         return np.empty(key[0], dtype=dtype)
 
+    @property
+    def outstanding(self) -> int:
+        """Buffers handed out (allocated + reused) and not yet returned
+        through :meth:`retire` — the quantity the leak sentinel watches."""
+        return (self.allocated + self.reused) - self.retired
+
     def retire(self, bufs, guards) -> None:
         """Offer a delivered batch's staging buffers back, guarded by the
-        device arrays their transfer produced."""
+        device arrays their transfer produced. A buffer the pool already
+        holds (double-retire — two delivery paths returning one batch) is
+        dropped rather than queued twice: queuing it again would hand the
+        same memory to two future acquirers and silently corrupt an
+        in-flight batch."""
         if not self.recycle:
             return
-        self._retired.append((list(bufs), list(guards)))
+        accepted = []
+        for buf in bufs:
+            bid = id(buf)
+            if bid in self._pooled_ids:
+                self.double_retired += 1
+                continue
+            self._pooled_ids.add(bid)
+            accepted.append(buf)
+        if not accepted:
+            return
+        self.retired += len(accepted)
+        self._retired.append((accepted, list(guards)))
         while len(self._retired) > self.MAX_RETIRED:
-            self._retired.popleft()  # degrade to allocation, never leak
+            # degrade to allocation, never leak; the dropped buffers are
+            # GC'd, so forget their ids (id() values can be recycled)
+            dropped, _ = self._retired.popleft()
+            for buf in dropped:
+                self._pooled_ids.discard(id(buf))
+
+    def _leak_check(self) -> None:
+        """Fire one ``pool.leak`` flight event when the outstanding buffer
+        count keeps making new highs — acquires without matching retires
+        grow host memory linearly with the fit and this is the earliest
+        observable signature."""
+        if self._leak_reported:
+            return
+        out = self.outstanding
+        if out > self._leak_high:
+            self._leak_high = out
+            self._leak_strikes += 1
+            if self._leak_strikes >= self.LEAK_STRIKES:
+                self._leak_reported = True
+                flight.record_event(
+                    "pool.leak",
+                    outstanding=out,
+                    allocated=self.allocated,
+                    reused=self.reused,
+                    retired=self.retired,
+                )
+        else:
+            self._leak_strikes = 0
 
     def _drain(self) -> None:
         # strictly oldest-first: a younger batch ready before an older one
@@ -222,6 +308,9 @@ class FixedShapePool:
             "shapes": len(self._shapes),
             "allocated": self.allocated,
             "reused": self.reused,
+            "retired": self.retired,
+            "double_retired": self.double_retired,
+            "outstanding": self.outstanding,
             "pending_retire": len(self._retired),
         }
 
@@ -354,6 +443,24 @@ class DeviceFeed:
         self._m_rows = reg.counter(
             "dmlc_feed_rows_total", "examples delivered to device",
             feed=fid)
+        # device_put calls per feed: the sentry gates this against the
+        # batch count — per-array dispatch regressions (N calls where one
+        # pytree put would do) surface as dispatches/batch > 1
+        self._m_dispatches = reg.counter(
+            "dmlc_feed_h2d_dispatches_total",
+            "device_put dispatch calls (one per batched pytree put; "
+            "per-array regressions show up as dispatches/batch > 1)",
+            feed=fid)
+        # device-resident fast path (DMLC_TPU_DEVICE_RESIDENT): parsed
+        # RowBlock parts emit straight into pooled staging (pad-in-place,
+        # device/csr.emit_to_bucket) instead of materialize+pad — python
+        # re-batch paths only (the native pipeline already stages without
+        # container copies; sharded csr keeps its partition path)
+        self._resident = (
+            device_resident()
+            and spec.layout in ("dense", "csr")
+            and self._shards == 1
+        )
         # H2D accounting around _put_tree: None when device telemetry is
         # off, and then the dispatch path has no byte walk and no timer.
         self._h2d = device_telemetry.h2d_meter(feed=fid)
@@ -407,11 +514,12 @@ class DeviceFeed:
     def _host_batches(self) -> Iterator:
         from dmlc_tpu.resilience import faultpoint
 
-        producer = (
-            self._host_batches_native()
-            if self._use_native_batches()
-            else self._host_batches_python()
-        )
+        if self._use_native_batches():
+            producer = self._host_batches_native()
+        elif self._resident:
+            producer = self._host_batches_resident()
+        else:
+            producer = self._host_batches_python()
         while True:
             faultpoint("device.feed")
             t0 = time.monotonic_ns()
@@ -469,6 +577,85 @@ class DeviceFeed:
             # chunks whose rows only ever reached a dropped remainder (or
             # an empty chunk) still count as visited — ack them here or
             # the dispatcher would requeue them forever
+            for sid in seqs:
+                self._ack_seq(sid)
+
+    def _emit_resident(self, pending, flows, seqs):
+        """Finalize one accumulated container straight into pooled
+        staging — the device-resident single copy (no ``to_block``
+        concatenate, no second pad copy)."""
+        spec = self.spec
+        with obs.span("stage", rows=len(pending)):
+            for fid in flows:
+                obs.flow_step(fid, "chunk")
+            if spec.layout == "csr":
+                batch = emit_to_bucket(
+                    pending, spec.batch_size, nnz_bucket=spec.nnz_bucket,
+                    pool=self.pool,
+                )
+                batch.staging_bufs = (
+                    batch.labels, batch.weights, batch.indices,
+                    batch.values, batch.offsets,
+                )
+            else:
+                x = self.pool.acquire(
+                    (spec.batch_size, spec.num_features), np.float32)
+                x.fill(0)  # the scatter only writes present entries
+                labels = self.pool.acquire(spec.batch_size, np.float32)
+                weights = self.pool.acquire(spec.batch_size, np.float32)
+                n = pending.emit_dense_into(x, labels, weights)
+                labels[n:] = 0.0
+                weights[n:] = 0.0
+                batch = _ResidentDense(
+                    x=x, labels=labels, weights=weights, num_rows=n)
+        if flows:
+            batch.flow_ids = tuple(flows)
+        if seqs:
+            batch.seq_ids = tuple(seqs)
+        return batch
+
+    def _host_batches_resident(self) -> Iterator:
+        """The device-resident re-batch producer: parser blocks are
+        split at batch boundaries with zero-copy ``slice()`` views into
+        an accumulating container, and each full batch is emitted
+        directly into ``FixedShapePool`` staging via the pad-in-place
+        path (``RowBlockContainer.emit_csr_into`` /
+        ``emit_dense_into``). vs ``_host_batches_python``: the
+        ``to_block()`` concatenate copy and the separate pad copy fuse
+        into ONE write that lands where ``device_put`` reads."""
+        spec = self.spec
+        bs = spec.batch_size
+        if spec.layout == "dense":
+            check(spec.num_features > 0, "dense layout requires num_features")
+        pending = RowBlockContainer()
+        flows = []
+        seqs = []
+        for block in self._parser:
+            fid = getattr(block, "flow_id", 0)
+            if fid:
+                flows.append(fid)
+            sid = getattr(block, "seq_id", None)
+            if sid is not None:
+                seqs.append(sid)
+            start = 0
+            n = len(block)
+            while len(pending) + (n - start) >= bs:
+                take = bs - len(pending)
+                if take:
+                    pending.push_block(block.slice(start, start + take))
+                    start += take
+                yield self._emit_resident(pending, flows, seqs)
+                pending = RowBlockContainer()
+                flows = []
+                seqs = []
+            if start < n:
+                pending.push_block(block.slice(start, n))
+        if len(pending) and not spec.drop_remainder:
+            yield self._emit_resident(pending, flows, seqs)
+            seqs = []
+        if seqs and self._ack is not None:
+            # chunks whose rows only reached a dropped remainder still
+            # count as visited (see _host_batches_python)
             for sid in seqs:
                 self._ack_seq(sid)
 
@@ -534,17 +721,86 @@ class DeviceFeed:
                 # IS the async H2D overlap, so only cpu skips.
                 # DMLC_TPU_FEED_PUT=1 restores the put for A/B.
                 return arrays
+            self._m_dispatches.inc()
             return jax.device_put(arrays)
         if jax.process_count() > 1:
-            # multi-host assembly is per-array by API shape
-            return {
-                k: jax.make_array_from_process_local_data(
-                    self._sharding(specs[k]), v
+            return self._put_tree_multihost(arrays, specs)
+        shardings = {k: self._sharding(specs[k]) for k in arrays}
+        self._m_dispatches.inc()
+        return jax.device_put(arrays, shardings)
+
+    def _global_shape(self, arr, spec: P) -> tuple:
+        """Global shape of ``arr`` under ``spec``: the leading dim sharded
+        over the mesh axis multiplies by total/local shard sections
+        (each process contributes ``self._shards`` contiguous sections);
+        replicated arrays keep their local shape."""
+        if len(spec) and spec[0] == self._axis:
+            total = self._mesh.shape[self._axis]
+            return (arr.shape[0] * (total // self._shards),) + arr.shape[1:]
+        return arr.shape
+
+    def _put_tree_multihost(self, arrays: dict, specs: dict) -> dict:
+        """Multi-host assembly through ONE batched ``device_put``.
+
+        ``jax.make_array_from_process_local_data`` is per-array by API
+        shape — N dispatch round trips per batch (the overhead the
+        ``dmlc_feed_h2d_dispatches_total``/batch ratio gates). Instead:
+        compute each array's global shape, slice this process's
+        addressable per-device shards as host views
+        (``addressable_devices_indices_map`` rebased by the local block's
+        global offset), ship every shard of every array through one
+        batched ``device_put``, and assemble the global arrays with
+        ``make_array_from_single_device_arrays`` — metadata only, no
+        further transfer."""
+        shardings = {k: self._sharding(specs[k]) for k in arrays}
+        try:
+            views, devs, plans = [], [], []
+            for k, v in arrays.items():
+                sh = shardings[k]
+                gshape = self._global_shape(v, specs[k])
+                ndim = len(gshape)
+                idx_map = sh.addressable_devices_indices_map(gshape)
+                devices = list(idx_map)
+                norm = {
+                    d: tuple(idx_map[d]) + (slice(None),) * (
+                        ndim - len(idx_map[d]))
+                    for d in devices
+                }
+                # this process's local block is contiguous in global
+                # coords: its offset per dim is the min start over the
+                # process's own shards
+                offs = [
+                    min((norm[d][dim].start or 0) for d in devices)
+                    for dim in range(ndim)
+                ]
+                for d in devices:
+                    local = tuple(
+                        slice(
+                            (s.start or 0) - off,
+                            (s.stop if s.stop is not None else size) - off,
+                        )
+                        for s, off, size in zip(norm[d], offs, gshape)
+                    )
+                    views.append(v[local])
+                    devs.append(d)
+                plans.append((k, gshape, sh, len(devices)))
+            self._m_dispatches.inc()
+            shards = jax.device_put(views, devs)
+            out, pos = {}, 0
+            for k, gshape, sh, n in plans:
+                out[k] = jax.make_array_from_single_device_arrays(
+                    gshape, sh, list(shards[pos: pos + n])
                 )
+                pos += n
+            return out
+        except Exception:  # noqa: BLE001 — exotic sharding/runtime: keep
+            # feeding through the per-array path rather than kill the fit
+            # (the dispatch counter records the N-call cost honestly)
+            self._m_dispatches.inc(len(arrays))
+            return {
+                k: jax.make_array_from_process_local_data(shardings[k], v)
                 for k, v in arrays.items()
             }
-        shardings = {k: self._sharding(specs[k]) for k in arrays}
-        return jax.device_put(arrays, shardings)
 
     def _to_device(self, block, flows=()):
         """→ (device batch, staging buffers to retire — () when the host
@@ -563,7 +819,18 @@ class DeviceFeed:
             out["num_rows"] = rows
             return out, ()
         if isinstance(block, (DeviceCSRBatch, ShardedCSRBatch)):
-            return self._put_csr(block), ()  # native COO batch, pre-padded
+            # native COO batch (no staging to retire) or the resident
+            # emit path (its pooled staging rides along for retire)
+            return self._put_csr(block), getattr(block, "staging_bufs", ())
+        if isinstance(block, _ResidentDense):
+            out = self._put_tree(
+                {"x": block.x, "label": block.labels,
+                 "weight": block.weights},
+                {"x": P(self._axis), "label": P(self._axis),
+                 "weight": P(self._axis)},
+            )
+            out["num_rows"] = block.num_rows
+            return out, (block.x, block.labels, block.weights)
         if spec.layout == "dense":
             check(spec.num_features > 0, "dense layout requires num_features")
             with obs.span("stage", rows=len(block)):

@@ -22,7 +22,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dmlc_tpu.utils.jax_compat import shard_map
 
 from dmlc_tpu.collective.device import bucketed_psum
-from dmlc_tpu.models.linear import _margin_grad, step_batch
+from dmlc_tpu.models.linear import (
+    _margin_grad,
+    _suppress_donation_warnings,
+    step_batch,
+)
 from dmlc_tpu.obs.device_telemetry import instrumented_jit
 from dmlc_tpu.ops.spmv import expand_row_ids, spmv, spmv_transpose
 from dmlc_tpu.parallel.partition import match_partition_rules, shard_params
@@ -94,10 +98,18 @@ def make_fm_train_step(
     l2: float = 0.0,
     axis: str = "dp",
     param_specs=None,
+    donate_batch: bool = False,
 ):
     """Jitted FM SGD step over COO batches; ONE fused (dtype-bucketed)
     in-graph psum on the mesh — the [F,K] factor grads, [F] linear grads
-    and loss scalars cross ICI as a single contiguous f32 buffer."""
+    and loss scalars cross ICI as a single contiguous f32 buffer.
+
+    ``donate_batch=True`` (single-device path) donates params AND the
+    batch arrays, the same contract as
+    :func:`~dmlc_tpu.models.linear.make_linear_train_step`: XLA reuses
+    the H2D landing buffers and updates the factor table in place —
+    only for streaming callers that rebind params each step and never
+    touch a batch after its step (DeviceFeed loops, FMLearner)."""
     check(num_features > 0, "num_features required")
 
     def _apply(params, gw, gb, gv, wsum):
@@ -117,7 +129,11 @@ def make_fm_train_step(
             params = _apply(params, gw, gb, gv, wsum)
             return params, {"loss_sum": loss_sum, "weight_sum": wsum}
 
-        return instrumented_jit(step, "fm.step")
+        fn = instrumented_jit(
+            step, "fm.step",
+            donate_argnums=(0, 1) if donate_batch else (),
+        )
+        return _suppress_donation_warnings(fn) if donate_batch else fn
 
     # Entries arrive SHARDED (ShardedCSRBatch: per-shard sections, local
     # row ids) — each device holds only its own nnz; no global mask.
@@ -198,6 +214,9 @@ class FMLearner:
                 objective=self.param.objective,
                 learning_rate=self.param.learning_rate,
                 l2=self.param.l2,
+                # the fit loop rebinds params every step and never touches
+                # a batch after its step — the donation contract holds
+                donate_batch=self.mesh is None,
             )
 
     def reshard(self, mesh: Optional[Mesh] = None) -> None:

@@ -137,23 +137,33 @@ def _suppress_donation_warnings(step):
 
 
 def _resolve_pallas(use_pallas: Optional[bool], layout: str,
-                    objective: str) -> bool:
-    """Validate + default the Pallas fused-kernel switch (env
-    DMLC_TPU_PALLAS=1); shared by the mesh and hostsync step builders."""
+                    objective: str):
+    """Validate + default the Pallas kernel switch (env
+    DMLC_TPU_PALLAS=1); shared by the mesh and hostsync step builders.
+
+    Returns the kernel MODE, not a bare bool: False (off), "dense" (the
+    fused whole-step kernel, dense layout), or "spmv" (the COO
+    segment-sum kernel on the csr margin path; the feature-direction
+    scatter stays on XLA). Truthiness is preserved, so boolean callers
+    keep working."""
     if use_pallas is None:
         import os
 
         use_pallas = os.environ.get("DMLC_TPU_PALLAS", "0") == "1"
-    if use_pallas:
-        from dmlc_tpu.ops import pallas_kernels
-        from dmlc_tpu.ops.objectives import OBJECTIVES
+    if not use_pallas:
+        return False
+    from dmlc_tpu.ops import pallas_kernels
+    from dmlc_tpu.ops.objectives import OBJECTIVES
 
-        check(layout == "dense", "the pallas fused step is dense-only")
+    if layout == "dense":
         check(
             pallas_kernels.available and objective in OBJECTIVES,
             "pallas path unavailable for this configuration",
         )
-    return use_pallas
+        return "dense"
+    check(pallas_kernels.available,
+          "pallas path unavailable for this configuration")
+    return "spmv"
 
 
 def _build_local_grads(objective: str, layout: str, num_features: int,
@@ -191,16 +201,22 @@ def _build_local_grads(objective: str, layout: str, num_features: int,
             row_ids = expand_row_ids(
                 batch["offsets"], batch["values"].shape[0]
             )
-            margin = (
-                spmv(
+            if use_pallas == "spmv":
+                from dmlc_tpu.ops.spmv import spmv_pallas
+
+                margin = spmv_pallas(
+                    batch["values"], batch["indices"], row_ids,
+                    params["w"], label.shape[0],
+                    interpret=pallas_interpret,
+                ) + params["b"]
+            else:
+                margin = spmv(
                     batch["values"],
                     batch["indices"],
                     row_ids,
                     params["w"],
                     label.shape[0],
-                )
-                + params["b"]
-            )
+                ) + params["b"]
         loss, gmargin = _margin_grad(objective, margin, label)
         wg = weight * gmargin
         if layout == "dense":
@@ -269,8 +285,11 @@ def make_linear_train_step(
 
     ``use_pallas`` (default: env DMLC_TPU_PALLAS=1) routes the dense
     gradient core through the fused Pallas kernel
-    (ops/pallas_kernels.fused_linear_grads). Measured at parity with XLA's
-    own fusion on v5e (BASELINE.md) — XLA stays the default.
+    (ops/pallas_kernels.fused_linear_grads); on the csr layout it routes
+    the margin SpMV's row reduce through the COO segment-sum kernel
+    (ops/spmv.spmv_pallas) while the feature-direction scatter stays on
+    XLA. Measured at parity with XLA's own fusion on v5e (BASELINE.md) —
+    XLA stays the default.
 
     ``donate_batch=True`` donates ALL step inputs — params, velocity, and
     the batch arrays: the H2D landing buffers are released to XLA the

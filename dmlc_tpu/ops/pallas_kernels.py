@@ -8,12 +8,21 @@ loss, and the scalar reductions — resident in VMEM per batch tile, with the
 gradient accumulated across grid steps. One HBM read of X per step, no
 intermediate [B] arrays ever round-tripping through HBM.
 
-Why there is NO pallas sparse (COO/segment-sum) kernel: gather/scatter with
-per-entry dynamic indices is exactly what the TPU's vector unit can't tile
-(SURVEY §7 hard parts; ops/spmv.py design note) — XLA's own segment_sum
-lowering is the right tool, and a hand-rolled kernel would serialize. The
-sparse path stays on ops.spmv; dense batches (the HIGGS north star) get the
-fused kernel.
+The sparse (COO) path gets a kernel too, with a narrower scope. Per-entry
+dynamic gather/scatter is exactly what the TPU's vector unit can't tile
+(SURVEY §7 hard parts; ops/spmv.py design note), so the feature-id gather
+(``vec[indices]`` — the segment keys span millions of features) stays on
+XLA, where it fuses into the kernel's input. What Pallas CAN tile is the
+row-direction reduce: ``coo_segment_sum`` turns the multi-op
+scatter-segment-sum chain into a one-hot broadcast-compare + masked
+VPU reduce per (row tile, entry tile) — the segment ids are batch row
+ids, bounded by batch_size, so the one-hot tile is small and static. The
+transpose direction (segment by FEATURE id, ops/spmv.spmv_transpose)
+stays on XLA's scatter: a one-hot over millions of features would sweep
+every entry tile per feature tile and serialize. Exact f32 by the same
+argument as the dense kernel (VPU masked add, no MXU truncation), so
+bit-parity with XLA holds on integer-valued data where sums are exactly
+representable.
 
 Tiling: batch rows are processed TILE_B at a time; the feature dim is padded
 to a lane multiple (128) by the wrapper, and the row tile to a sublane
@@ -166,6 +175,87 @@ def fused_linear_grads(
         jnp.asarray(b, jnp.float32).reshape(1, 1),
     )
     return gw[0, :nfeat], gb[0, 0], loss_sum[0, 0], wsum[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# COO row-direction segment-sum for the sparse SpMV path (ops/spmv.py)
+# ---------------------------------------------------------------------------
+#
+# y[r] = sum_{e: row_ids[e]==r} contrib[e]. The grid walks (row tile,
+# entry tile); each step compares the entry tile's row ids against the
+# row tile's id range (2D broadcasted_iota — a 1D iota does not lower on
+# TPU) and masked-adds the matching contributions on the VPU,
+# accumulating across the sequential entry-tile sweep. Padded entries
+# carry contrib 0 (the csr bucket invariant) and the wrapper's alignment
+# pad carries row id -1, which matches no tile row.
+
+_SEG_TILE_E = 512  # entries per grid step
+_SEG_TILE_R = 256  # output rows per grid step (lane multiple)
+
+
+def _seg_sum_kernel(rid_ref, contrib_ref, out_ref):
+    j = pl.program_id(0)  # row tile (output block)
+    k = pl.program_id(1)  # entry tile (sequential sweep, accumulates)
+    rid = rid_ref[...]  # [TILE_E, 1] i32
+    contrib = contrib_ref[...]  # [TILE_E, 1] f32
+    rows = j * _SEG_TILE_R + jax.lax.broadcasted_iota(
+        jnp.int32, (1, _SEG_TILE_R), 1
+    )
+    # one-hot membership of each entry in this row tile; masked add on
+    # the VPU keeps f32 exact (MXU one-hot matmul would truncate to bf16
+    # — the same exactness argument as the dense kernel's matvec)
+    part = jnp.sum(
+        jnp.where(rid == rows, contrib, 0.0), axis=0, keepdims=True
+    )  # [1, TILE_R]
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = part
+
+    @pl.when(k > 0)
+    def _():
+        out_ref[...] += part
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows", "interpret"))
+def coo_segment_sum(contrib, row_ids, num_rows: int, interpret: bool = False):
+    """``jax.ops.segment_sum(contrib, row_ids, num_rows)`` as a Pallas
+    reduce — the row-direction half of the SpMV chain (ops/spmv.spmv),
+    with the feature gather left to XLA where it fuses into ``contrib``.
+    contrib [E] f32, row_ids [E] i32 (entries beyond the valid nnz must
+    carry contrib 0); returns [num_rows] f32."""
+    e = contrib.shape[0]
+    epad = _round_up(max(e, _SEG_TILE_E), _SEG_TILE_E)
+    rpad = _round_up(max(num_rows, _SEG_TILE_R), _SEG_TILE_R)
+    if epad != e:
+        contrib = jnp.pad(contrib, (0, epad - e))
+        row_ids = jnp.pad(row_ids, (0, epad - e), constant_values=-1)
+    out = pl.pallas_call(
+        _seg_sum_kernel,
+        grid=(rpad // _SEG_TILE_R, epad // _SEG_TILE_E),
+        in_specs=[
+            pl.BlockSpec((_SEG_TILE_E, 1), lambda j, k: (k, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SEG_TILE_E, 1), lambda j, k: (k, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _SEG_TILE_R), lambda j, k: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (rpad // _SEG_TILE_R, _SEG_TILE_R), jnp.float32
+        ),
+        cost_estimate=pl.CostEstimate(
+            # each (row tile, entry tile) pair compares + masked-adds
+            flops=2 * (rpad // _SEG_TILE_R) * epad,
+            bytes_accessed=(rpad // _SEG_TILE_R) * epad * 8 + rpad * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(
+        row_ids.astype(jnp.int32).reshape(-1, 1),
+        contrib.astype(jnp.float32).reshape(-1, 1),
+    )
+    return out.reshape(-1)[:num_rows]
 
 
 # ---------------------------------------------------------------------------

@@ -10,6 +10,13 @@ no-ops, so the static nnz bucket needs no masking.
 
 Reference parity: this replaces `Row::SDot` (data.h:152-158), the only
 compute kernel the reference ships.
+
+On TPU the row-direction segment-sum can additionally route through the
+fused Pallas kernel (:func:`spmv_pallas`, DMLC_TPU_PALLAS=1 with a csr
+layout): same contract, the reduce tiled as a one-hot masked add instead
+of XLA's scatter chain. The transpose (feature-direction) reduce stays
+on XLA in every configuration — see the design note in
+ops/pallas_kernels.py.
 """
 
 from __future__ import annotations
@@ -53,6 +60,23 @@ def spmv(values, indices, row_ids, weight_vec, num_rows: int):
     """
     contrib = values * jnp.take(weight_vec, indices, axis=0)
     return jax.ops.segment_sum(contrib, row_ids, num_segments=num_rows)
+
+
+@partial(jax.jit, static_argnames=("num_rows", "interpret"))
+def spmv_pallas(values, indices, row_ids, weight_vec, num_rows: int,
+                interpret: bool = False):
+    """:func:`spmv` with the row-direction reduce on the fused Pallas
+    kernel (ops/pallas_kernels.coo_segment_sum) instead of XLA's
+    scatter-based ``segment_sum`` lowering. The feature gather stays on
+    XLA, where it fuses into the kernel's ``contrib`` input — per-entry
+    dynamic gather is the part a TPU kernel cannot tile (module design
+    note), the batch-row reduce is the part it can. Bit-parity with
+    :func:`spmv` is pinned by the CI parity digest on integer-valued
+    data (exact f32 sums ⇒ reduction order is unobservable)."""
+    from dmlc_tpu.ops.pallas_kernels import coo_segment_sum
+
+    contrib = values * jnp.take(weight_vec, indices, axis=0)
+    return coo_segment_sum(contrib, row_ids, num_rows, interpret=interpret)
 
 
 @partial(jax.jit, static_argnames=("num_features",))

@@ -206,6 +206,125 @@ class TestDeviceFeedParity:
         piped.close()
 
 
+class TestDeviceResident:
+    """DMLC_TPU_DEVICE_RESIDENT=1: the pad-in-place producer
+    (RowBlockContainer.emit_* → FixedShapePool staging) must be
+    indistinguishable from the legacy materialize+pad path except in
+    copy count."""
+
+    def _collect(self, feed):
+        out = []
+        for batch in feed:
+            out.append({k: np.asarray(v).tobytes()
+                        for k, v in batch.items()
+                        if not np.isscalar(v)})
+        return out
+
+    @pytest.mark.parametrize("layout", ["dense", "csr"])
+    def test_resident_bit_identical_to_legacy(self, svm_path, monkeypatch,
+                                              layout):
+        spec = BatchSpec(batch_size=512, layout=layout, num_features=40,
+                         prefetch=1)
+        monkeypatch.delenv("DMLC_TPU_DEVICE_RESIDENT", raising=False)
+        legacy = DeviceFeed(_base_parser(svm_path), spec, host_prefetch=0)
+        assert not legacy._resident
+        want = self._collect(legacy)
+        legacy.close()
+
+        monkeypatch.setenv("DMLC_TPU_DEVICE_RESIDENT", "1")
+        resident = DeviceFeed(_base_parser(svm_path), spec, host_prefetch=0)
+        assert resident._resident
+        got = self._collect(resident)
+        assert got == want  # every array of every batch, byte-exact
+        resident.close()
+
+    def test_resident_one_trace_per_shape_bucket(self, svm_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("DMLC_TPU_DEVICE_RESIDENT", "1")
+        spec = BatchSpec(batch_size=512, layout="csr", num_features=40)
+        feed = DeviceFeed(
+            PipelinedParser(_base_parser(svm_path), nthread=2),
+            spec, host_prefetch=2,
+        )
+        step = jax.jit(
+            lambda b: (b["values"].sum(), b["label"].sum())
+        )
+        shapes_seen = set()
+        nrows = 0
+        for batch in feed:
+            step(batch)
+            nrows += int(batch["num_rows"])
+            shapes_seen.add(tuple(
+                (k, np.shape(v)) for k, v in sorted(batch.items())
+                if not np.isscalar(v)
+            ))
+        assert nrows == ROWS  # row accounting survives the emit path
+        assert step._cache_size() == len(shapes_seen)
+        assert len(shapes_seen) < feed.stats()["batches"]
+        feed.close()
+
+    def test_resident_rebatches_across_chunk_boundaries(self, svm_path,
+                                                        monkeypatch):
+        """Tiny parser chunks force every batch to span several blocks —
+        the slice/accumulate logic, not the happy one-block path."""
+        monkeypatch.setenv("DMLC_TPU_DEVICE_RESIDENT", "1")
+        spec = BatchSpec(batch_size=256, layout="csr", num_features=40)
+        monkeypatch.delenv("DMLC_TPU_DEVICE_RESIDENT", raising=False)
+        legacy = DeviceFeed(_base_parser(svm_path, chunk=1024), spec,
+                            host_prefetch=0)
+        want = self._collect(legacy)
+        legacy.close()
+        monkeypatch.setenv("DMLC_TPU_DEVICE_RESIDENT", "1")
+        resident = DeviceFeed(_base_parser(svm_path, chunk=1024), spec,
+                              host_prefetch=0)
+        got = self._collect(resident)
+        assert got == want
+        resident.close()
+
+    def test_dispatch_counter_one_per_batch(self, svm_path, monkeypatch):
+        """The whole pytree crosses in ONE device_put per batch —
+        dispatches/batch > 1 is the per-array regression the sentry
+        gates (dmlc_feed_h2d_dispatches_total)."""
+        # on the cpu backend the eager put is skipped unless forced
+        monkeypatch.setenv("DMLC_TPU_FEED_PUT", "1")
+        spec = BatchSpec(batch_size=512, layout="csr", num_features=40)
+        feed = DeviceFeed(_base_parser(svm_path), spec, host_prefetch=0)
+        batches = sum(1 for _ in feed)
+        assert batches > 0
+        assert feed._m_dispatches.value == batches
+        feed.close()
+
+    def test_batched_multihost_put_matches_per_array(self, svm_path):
+        """_put_tree_multihost (one batched device_put + metadata-only
+        assembly) must equal the per-array
+        make_array_from_process_local_data result. Single-process mesh:
+        both APIs are exercisable and must agree exactly."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+        spec = BatchSpec(batch_size=4, layout="dense", num_features=8)
+        feed = DeviceFeed(_base_parser(svm_path), spec, mesh=mesh,
+                          host_prefetch=0)
+        from jax.sharding import PartitionSpec as P
+
+        arrays = {
+            "x": np.arange(32, dtype=np.float32).reshape(4, 8),
+            "label": np.arange(4, dtype=np.float32),
+            "vec": np.arange(8, dtype=np.float32),  # replicated
+        }
+        specs = {"x": P("dp"), "label": P("dp"), "vec": P()}
+        before = feed._m_dispatches.value
+        got = feed._put_tree_multihost(arrays, specs)
+        assert feed._m_dispatches.value == before + 1  # ONE batched put
+        for k, v in arrays.items():
+            ref = jax.make_array_from_process_local_data(
+                feed._sharding(specs[k]), v)
+            assert got[k].shape == ref.shape
+            assert got[k].sharding == ref.sharding
+            assert np.array_equal(np.asarray(got[k]), np.asarray(ref))
+        feed.close()
+
+
 class TestFixedShapePool:
     def test_one_trace_per_shape_bucket(self, svm_path):
         spec = BatchSpec(batch_size=512, layout="csr", num_features=40)
@@ -250,7 +369,8 @@ class TestFixedShapePool:
         assert c is a
         stats = pool.stats()
         assert stats == {"shapes": 1, "allocated": 2, "reused": 1,
-                         "pending_retire": 0}
+                         "retired": 1, "double_retired": 0,
+                         "outstanding": 2, "pending_retire": 0}
 
     def test_no_recycle_mode_only_accounts_shapes(self):
         pool = FixedShapePool(recycle=False)
@@ -267,6 +387,59 @@ class TestFixedShapePool:
             buf = pool.acquire(16, np.int32)
             pool.retire([buf], [self._guard(lambda: False)])
         assert pool.stats()["pending_retire"] == pool.MAX_RETIRED
+
+    def test_double_retire_is_rejected(self):
+        """A buffer offered back twice must not be queued twice — two
+        future acquires sharing one backing array would corrupt an
+        in-flight batch."""
+        pool = FixedShapePool(recycle=True)
+        a = pool.acquire(32, np.float32)
+        pool.retire([a], [self._guard(lambda: True)])
+        pool.retire([a], [self._guard(lambda: True)])  # duplicate offer
+        assert pool.stats()["double_retired"] == 1
+        assert pool.stats()["retired"] == 1
+        b = pool.acquire(32, np.float32)
+        c = pool.acquire(32, np.float32)
+        assert b is a and c is not a  # handed out exactly once
+        # once re-acquired, retiring again is legitimate, not a double
+        pool.retire([b], [self._guard(lambda: True)])
+        assert pool.stats()["double_retired"] == 1
+
+    def test_leak_sentinel_fires_flight_event(self, tmp_path):
+        """Acquires without matching retires make monotonic outstanding
+        highs — after LEAK_STRIKES consecutive check windows, exactly one
+        ``pool.leak`` flight event."""
+        from dmlc_tpu.obs import flight
+
+        rec = flight.configure(str(tmp_path), capacity=64, rank=0,
+                               install=False)
+        try:
+            pool = FixedShapePool(recycle=True)
+            n = pool.LEAK_CHECK_EVERY * (pool.LEAK_STRIKES + 2)
+            for _ in range(n):
+                pool.acquire(8, np.float32)  # never retired: a leak
+            events = [r for r in rec.records()
+                      if r["kind"] == "pool.leak"]
+            assert len(events) == 1  # fires once, not per window
+            assert events[0]["outstanding"] > 0
+            assert events[0]["retired"] == 0
+        finally:
+            flight.reset()
+
+    def test_healthy_churn_never_trips_leak_sentinel(self, tmp_path):
+        from dmlc_tpu.obs import flight
+
+        rec = flight.configure(str(tmp_path), capacity=64, rank=0,
+                               install=False)
+        try:
+            pool = FixedShapePool(recycle=True)
+            for _ in range(pool.LEAK_CHECK_EVERY * (pool.LEAK_STRIKES + 2)):
+                buf = pool.acquire(8, np.float32)
+                pool.retire([buf], [self._guard(lambda: True)])
+            assert not [r for r in rec.records()
+                        if r["kind"] == "pool.leak"]
+        finally:
+            flight.reset()
 
 
 class TestKnobs:

@@ -66,6 +66,93 @@ def test_multi_tile_accumulation():
     assert float(ws) == n
 
 
+def test_coo_segment_sum_bit_parity():
+    """The sparse reduce kernel vs jax.ops.segment_sum on integer-valued
+    f32 data: sums are exactly representable, so ANY reduction order must
+    produce identical bits — the strongest pin available."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    for entries, rows in ((256, 6), (2048, 4096), (777, 100)):
+        rid = rng.randint(0, rows, size=entries).astype(np.int32)
+        contrib = rng.randint(-5, 6, size=entries).astype(np.float32)
+        contrib[-entries // 8:] = 0.0  # a padded bucket tail
+        ref = jax.ops.segment_sum(
+            jnp.asarray(contrib), jnp.asarray(rid), num_segments=rows)
+        got = pallas_kernels.coo_segment_sum(
+            jnp.asarray(contrib), jnp.asarray(rid), rows, interpret=True)
+        assert got.shape == (rows,)
+        assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_spmv_pallas_matches_xla_spmv():
+    from dmlc_tpu.ops.spmv import spmv, spmv_pallas
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    entries, rows, nfeat = 512, 64, 32
+    nnz = 400
+    values = np.zeros(entries, np.float32)
+    values[:nnz] = rng.randint(1, 4, nnz).astype(np.float32)
+    indices = np.zeros(entries, np.int32)
+    indices[:nnz] = rng.randint(0, nfeat, nnz)
+    rid = np.zeros(entries, np.int32)
+    rid[:nnz] = np.sort(rng.randint(0, rows, nnz))
+    vec = rng.randint(-3, 4, nfeat).astype(np.float32)  # exact products
+    ref = spmv(jnp.asarray(values), jnp.asarray(indices),
+               jnp.asarray(rid), jnp.asarray(vec), rows)
+    got = spmv_pallas(jnp.asarray(values), jnp.asarray(indices),
+                      jnp.asarray(rid), jnp.asarray(vec), rows,
+                      interpret=True)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_csr_model_step_with_pallas_matches_xla():
+    """make_linear_train_step(layout='csr', use_pallas=True) routes the
+    margin reduce through the Pallas kernel; the fit must track the XLA
+    step to float tolerance (reduction order differs once weights are
+    non-integer)."""
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+    )
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    rows, nfeat, entries = 64, 32, 512
+    nnz = 400
+    indices = np.zeros(entries, np.int32)
+    values = np.zeros(entries, np.float32)
+    indices[:nnz] = rng.randint(0, nfeat, nnz)
+    values[:nnz] = rng.rand(nnz).astype(np.float32)
+    row_of = np.sort(rng.randint(0, rows, nnz))
+    offsets = np.zeros(rows + 1, np.int32)
+    np.add.at(offsets, row_of + 1, 1)
+    offsets = np.cumsum(offsets).astype(np.int32)
+    batch = {
+        "label": jnp.asarray((rng.rand(rows) > 0.5).astype(np.float32)),
+        "weight": jnp.ones(rows, jnp.float32),
+        "indices": jnp.asarray(indices),
+        "values": jnp.asarray(values),
+        "offsets": jnp.asarray(offsets),
+    }
+    outs = {}
+    for use_pallas in (False, True):
+        params = init_linear_params(nfeat)
+        velocity = {"w": jnp.zeros(nfeat), "b": jnp.zeros(())}
+        step = make_linear_train_step(
+            None, layout="csr", num_features=nfeat, use_pallas=use_pallas
+        )
+        for _ in range(3):
+            params, velocity, metrics = step(params, velocity, batch)
+        outs[use_pallas] = (np.asarray(params["w"]),
+                            float(metrics["loss_sum"]))
+    np.testing.assert_allclose(outs[False][0], outs[True][0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[False][1], outs[True][1], rtol=1e-5)
+
+
 def test_model_step_with_pallas_matches_xla():
     """make_linear_train_step(use_pallas=True) reproduces the XLA step."""
     from dmlc_tpu.models.linear import (
