@@ -1000,13 +1000,23 @@ def create_parser(
             f"unknown data format {data_format!r}; known: "
             f"{PARSER_REGISTRY.list_all_names()}"
         )
-    if threaded and parse_backend() in ("auto", "native") and parse_procs() == 0:
+    # stamp the determinism auditor's shard signature so digest chains
+    # only compare across runs/ranks reading the same (uri, part) slice
+    # (obs/audit.py; no-op child when DMLC_TPU_AUDIT is off)
+    from dmlc_tpu.obs import audit
+
+    audit.auditor().set_shard(uri, part_index, num_parts)
+    if (threaded and parse_backend() in ("auto", "native")
+            and parse_procs() == 0 and not audit.auditor().enabled):
         # Built-in formats over local files take the all-native pipeline
         # (reader + parse + prefetch in C++); everything else composes the
         # Python InputSplit stack with native chunk parses inside. A
         # vector/scalar backend override or a process-pool request
         # (DMLC_TPU_PARSE_PROCS>0) keeps the Python PipelinedParser so the
-        # selected engine actually runs.
+        # selected engine actually runs. An enabled determinism auditor
+        # does too: the all-native pipeline has no io_read/parse digest
+        # points, and an armed audit plane that silently observes nothing
+        # is worse than the Python pipeline's (native-chunk-parse) cost.
         native_parser = _try_native_pipeline(
             spec, data_format, part_index, num_parts, nthread
         )

@@ -47,6 +47,7 @@ from typing import Iterator, Optional
 
 from dmlc_tpu import obs
 from dmlc_tpu.data.parsers import Parser
+from dmlc_tpu.obs import audit
 from dmlc_tpu.data.row_block import RowBlock
 from dmlc_tpu.io.readahead import OrderedWindow
 from dmlc_tpu.params.knobs import default_nthread, parse_procs
@@ -90,6 +91,23 @@ def _proc_parse(spec, chunk):
             parser = cls(_NullSource(), nthread=1)
         _PROC_PARSERS[spec] = parser
     return parser.parse_chunk(chunk)
+
+
+def _corrupt_chunk(chunk):
+    """``audit.corrupt`` payload: nudge the first ASCII digit so the
+    chunk stays parseable but its content forks — the parse-stage digest
+    diverges while the io_read digest (taken before this point) stays
+    clean, localizing the fault to ``parse``."""
+    text = isinstance(chunk, str)
+    try:
+        buf = bytearray(chunk.encode() if text else chunk)
+    except (TypeError, ValueError):
+        return chunk
+    for i, c in enumerate(buf):
+        if 0x30 <= c <= 0x38:  # '0'..'8': +1 keeps it a digit
+            buf[i] = c + 1
+            return buf.decode() if text else bytes(buf)
+    return chunk
 
 
 def _proc_spec(base: Parser):
@@ -156,6 +174,11 @@ class PipelinedParser:
         self._executor = None
         self._win: Optional[OrderedWindow] = None
         self._seq = 0  # in-order chunk id (span labels), not telemetry
+        # the determinism auditor keys chunk digests on epoch-relative
+        # seq (self._seq - _epoch_base) so chains line up across epochs
+        # and ranks; the no-op child when DMLC_TPU_AUDIT is off
+        self._audit = audit.auditor()
+        self._epoch_base = 0
         self._eof = False
         self._closed = False
         self._open()
@@ -179,7 +202,7 @@ class PipelinedParser:
         return self._executor
 
     def _parse_timed(self, task):
-        from dmlc_tpu.resilience import faultpoint
+        from dmlc_tpu.resilience import InjectedFault, faultpoint
 
         seq, fid, chunk = task
         t0 = time.monotonic_ns()
@@ -190,6 +213,12 @@ class PipelinedParser:
                 # injected fault poisons the window at the chunk's in-order
                 # position whether or not a process pool is behind it
                 faultpoint("parse.chunk")
+                # audit smoke fault: flip one byte AFTER the io_read
+                # digest so only the parse chain forks (localization)
+                try:
+                    faultpoint("audit.corrupt")
+                except InjectedFault:
+                    chunk = _corrupt_chunk(chunk)
                 if self._procs > 0:
                     container = self._ensure_executor().submit(
                         _proc_parse, self._proc_recipe, chunk
@@ -197,6 +226,7 @@ class PipelinedParser:
                 else:
                     container = self._base.parse_chunk(chunk)
             container.flow_id = fid
+            self._audit.note_parse(seq - self._epoch_base, container)
             return container
         finally:
             self._h_parse.observe(time.monotonic_ns() - t0)
@@ -215,6 +245,7 @@ class PipelinedParser:
                 self._eof = True
                 return
             self._m_chunks.inc()
+            self._audit.note_chunk(self._seq - self._epoch_base, chunk)
             self._win.submit((self._seq, fid, chunk))
             self._seq += 1
 
@@ -256,6 +287,7 @@ class PipelinedParser:
         self._win.close()
         self._base.before_first()
         self._open()
+        self._epoch_base = self._seq
         self._closed = False
 
     def stats(self) -> dict:

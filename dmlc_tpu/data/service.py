@@ -70,6 +70,7 @@ import numpy as np
 
 from dmlc_tpu import obs
 from dmlc_tpu.data.dispatcher import DispatcherClient, dispatcher_address
+from dmlc_tpu.obs import audit
 from dmlc_tpu.data.parsers import Parser, create_parser
 from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
 from dmlc_tpu.data.source_cache import source_cache
@@ -870,6 +871,18 @@ class RemoteBlockParser:
         # drain its response so the server's send completes cleanly)
         self._explicit_ack = False
         self._unacked: List[int] = []
+        # determinism audit (obs/audit.py): remember each accepted
+        # chunk's content digest so a requeued redelivery can be checked
+        # byte-for-byte against the first delivery before it is dropped.
+        # The map exists only when the auditor is live — the off path
+        # stays allocation-free.
+        self._audit = audit.auditor()
+        self._audit_digests: Optional[Dict[int, str]] = (
+            {} if self._audit.enabled else None)
+        self._m_redelivery = (obs.registry().counter(
+            "dmlc_audit_redelivery_checked_total",
+            "redelivered chunks digest-checked against first delivery")
+            if self._audit.enabled else None)
         self._seen: set = set()  # every seq this client ever accepted —
         # a redelivery of rows we already hold (a lease the dispatcher
         # requeued while our dispatcher session was briefly down) is
@@ -1096,6 +1109,14 @@ class RemoteBlockParser:
             self._ended = True
             raise
 
+    @staticmethod
+    def _content_digest(arrays: Dict[str, np.ndarray]) -> str:
+        """Digest of a delivery's content fields — ``flow`` is excluded
+        (the server mints a fresh flow id per send, so it legitimately
+        differs between a first delivery and its requeued duplicate)."""
+        return audit.digest_arrays(
+            {k: v for k, v in arrays.items() if k != "flow"})
+
     def next_block(self) -> Optional[RowBlock]:
         if self._ended:
             return None
@@ -1125,9 +1146,20 @@ class RemoteBlockParser:
                     # delivered-to-us (stopping further reserves), and
                     # this duplicate copy is dropped; the original's ack
                     # settles the chunk.
+                    if (self._audit_digests is not None
+                            and sid in self._audit_digests):
+                        # audit: the dropped duplicate must carry the
+                        # same rows the first delivery did — a fork here
+                        # means the requeue path rewrote content
+                        self._m_redelivery.inc()
+                        self._audit.check_redelivery(
+                            sid, self._audit_digests[sid],
+                            self._content_digest(arrays))
                     continue
                 self._seen.add(sid)
                 self._unacked.append(sid)
+                if self._audit_digests is not None:
+                    self._audit_digests[sid] = self._content_digest(arrays)
             nbytes = sum(a.nbytes for a in arrays.values())
             self.bytes_read += nbytes
             self._m_read.inc(nbytes)

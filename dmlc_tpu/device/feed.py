@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_tpu import obs
-from dmlc_tpu.obs import device_telemetry, flight
+from dmlc_tpu.obs import audit, device_telemetry, flight
 from dmlc_tpu.data.parsers import Parser, ThreadedParser, create_parser
 from dmlc_tpu.data.row_block import RowBlockContainer
 from dmlc_tpu.device.csr import (
@@ -465,6 +465,12 @@ class DeviceFeed:
         # off, and then the dispatch path has no byte walk and no timer.
         self._h2d = device_telemetry.h2d_meter(feed=fid)
         device_telemetry.maybe_start_hbm_poller()
+        # determinism audit: batch-stage digests at pool emit, keyed by
+        # per-epoch batch index (obs/audit.py; the canonical audit_arrays
+        # stream makes the resident container and the legacy sliced block
+        # hash identically for the same rows). The shared no-op child
+        # when DMLC_TPU_AUDIT is off.
+        self._audit = audit.auditor()
         self._epoch_base: dict = {}
         # exactly-once ack emission (dispatcher-mode RemoteBlockParser):
         # switch the parser to explicit acks BEFORE the producer thread
@@ -534,6 +540,7 @@ class DeviceFeed:
 
     def _host_batches_python(self) -> Iterator:
         bs = self.spec.batch_size
+        bidx = 0  # per-epoch batch index (audit batch-chain key)
         pending = RowBlockContainer()
         # flow ids (and dispatcher chunk seq ids) of parser chunks not yet
         # represented in an emitted batch; rebatching is N:M, so each
@@ -561,6 +568,8 @@ class DeviceFeed:
                 if seqs:
                     piece.seq_ids = tuple(seqs)
                     seqs = []
+                self._audit.note_batch(bidx, piece)
+                bidx += 1
                 yield piece
             pending = RowBlockContainer()
             if len(whole) > nfull * bs:
@@ -572,6 +581,7 @@ class DeviceFeed:
             if seqs:
                 tail.seq_ids = tuple(seqs)
                 seqs = []
+            self._audit.note_batch(bidx, tail)
             yield tail
         if seqs and self._ack is not None:
             # chunks whose rows only ever reached a dropped remainder (or
@@ -628,6 +638,9 @@ class DeviceFeed:
         if spec.layout == "dense":
             check(spec.num_features > 0, "dense layout requires num_features")
         pending = RowBlockContainer()
+        bidx = 0  # per-epoch batch index (audit batch-chain key); the
+        # container digests BEFORE emit consumes it, and hashes the same
+        # bytes as the legacy path's sliced block for the same rows
         flows = []
         seqs = []
         for block in self._parser:
@@ -644,6 +657,8 @@ class DeviceFeed:
                 if take:
                     pending.push_block(block.slice(start, start + take))
                     start += take
+                self._audit.note_batch(bidx, pending)
+                bidx += 1
                 yield self._emit_resident(pending, flows, seqs)
                 pending = RowBlockContainer()
                 flows = []
@@ -651,6 +666,7 @@ class DeviceFeed:
             if start < n:
                 pending.push_block(block.slice(start, n))
         if len(pending) and not spec.drop_remainder:
+            self._audit.note_batch(bidx, pending)
             yield self._emit_resident(pending, flows, seqs)
             seqs = []
         if seqs and self._ack is not None:

@@ -34,7 +34,7 @@ from typing import Optional
 
 from dmlc_tpu import obs
 from dmlc_tpu.device.feed import stall_breakdown
-from dmlc_tpu.obs import goodput
+from dmlc_tpu.obs import audit, goodput
 from dmlc_tpu.obs.watchdog import make_watchdog
 from dmlc_tpu.utils.logging import log_info
 
@@ -56,6 +56,9 @@ class FitLoopObs:
             "dmlc_fit_epoch_ns", "wall time per epoch", model=model)
         self.ledger = goodput.ledger(self.reg)
         self.watchdog = make_watchdog(self.reg)
+        # determinism audit: the model digest chain + numeric sentinel
+        # (the shared no-op child when DMLC_TPU_AUDIT is off)
+        self.audit = audit.auditor()
 
     def note_step(self, n: int = 1) -> None:
         """Hot-path progress marker (one no-op call under
@@ -64,18 +67,25 @@ class FitLoopObs:
 
     def end_epoch(self, epoch: int, nstep: int, t0_ns: int,
                   loss: Optional[float], feed=None,
-                  log_every: int = 0) -> Optional[dict]:
+                  log_every: int = 0, params=None) -> Optional[dict]:
         """Close one epoch: fit metrics, a goodput-ledger window fed to
         the watchdog, the unified stall/goodput log line (every
         ``log_every``-th epoch), and the registry export. Returns the
-        ledger window (None when metrics are disabled)."""
+        ledger window (None when metrics are disabled).
+
+        ``params`` (optional dict of device arrays) extends the audit
+        model-digest chain over a strided parameter sample — one small
+        epoch-cadence fetch that doubles as the numeric-health sentinel
+        (non-finite counts feed the watchdog's ``numeric`` alert)."""
         self.h_epoch.observe(time.monotonic_ns() - t0_ns)
         self.m_steps.inc(nstep)
         self.m_epochs.inc()
         if loss is not None:
             self.g_loss.set(loss)
+        nonfinite = self.audit.note_model(epoch, loss, params)
         win = self.ledger.tick()
         if win is not None:
+            win["nonfinite"] = nonfinite
             self.watchdog.observe(win)
         if log_every and (epoch + 1) % log_every == 0:
             parts = ["%s epoch %d" % (self.model, epoch)]
@@ -88,4 +98,8 @@ class FitLoopObs:
                     win["goodput"]["ratio"], win["binding"]))
             log_info("%s", " ".join(parts))
         obs.export_epoch(self.reg)
+        # roll AFTER the export/publish so the epoch's full data chains
+        # rode the heartbeat; this also runs the epoch-over-epoch
+        # self-check (first divergence writes the replay bundle)
+        self.audit.roll_epoch(epoch)
         return win

@@ -736,7 +736,7 @@ class LinearLearner:
             loss = acc.mean_loss()
             history.append(loss)
             fl.end_epoch(epoch, nstep, t0, loss, feed=feed,
-                         log_every=log_every)
+                         log_every=log_every, params=self.params)
             if epoch + 1 < epochs:
                 feed.before_first()
         return history

@@ -36,7 +36,13 @@ bottleneck diagnosis and auto-tuning):
   (see obs/goodput.py)
 - ``obs.watchdog`` — the in-run SLO watchdog over ledger windows:
   throughput collapse, recompile storms, pipeline stalls, straggler
-  ranks; fires ``watchdog.alert`` flight events (see obs/watchdog.py)
+  ranks, non-finite numerics; fires ``watchdog.alert`` flight events
+  (see obs/watchdog.py)
+- ``obs.audit`` — the cross-rank determinism audit plane: streaming
+  per-stage content-digest chains (io_read/parse/batch/model), epoch
+  self-checks, tracker-side cross-rank comparison behind ``/audit``,
+  and ``audit-rank<k>.json`` replay bundles on the first fork
+  (``DMLC_TPU_AUDIT``; see obs/audit.py)
 
 Metric names follow ``dmlc_<area>_<name>_<unit>`` and every registered
 name is documented in docs/observability.md (enforced by

@@ -59,7 +59,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Deque, Dict, List, Optional, Tuple
 
-from dmlc_tpu.obs import goodput, trace
+from dmlc_tpu.obs import audit, goodput, trace
 from dmlc_tpu.obs.exporters import prometheus_lines
 from dmlc_tpu.obs.metrics import Registry, registry
 from dmlc_tpu.params.knobs import obs_payload_max, obs_publish_enabled
@@ -122,12 +122,24 @@ def build_payload(
         "spans": spans,
         "spans_dropped": 0,
     }
+    # determinism-audit chains ride the same payload (obs/audit.py);
+    # the key is omitted entirely when audit is off or nothing was
+    # digested, so pre-audit payloads stay byte-stable
+    audit_obj = audit.auditor().export()
+    if audit_obj:
+        obj["audit"] = audit_obj
     blob = json.dumps(obj, separators=(",", ":"))
     while len(blob) > cap and obj["spans"]:
         shed = max(1, len(obj["spans"]) // 2)
         dropped += shed
         obj["spans"] = obj["spans"][shed:]
         obj["spans_dropped"] = dropped
+        blob = json.dumps(obj, separators=(",", ":"))
+    if len(blob) > cap and obj.get("audit"):
+        # shed the chain windows before the metrics: heads + totals
+        # still let the tracker spot length drift
+        for chain in obj["audit"]["chains"].values():
+            chain["d"] = []
         blob = json.dumps(obj, separators=(",", ":"))
     if len(blob) > cap and obj["metrics"]:
         obj["metrics"] = {}
@@ -362,6 +374,9 @@ class StatusPlane:
         # fault-tolerant data service (data/dispatcher.py): a snapshot
         # provider installed by DataDispatcher.attach_plane backs /data
         self._data_provider = None
+        # determinism audit: cross-rank chain comparison behind /audit
+        # (obs/audit.py; idle until a payload carries an "audit" key)
+        self.audit = audit.AuditPlane()
 
     def _view(self, rank: int) -> _WorkerView:
         view = self._views.get(rank)
@@ -412,6 +427,9 @@ class StatusPlane:
                 view.spans.extend(
                     e for e in spans if isinstance(e, dict) and "ts" in e)
             view.spans_dropped += int(obj.get("spans_dropped", 0) or 0)
+        audit_obj = obj.get("audit")
+        if audit_obj:
+            self.audit.note_audit(rank, audit_obj)
         self.stage_slack()  # refresh straggler/slack gauges as data lands
 
     def note_membership(self, kind: str, **fields) -> None:
@@ -465,6 +483,11 @@ class StatusPlane:
         except Exception as err:  # noqa: BLE001 — a dying dispatcher must
             # not take the status server down with it
             return {"attached": True, "error": str(err)}
+
+    def audit_view(self) -> Dict:
+        """The ``/audit`` body: per-rank chain summaries and the
+        cross-rank fork table (obs/audit.py AuditPlane.view)."""
+        return self.audit.view()
 
     def goodput_view(self) -> Dict:
         """The ``/goodput`` body: per-rank attribution windows plus the
@@ -685,6 +708,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 ctype = "application/json"
             elif path == "/goodput":
                 body = json.dumps(plane.goodput_view()).encode()
+                ctype = "application/json"
+            elif path == "/audit":
+                body = json.dumps(plane.audit_view()).encode()
                 ctype = "application/json"
             elif path == "/profile":
                 from urllib.parse import parse_qs

@@ -16,7 +16,12 @@ window per epoch (obs/goodput.py), and it fires on four shapes:
   flat) for ``DMLC_TPU_WATCHDOG_STALL_S`` cumulative seconds
   (0 disables);
 - ``straggler``       — the status plane flagged a straggler rank
-  (``dmlc_job_straggler_rank`` ≥ 0).
+  (``dmlc_job_straggler_rank`` ≥ 0);
+- ``numeric``         — the determinism auditor's numeric-health
+  sentinel saw non-finite values in the epoch loss or the strided
+  parameter sample it already fetches for the model digest chain
+  (obs/audit.py; the fit loop stamps the count onto the window as
+  ``nonfinite``).
 
 Each kind fires **once** per excursion: on firing it emits one
 ``watchdog.alert`` flight-recorder event, bumps
@@ -46,7 +51,7 @@ from dmlc_tpu.params import knobs
 logger = logging.getLogger("dmlc_tpu.obs.watchdog")
 
 #: alert kinds, in evaluation order
-KINDS = ("collapse", "recompile_storm", "stall", "straggler")
+KINDS = ("collapse", "recompile_storm", "stall", "straggler", "numeric")
 
 #: collapse gate defaults: the sentry window/MAD machinery, with a wider
 #: relative band — epoch windows are noisier than bench rounds
@@ -170,6 +175,14 @@ class Watchdog:
             note(self._fire("straggler", rank=rank))
         else:
             self._clear("straggler")
+
+        # numeric health: non-finite loss/param-sample values stamped
+        # onto the window by the fit loop's audit hook
+        nonfinite = int(win.get("nonfinite", 0) or 0)
+        if nonfinite > 0:
+            note(self._fire("numeric", nonfinite=nonfinite))
+        else:
+            self._clear("numeric")
         return fired
 
 

@@ -175,6 +175,17 @@ add five more:
   (default 45; the same figure bench_collective.py scores utilization
   against)
 
+The determinism audit plane (obs/audit.py, see docs/observability.md
+"Audit plane") adds two more:
+
+- ``DMLC_TPU_AUDIT`` — streaming stage-digest ledger: ``1``/``full``
+  digests every chunk/batch/step, ``sample`` digests every
+  ``DMLC_TPU_AUDIT_SAMPLE_N``-th chunk, anything else (the default)
+  hands every call site the shared no-op auditor — the hot path stays
+  allocation-free
+- ``DMLC_TPU_AUDIT_SAMPLE_N`` — sampling stride for ``sample`` mode
+  (default 16, floor 1)
+
 ``KNOWN_KNOBS`` below is the authoritative list of every
 ``DMLC_TPU_*`` variable the tree reads; ``scripts/check_faultpoints.py``
 fails CI when a knob is referenced anywhere without being registered
@@ -475,6 +486,29 @@ def ici_peak_gbps() -> float:
     return max(0.0, float(get_env("DMLC_TPU_ICI_PEAK_GBPS", 45.0)))
 
 
+def audit_mode() -> str:
+    """Determinism-audit ledger mode (``DMLC_TPU_AUDIT``): ``full``
+    (aliases ``1``/``on``) digests every chunk, parsed block, emitted
+    batch, and model step; ``sample`` digests every
+    :func:`audit_sample_n`-th sequence number for bounded overhead;
+    ``off`` — the default — makes :func:`dmlc_tpu.obs.audit.auditor`
+    return the shared no-op child (zero-alloc hot path)."""
+    val = str(get_env("DMLC_TPU_AUDIT", "")).strip().lower()
+    if val in ("1", "on", "full", "true"):
+        return "full"
+    if val == "sample":
+        return "sample"
+    return "off"
+
+
+def audit_sample_n() -> int:
+    """Digest stride for ``DMLC_TPU_AUDIT=sample``
+    (``DMLC_TPU_AUDIT_SAMPLE_N``, default 16, floor 1): only sequence
+    numbers divisible by N are digested, trading localization
+    granularity for overhead."""
+    return max(1, get_env("DMLC_TPU_AUDIT_SAMPLE_N", 16))
+
+
 def parse_backend() -> str:
     """Chunk-parse implementation (``DMLC_TPU_PARSE_BACKEND``): one of
     ``auto`` (native when loadable, else vector — the default),
@@ -565,6 +599,9 @@ KNOWN_KNOBS = (
     # goodput ledger + runtime watchdog
     "DMLC_TPU_WATCHDOG_STALL_S",
     "DMLC_TPU_WATCHDOG_PROFILE",
+    # determinism audit plane
+    "DMLC_TPU_AUDIT",
+    "DMLC_TPU_AUDIT_SAMPLE_N",
     "DMLC_TPU_PARSE_PEAK_MBPS",
     "DMLC_TPU_STEP_PEAK_MBPS",
     "DMLC_TPU_ICI_PEAK_GBPS",

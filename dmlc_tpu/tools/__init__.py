@@ -42,6 +42,7 @@ _COMMANDS = {
     "serve": "dmlc_tpu.tools.serve",
     "dispatch": "dmlc_tpu.tools.dispatch",
     "parity": "dmlc_tpu.tools.parity",
+    "audit-report": "dmlc_tpu.tools.audit_report",
     "obs-report": "dmlc_tpu.tools.obs_report",
     "obs-top": "dmlc_tpu.tools.obs_top",
     "bench-gate": "dmlc_tpu.tools.bench_gate",

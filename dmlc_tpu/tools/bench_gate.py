@@ -123,6 +123,17 @@ def main(argv: Optional[List[str]] = None) -> int:
               "(looked at: %s)" % ", ".join(patterns), file=sys.stderr)
         return 2
 
+    if not history:
+        # a fresh record with no history cannot be gated — that is a
+        # bootstrap state (first bench round, wiped archive), not a
+        # regression; report it as advisory instead of failing the build
+        print(
+            "bench-gate ADVISORY: no history to gate %s against "
+            "(looked at: %s); record it as the first baseline round"
+            % (fresh_path, ", ".join(patterns))
+        )
+        return 0
+
     series = sentry.metric_series(history)
     # direction registry: fresh record's map wins, history fills gaps
     directions = sentry.record_directions(history + [fresh_rec])

@@ -22,6 +22,11 @@ Renders a human-readable summary of a job's observability artifacts:
   the per-rank + job-rolled stage-budget/roofline attribution tables
   (obs/goodput.py — the same code path the bench detail record and
   obs-top's goodput column use), binding constraint flagged per window.
+- ``--audit`` — with ``--status``: fetch ``/audit`` and render the
+  determinism audit plane's per-rank digest-chain summary + fork table
+  (obs/audit.py — the same view ``audit-report --status`` renders);
+  without ``--status``, scan the ``--flightrec`` dir (or cwd) for
+  ``audit-rank*.json`` replay bundles instead.
 - ``--diff A B`` — compare two traces (e.g. the last good run's
   ``/trace`` download vs the regressed run's): per-stage total time
   delta, biggest eater first — "which stage ate the regression", the
@@ -378,6 +383,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--attribution", action="store_true",
                         help="With --status: render the /goodput per-rank "
                         "+ job-rolled stage-budget attribution tables.")
+    parser.add_argument("--audit", action="store_true",
+                        help="Render the determinism audit plane: /audit "
+                        "with --status, else audit-rank*.json bundles "
+                        "under --flightrec (or the cwd).")
     args = parser.parse_args(argv)
     if (args.top or args.attribution) and not args.status:
         print("obs-report: --top/--attribution need --status",
@@ -406,6 +415,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             goodput_obj = _fetch(args.status, "/goodput")
             if goodput_obj is not None:
                 reported = _report_attribution(goodput_obj) or reported
+        if args.audit:
+            audit_obj = _fetch(args.status, "/audit")
+            if audit_obj is not None:
+                from dmlc_tpu.tools import audit_report
+
+                print("== determinism audit ==")
+                audit_report._render_view(audit_obj)
+                reported = True
         data = _fetch(args.status, "/data")
         if data is not None:
             reported = _report_data(data) or reported
@@ -422,6 +439,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         trace_obj = _load_trace(args.trace)
         if trace_obj is not None:
             reported = _report_trace(trace_obj) or reported
+    if args.audit and not args.status:
+        from dmlc_tpu.tools import audit_report
+
+        bundles = audit_report._find_bundles(
+            [args.flightrec] if args.flightrec else [])
+        if bundles:
+            print("== determinism audit bundles ==")
+            for path in bundles:
+                try:
+                    audit_report._render_bundle(path)
+                except (OSError, ValueError) as err:
+                    print(f"obs-report: unreadable bundle {path}: {err}",
+                          file=sys.stderr)
+            reported = True
     if not reported:
         print("obs-report: nothing to report (pass --flightrec, --trace, "
               "--diff, or --status)", file=sys.stderr)

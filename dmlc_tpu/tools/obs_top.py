@@ -100,6 +100,7 @@ def build_rows(
     prev_h2d: Optional[Dict[int, float]] = None,
     dt_s: float = 0.0,
     goodput_obj: Optional[Dict] = None,
+    audit_obj: Optional[Dict] = None,
 ) -> Tuple[List[Dict], Dict[int, float]]:
     """One table frame from a ``/metrics`` + ``/workers`` fetch.
 
@@ -110,7 +111,11 @@ def build_rows(
     ``goodput_obj`` is the tracker's ``/goodput`` JSON (obs/plane.py):
     when a rank has a window there, its row carries the goodput ratio and
     the live binding-stage verdict — same attribution code path as
-    ``obs-report --attribution`` and the bench detail record."""
+    ``obs-report --attribution`` and the bench detail record.
+
+    ``audit_obj`` is the tracker's ``/audit`` JSON (obs/audit.py
+    AuditPlane.view): a rank with published digest chains gets an audit
+    column — total digests chained, or the fork flag on divergence."""
     samples = parse_metrics(metrics_text)
     consume_sum = _rank_sums(samples, "dmlc_feed_consume_ns_sum")
     consume_count = _rank_sums(samples, "dmlc_feed_consume_ns_count")
@@ -132,6 +137,7 @@ def build_rows(
             continue
 
     goodput_ranks = (goodput_obj or {}).get("ranks") or {}
+    audit_ranks = (audit_obj or {}).get("ranks") or {}
 
     rows = []
     for rank in sorted(ranks):
@@ -139,6 +145,16 @@ def build_rows(
         m = _JOB_RE.search(str(info.get("info") or ""))
         job = m.group(1) if m else None
         att = goodput_ranks.get(str(rank)) or {}
+        aud = audit_ranks.get(str(rank))
+        if aud is not None:
+            audit_n = sum(
+                int(c.get("n", 0) or 0)
+                for c in (aud.get("chains") or {}).values())
+            audit_diverged = bool(
+                aud.get("diverged") or aud.get("worker_divergences"))
+        else:
+            audit_n = None
+            audit_diverged = False
         gp = att.get("goodput") or {}
         count = consume_count.get(rank, 0.0)
         step_ms = (consume_sum.get(rank, 0.0) / count / 1e6) if count else 0.0
@@ -164,6 +180,8 @@ def build_rows(
             "recompiles": int(recompiles.get(rank, 0)),
             "goodput_ratio": gp.get("ratio"),
             "binding": att.get("binding"),
+            "audit_n": audit_n,
+            "audit_diverged": audit_diverged,
         })
     # multi-tenant fleet: ranks serving the same job sit together
     # (unlabeled ranks first, then jobs alphabetically, rank within)
@@ -182,12 +200,17 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
     # the plane has two metric snapshots to attribute between
     with_jobs = any(r.get("job") for r in rows)
     with_goodput = any(r.get("binding") for r in rows)
+    # same contract again for the audit column: it appears only when the
+    # audit plane has chains for some rank, so a no-audit frame keeps
+    # the exact pre-audit byte layout
+    with_audit = any(r.get("audit_n") is not None for r in rows)
     job_hdr = f"{'job':>10} " if with_jobs else ""
     gp_hdr = f"{'goodput':>7} {'binding':>11} " if with_goodput else ""
+    audit_hdr = f"{'audit':>7} " if with_audit else ""
     lines.append(
         f"{'rank':>4} {job_hdr}{'epoch':>6} {'lag_s':>7} {'step_ms':>8} "
         f"{'h2d_MBps':>9} {'hbm_MB':>8} {'compiles':>8} {'recomp':>6} "
-        f"{gp_hdr} flag")
+        f"{gp_hdr}{audit_hdr} flag")
     if not rows:
         lines.append("(no ranks reporting yet)")
     for r in rows:
@@ -201,11 +224,22 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
             gp_col = f"{gp:>7} {(r.get('binding') or '-'):>11} "
         else:
             gp_col = ""
+        if with_audit:
+            if r.get("audit_diverged"):
+                audit_cell = "FORK"
+            elif r.get("audit_n") is not None:
+                audit_cell = str(r["audit_n"])
+            else:
+                audit_cell = "-"
+            audit_col = f"{audit_cell:>7} "
+        else:
+            audit_col = ""
         lines.append(
             f"{r['rank']:>4} {job_col}{epoch:>6} {lag:>7} "
             f"{r['step_ms']:>8.1f} "
             f"{r['h2d_mbps']:>9.1f} {r['hbm_mb']:>8.1f} "
-            f"{r['compiles']:>8d} {r['recompiles']:>6d} {gp_col} {flag}")
+            f"{r['compiles']:>8d} {r['recompiles']:>6d} "
+            f"{gp_col}{audit_col} {flag}")
     return "\n".join(lines)
 
 
@@ -223,7 +257,7 @@ def _fetch_text(status: str, endpoint: str) -> Optional[str]:
 
 def _fetch_frame(
     status: str,
-) -> Optional[Tuple[str, Optional[Dict], Optional[Dict]]]:
+) -> Optional[Tuple[str, Optional[Dict], Optional[Dict], Optional[Dict]]]:
     metrics_text = _fetch_text(status, "/metrics")
     if metrics_text is None:
         return None
@@ -237,7 +271,7 @@ def _fetch_frame(
         except ValueError:
             return None
 
-    return metrics_text, _json("/workers"), _json("/goodput")
+    return metrics_text, _json("/workers"), _json("/goodput"), _json("/audit")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -256,9 +290,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     frame = _fetch_frame(args.status)
     if frame is None:
         return 2
-    metrics_text, workers_obj, goodput_obj = frame
+    metrics_text, workers_obj, goodput_obj, audit_obj = frame
     rows, h2d_prev = build_rows(metrics_text, workers_obj,
-                                goodput_obj=goodput_obj)
+                                goodput_obj=goodput_obj,
+                                audit_obj=audit_obj)
     wv = (workers_obj or {}).get("world_version")
     table = render_table(rows, world_version=wv)
     if args.once:
@@ -276,11 +311,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             frame = _fetch_frame(args.status)
             if frame is None:
                 return 2
-            metrics_text, workers_obj, goodput_obj = frame
+            metrics_text, workers_obj, goodput_obj, audit_obj = frame
             rows, h2d_prev = build_rows(
                 metrics_text, workers_obj,
                 prev_h2d=h2d_prev, dt_s=max(0.1, args.interval),
-                goodput_obj=goodput_obj)
+                goodput_obj=goodput_obj, audit_obj=audit_obj)
             wv = (workers_obj or {}).get("world_version")
             table = render_table(rows, world_version=wv)
     except KeyboardInterrupt:

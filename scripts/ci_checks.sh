@@ -504,4 +504,171 @@ print("ci_checks: watchdog smoke OK "
       "(collapse fired once, dumped; /goodput names device_step)")
 EOF
 
+# determinism-audit smoke: the same short fit run as a 2-process pair
+# with DMLC_TPU_AUDIT=1. Clean pair: zero divergences, no replay
+# bundles, bit-identical model digest chains across ranks. Faulted
+# pair: rank 1 gets a single silently-corrupted chunk (the
+# audit.corrupt faultpoint flips one digit — parseable, wrong bytes);
+# the worker's epoch self-check must localize the fork to the exact
+# (parse, rank 1, seq 0) in audit-rank1.json, and a tracker-side
+# AuditPlane fed both ranks' exports must flag the cross-rank model
+# fork. Finally the disabled-vs-enabled parse overhead is measured
+# (min-of-3; <2% steady-state target, generous CI bound).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import glob, json, os, shutil, subprocess, sys, tempfile, time
+
+import numpy as np
+
+workdir = tempfile.mkdtemp(prefix="dmlc_audit_smoke_")
+NF, ROWS = 12, 400
+rng = np.random.RandomState(5)
+svm = os.path.join(workdir, "a.svm")
+with open(svm, "w") as fh:
+    for i in range(ROWS):
+        ids = np.sort(rng.choice(NF, size=1 + i % 4, replace=False))
+        fh.write("%d %s\n" % (i % 2, " ".join(
+            "%d:%.4f" % (j, rng.rand()) for j in ids)))
+
+WORKER = r'''
+import json, sys
+data, out = sys.argv[1], sys.argv[2]
+import numpy as np
+from dmlc_tpu.models import LinearLearner
+from dmlc_tpu.obs import audit
+learner = LinearLearner(objective="logistic", learning_rate=0.1,
+                        num_features=12)
+list(learner.fit_uri(data, batch_size=64, epochs=2, num_features=12))
+a = audit.auditor()
+json.dump({"divergences": a.snapshot()["divergences"],
+           "export": a.export(),
+           "w": np.asarray(learner.params["w"]).tobytes().hex()},
+          open(out, "w"))
+'''
+worker_py = os.path.join(workdir, "worker.py")
+open(worker_py, "w").write(WORKER)
+
+def run_pair(tag, faults=None):
+    rundir = os.path.join(workdir, tag)
+    os.makedirs(rundir)
+    procs, outs = [], []
+    for rank in range(2):
+        out = os.path.join(rundir, "r%d.json" % rank)
+        outs.append(out)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DMLC_TPU_AUDIT="1", DMLC_TPU_NTHREAD="1",
+                   DMLC_TASK_ID=str(rank), PYTHONPATH=os.getcwd())
+        env.pop("DMLC_TPU_FAULTS", None)
+        env.pop("DMLC_TPU_STATUS_PORT", None)
+        if rank == 1 and faults:
+            env["DMLC_TPU_FAULTS"] = faults
+        procs.append(subprocess.Popen(
+            [sys.executable, worker_py, svm, out], env=env, cwd=rundir))
+    for p in procs:
+        if p.wait(timeout=240) != 0:
+            sys.exit("ci_checks: audit smoke worker failed (rc=%d)"
+                     % p.returncode)
+    return rundir, [json.load(open(o)) for o in outs]
+
+def plane_forks(reports, rundir):
+    from dmlc_tpu.obs.audit import AuditPlane
+    from dmlc_tpu.obs.metrics import Registry
+    out_dir = os.path.join(rundir, "tracker")
+    os.makedirs(out_dir, exist_ok=True)
+    plane = AuditPlane(reg=Registry(), out_dir=out_dir)
+    found = []
+    for rank, rep in enumerate(reports):
+        found += plane.note_audit(rank, rep["export"])
+    return found
+
+# clean pair: identical inputs -> identical chains, zero divergences
+rundir, reports = run_pair("clean")
+if any(rep["divergences"] for rep in reports):
+    sys.exit("ci_checks: clean audit run reported divergences: %r"
+             % [rep["divergences"] for rep in reports])
+if glob.glob(os.path.join(rundir, "audit-rank*.json")):
+    sys.exit("ci_checks: clean audit run wrote a replay bundle")
+heads = [rep["export"]["chains"]["model"]["head"] for rep in reports]
+if heads[0] != heads[1] or reports[0]["w"] != reports[1]["w"]:
+    sys.exit("ci_checks: clean ranks disagree on the model chain")
+if plane_forks(reports, rundir):
+    sys.exit("ci_checks: AuditPlane flagged a fork on the clean pair")
+
+# faulted pair: one corrupted chunk on rank 1, epoch 0
+rundir, reports = run_pair("corrupt", faults="audit.corrupt:nth=1")
+if reports[0]["divergences"]:
+    sys.exit("ci_checks: corruption on rank 1 flagged rank 0: %r"
+             % reports[0]["divergences"])
+divs = reports[1]["divergences"]
+if not divs or (divs[0]["stage"], divs[0]["seq"]) != ("parse", 0):
+    sys.exit("ci_checks: rank 1 self-check missed the fork "
+             "(want stage=parse seq=0): %r" % divs)
+bundle_file = os.path.join(rundir, "audit-rank1.json")
+if os.path.exists(os.path.join(rundir, "audit-rank0.json")):
+    sys.exit("ci_checks: clean rank 0 wrote a replay bundle")
+bundle = json.load(open(bundle_file))
+if (bundle["divergence"]["stage"], bundle["divergence"]["seq"],
+        bundle["rank"]) != ("parse", 0, 1):
+    sys.exit("ci_checks: bundle localization wrong: %r"
+             % bundle["divergence"])
+forks = plane_forks(reports, rundir)
+if not forks or (forks[0]["stage"], forks[0]["rank"]) != ("model", 1):
+    sys.exit("ci_checks: AuditPlane missed the cross-rank model fork: %r"
+             % forks)
+rc = subprocess.call([sys.executable, "-m", "dmlc_tpu.tools",
+                      "audit-report", rundir],
+                     stdout=subprocess.DEVNULL)
+if rc != 1:
+    sys.exit("ci_checks: audit-report rc=%d on a diverged bundle, "
+             "want 1" % rc)
+
+# overhead: disabled vs full-audit parse pass over a bigger corpus
+from dmlc_tpu.data.parsers import LibSVMParser
+from dmlc_tpu.io.input_split import create_input_split
+from dmlc_tpu.obs import audit as audit_mod
+
+big = os.path.join(workdir, "big.svm")
+with open(big, "w") as fh:
+    for i in range(20000):
+        fh.write("%d %d:%.4f %d:%.4f\n"
+                 % (i % 2, i % NF, rng.rand(), NF + i % NF, rng.rand()))
+
+def parse_pass():
+    split = create_input_split(big, 0, 1, "text", threaded=False)
+    parser = LibSVMParser(split, nthread=1)
+    n = sum(1 for _ in parser)
+    parser.close()
+    return n
+
+def best_of(trials=3):
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        parse_pass()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+os.environ.pop("DMLC_TPU_FAULTS", None)
+os.environ.pop("DMLC_TPU_AUDIT", None)
+audit_mod.reset_auditor()
+parse_pass()  # warm the page cache + import path before timing
+base = best_of()
+os.environ["DMLC_TPU_AUDIT"] = "1"
+audit_mod.reset_auditor()
+parse_pass()
+audited = best_of()
+if audit_mod.auditor().snapshot()["divergences"]:
+    sys.exit("ci_checks: overhead pass reported divergences")
+os.environ.pop("DMLC_TPU_AUDIT", None)
+audit_mod.reset_auditor()
+ratio = audited / base if base > 0 else 1.0
+print("ci_checks: audit parse overhead x%.3f (steady-state target "
+      "<1.02)" % ratio)
+if ratio > 1.15:
+    sys.exit("ci_checks: audit overhead x%.3f exceeds the CI bound "
+             "1.15" % ratio)
+shutil.rmtree(workdir, ignore_errors=True)
+print("ci_checks: audit smoke OK (self-check + cross-rank localized "
+      "(parse, rank 1, seq 0); clean pair chain-identical)")
+EOF
+
 echo "ci_checks: all checks passed"

@@ -19,6 +19,7 @@ import pytest
 from dmlc_tpu import resilience
 from dmlc_tpu.data import BlockService, DataDispatcher, RemoteBlockParser
 from dmlc_tpu.data.dispatcher import DispatcherClient, dispatcher_address
+from dmlc_tpu.obs import audit as audit_mod
 
 ROWS = 40
 
@@ -376,6 +377,9 @@ class TestFleetIntegration:
         p._explicit_ack = True
         p._unacked = []
         p._seen = set()
+        p._audit = audit_mod.NOOP_AUDITOR
+        p._audit_digests = None
+        p._m_redelivery = None
         p.bytes_read = 0
         p._m_read = obs.registry().counter(
             "dmlc_io_read_bytes_total", "payload bytes ingested by source",
@@ -407,6 +411,94 @@ class TestFleetIntegration:
         assert p.next_block() is None  # the duplicate is skipped, EOS
         assert p._unacked == [0]  # consumed once, owed exactly one ack
         assert [c["op"] for c in calls] == ["recv", "recv"]
+
+    def _audit_parser(self, fresh_reg):
+        """A RemoteBlockParser wired like the dispatcher path, with a
+        live auditor and the redelivery digest map armed."""
+        from dmlc_tpu import obs
+
+        p = RemoteBlockParser.__new__(RemoteBlockParser)
+        p._ended = False
+        p._closed = False
+        p._inflight = False
+        p._explicit_ack = True
+        p._unacked = []
+        p._seen = set()
+        p._audit = audit_mod.Auditor(reg=fresh_reg, mode="full", rank=0)
+        p._audit_digests = {}
+        p._m_redelivery = fresh_reg.counter(
+            "dmlc_audit_redelivery_checked_total",
+            "redelivered chunks digest-checked against first delivery")
+        p.bytes_read = 0
+        p._m_read = obs.registry().counter(
+            "dmlc_io_read_bytes_total", "payload bytes ingested by source",
+            source="service")
+
+        class _Dispatch:
+            def call(self, obj, site="service.dispatch"):
+                return {"ok": True}
+
+        p._dispatch = _Dispatch()
+        p._client_id = 0
+        p._jid = 0
+        return p
+
+    @staticmethod
+    def _frame(seq=0, label=1.0, flow=7):
+        return {
+            "seq": np.asarray([seq], dtype=np.int64),
+            "flow": np.asarray([flow], dtype=np.int64),
+            "offset": np.asarray([0, 1], dtype=np.int64),
+            "label": np.asarray([label]),
+            "index": np.asarray([1], dtype=np.int64),
+            "value": np.asarray([2.0]),
+        }
+
+    def test_redelivered_chunk_digest_checked(self):
+        """Audit satellite pin: a requeued redelivery must produce the
+        same content digest as the first delivery — the duplicate is
+        digest-compared (counter bumps) and a byte-identical copy raises
+        nothing. The server-minted flow id legitimately differs between
+        deliveries and must not fork the digest."""
+        from dmlc_tpu.obs.metrics import Registry
+
+        reg = Registry()
+        p = self._audit_parser(reg)
+        # identical rows, different flow ids (a requeue re-sends with a
+        # fresh flow)
+        frames = [self._frame(flow=7), self._frame(flow=8), None]
+        p._fetch_arrays = lambda: frames.pop(0)
+        assert p.next_block() is not None
+        assert p.next_block() is None  # duplicate dropped, EOS
+        assert p._m_redelivery.value == 1
+        assert reg.counter(
+            "dmlc_audit_divergences_total",
+            "digest-chain forks detected by the audit plane",
+            stage="redelivery").value == 0
+
+    def test_redelivered_chunk_content_fork_flagged(self, tmp_path,
+                                                    monkeypatch):
+        """The negative half: a redelivery whose rows differ from the
+        first delivery is a divergence — counted, and the replay bundle
+        lands beside the flight recorder dump."""
+        from dmlc_tpu.obs.metrics import Registry
+
+        monkeypatch.chdir(tmp_path)  # bundle path falls back to cwd
+        reg = Registry()
+        p = self._audit_parser(reg)
+        frames = [self._frame(label=1.0), self._frame(label=2.0), None]
+        p._fetch_arrays = lambda: frames.pop(0)
+        assert p.next_block() is not None
+        assert p.next_block() is None
+        assert p._m_redelivery.value == 1
+        assert reg.counter(
+            "dmlc_audit_divergences_total",
+            "digest-chain forks detected by the audit plane",
+            stage="redelivery").value == 1
+        bundle = json.load(open(tmp_path / "audit-rank0.json"))
+        div = bundle["divergence"]
+        assert div["stage"] == "redelivery" and div["seq"] == 0
+        assert div["ours"] != div["theirs"]
 
     def test_two_workers_share_one_epoch(self, svm_file):
         """Both registered workers take leases; the consumer sees every

@@ -185,6 +185,18 @@ class TestBenchGateCLI:
     def test_fresh_defaults_to_history_tail(self, capsys):
         assert bench_gate.main(["--history", BENCH_GLOB]) == 0
 
+    def test_fresh_without_history_is_advisory(self, tmp_path, capsys):
+        # first bench round: a fresh record but an empty history window
+        # is a bootstrap state, not a regression — advisory verdict, rc 0
+        fresh = tmp_path / "detail.json"
+        fresh.write_text(json.dumps({"metric": "x_ingest", "value": 100.0}))
+        rc = bench_gate.main(
+            ["--fresh", str(fresh),
+             "--history", str(tmp_path / "BENCH_r*.json")])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ADVISORY" in out and "no history" in out
+
 
 class TestObsReportDiff:
     def _trace(self, path, scale):
