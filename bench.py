@@ -316,6 +316,53 @@ def _criteo_recordio_sweep() -> dict:
     return _rowrec_sweep(_ensure_criteo_recordio(), CRITEO_ROWS)
 
 
+def _ensure_shard(path: str) -> str:
+    """Baked columnar twin of the higgs-shaped text file (io/shard.py,
+    baked through tools/bake.py so the bench exercises the product CLI
+    path). Idempotent: the bake sidecar digest skips a re-bake when the
+    source and bake params are unchanged."""
+    from dmlc_tpu.tools.bake import bake_dataset
+
+    dst = os.path.join(CACHE_DIR, f"higgs_like_{ROWS}.dtsh")
+    bake_dataset(path, dst, data_format="libsvm", rows_per_window=16384)
+    return dst
+
+
+def _shard_sweep(path: str) -> dict:
+    """One baked-shard ingest sweep → {probe_gbps, trials, bake_mbps}.
+
+    Trials are MB/s over the *shard* bytes (what the steady-state epoch
+    actually reads), matching the recordio tier's accounting.
+    ``bake_mbps`` is the one-off conversion cost in source-text MB/s —
+    forced (not sidecar-skipped) so every sweep measures a real bake and
+    the combine step can take the best window like any other score."""
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.tools.bake import bake_dataset
+
+    probe = _host_probe()
+    dst = os.path.join(CACHE_DIR, f"higgs_like_{ROWS}.dtsh")
+    t0 = time.time()
+    bake_dataset(path, dst, data_format="libsvm", rows_per_window=16384,
+                 force=True)
+    bake_dt = time.time() - t0
+    src_mb = os.path.getsize(path) / (1 << 20)
+    runs = []
+    for _ in range(TRIALS + 1):
+        t0 = time.time()
+        parser = create_parser(dst, 0, 1, nthread=1)
+        rows = sum(len(b) for b in parser)
+        dt = time.time() - t0
+        mb = parser.bytes_read / (1 << 20)
+        parser.close()
+        assert rows == ROWS, f"shard row mismatch: {rows}"
+        runs.append(round(mb / dt, 1))
+    return {
+        "probe_gbps": probe,
+        "trials": runs[1:],
+        "bake_mbps": round(src_mb / bake_dt, 1),
+    }
+
+
 def _combine_tier(sweeps: list) -> tuple:
     """Best sweep's score (median of its trials unless the sweep recorded
     an explicit score) → (value, sweeps-for-extra). The host is bimodal
@@ -644,6 +691,38 @@ def _bench_recordio_sgd(path: str) -> dict:
     return {
         "recordio_sgd_mbps": round(statistics.median(runs[1:]), 1),
         "recordio_sgd_trials_mbps": runs[1:],
+    }
+
+
+def _bench_shard_sgd(path: str) -> dict:
+    """Baked columnar shard → dense SGD on the attached device: the
+    ISSUE's 'ingest at RecordIO speed' claim measured end-to-end. Scored
+    in *source-text* MB/s (same ``size_mb`` as sgd_e2e_mbps) so the
+    sentry compares it directly against the text-parse epoch — the baked
+    epoch must beat it or the format isn't paying for itself."""
+    import jax.numpy as jnp
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.device import BatchSpec, DeviceFeed
+    from dmlc_tpu.models.linear import (
+        init_linear_params,
+        make_linear_train_step,
+    )
+
+    shard = _ensure_shard(path)
+    size_mb = os.path.getsize(path) / (1 << 20)
+    spec = BatchSpec(batch_size=16384, layout="dense", num_features=29)
+    params = init_linear_params(29)
+    velocity = {k: jnp.zeros_like(v) for k, v in params.items()}
+    step = make_linear_train_step(None, learning_rate=0.1, layout="dense",
+                                  donate_batch=True)
+    runs = _timed_sgd_epochs(
+        lambda: DeviceFeed(create_parser(shard, 0, 1, nthread=1), spec),
+        size_mb, step, "dense", params, velocity,
+    )
+    return {
+        "sgd_e2e_shard_mbps": round(statistics.median(runs[1:]), 1),
+        "sgd_e2e_shard_trials_mbps": runs[1:],
     }
 
 
@@ -1124,10 +1203,12 @@ _COMPACT_KEYS = (
     "parse_only_mbps", "parse_only_libsvm_native_gbps",
     "parse_only_libsvm_vector_gbps", "parse_only_csv_native_gbps",
     "parse_only_csv_vector_gbps",
-    "criteo_recordio_ingest_mbps", "remote_ingest_mbps",
+    "criteo_recordio_ingest_mbps", "shard_ingest_gbps", "bake_mbps",
+    "remote_ingest_mbps",
     "feed_dense_mbps", "sgd_e2e_mbps", "sgd_e2e_serial_mbps",
     "sgd_e2e_pipelined_mbps", "sgd_e2e_cached_mbps",
-    "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
+    "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "sgd_e2e_shard_mbps",
+    "criteo_like_csr_sgd_mbps",
     "sgd_e2e_resident_mbps", "sgd_e2e_python_mbps", "h2d_overlap_ratio",
     "resident_binding_stage",
     "gbdt_fit_mrows_s",
@@ -1160,7 +1241,8 @@ BENCH_DIRECTIONS = {
 # must not qualify a candidate
 _DEVICE_TIER_KEYS = (
     "feed_dense_mbps", "sgd_e2e_mbps", "sgd_e2e_cached_mbps",
-    "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "criteo_like_csr_sgd_mbps",
+    "sgd_csr_e2e_mbps", "recordio_sgd_mbps", "sgd_e2e_shard_mbps",
+    "criteo_like_csr_sgd_mbps",
 )
 
 
@@ -1355,6 +1437,7 @@ def main() -> None:
         "criteo_like_parse": _criteo_parse_sweep,
         "parse_only": _parse_only_sweep,
         "criteo_recordio_ingest": _criteo_recordio_sweep,
+        "shard_ingest": lambda: _shard_sweep(path),
         "remote_ingest": lambda: _remote_sweep(path),
     }
     tier_sweeps = {name: [] for name in host_tiers}
@@ -1377,6 +1460,8 @@ def main() -> None:
             os.path.getsize(_ensure_recordio(path)) / (1 << 20), 1),
         "criteo_recordio_file_mb": round(
             os.path.getsize(_ensure_criteo_recordio()) / (1 << 20), 1),
+        "shard_file_mb": round(
+            os.path.getsize(_ensure_shard(path)) / (1 << 20), 1),
     }
     device_ok, device_note, probe_record = _device_backend_ok()
     extra["device_probe"] = probe_record
@@ -1397,6 +1482,7 @@ def main() -> None:
         for tier_fn, err_key in (
             (lambda: _bench_device_feed(path), "device_feed_error"),
             (lambda: _bench_recordio_sgd(path), "recordio_sgd_error"),
+            (lambda: _bench_shard_sgd(path), "shard_sgd_error"),
             (_bench_criteo_sgd, "criteo_sgd_error"),
             (lambda: _bench_gbdt(path), "gbdt_error"),
             (lambda: _bench_multijob(path), "multijob_error"),
@@ -1483,6 +1569,16 @@ def main() -> None:
                 fmt_best[key] = max(fmt_best.get(key, 0.0), float(v))
     for key, v in fmt_best.items():
         extra["parse_only_" + key] = v
+    # the shard tier's headline is GB/s (the ISSUE's acceptance unit) and
+    # the one-off bake cost rides inside its sweeps — lift both to flat
+    # keys so the sentry gates them like any other throughput
+    if "shard_ingest_mbps" in extra:
+        extra["shard_ingest_gbps"] = round(
+            extra.pop("shard_ingest_mbps") / 1024, 2)
+    bake_best = [sw.get("bake_mbps") for sw in tier_sweeps.get(
+        "shard_ingest", ()) if isinstance(sw.get("bake_mbps"), (int, float))]
+    if bake_best:
+        extra["bake_mbps"] = max(bake_best)
     if "remote_ingest_mbps" in extra:
         # The loopback harness runs BOTH http ends and the parser on this
         # host's core(s): at 1 core the serial budget is parse + server

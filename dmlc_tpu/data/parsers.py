@@ -993,7 +993,24 @@ def create_parser(
     nthread = default_nthread(nthread)
     spec = URISpec(uri, part_index, num_parts)
     if data_format == "auto":
-        data_format = spec.args.get("format", "libsvm")
+        data_format = spec.args.get("format")
+        if data_format is None:
+            from dmlc_tpu.io.shard import is_shard_uri
+
+            data_format = "shard" if is_shard_uri(spec.uri) else "libsvm"
+    if data_format == "shard":
+        # baked columnar shards (io/shard.py): pre-tokenized, so there is
+        # no parse stage to fan out — the ShardParser decodes windows as
+        # frombuffer slices and owns its audit/flow wiring (including the
+        # shard signature, which it salts per epoch when shuffle is
+        # armed), so DMLC_TPU_AUDIT gets native digest points here and
+        # never forces a text re-parse of baked input
+        from dmlc_tpu.io.shard import ShardParser
+
+        base = ShardParser(
+            spec.uri, part_index, num_parts, args=spec.args, nthread=nthread
+        )
+        return ThreadedParser(base) if threaded else base
     entry = PARSER_REGISTRY.find(data_format)
     if entry is None:
         raise DMLCError(

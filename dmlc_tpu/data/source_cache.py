@@ -110,10 +110,17 @@ class SourceCache:
         when every input that could change the parsed bytes matches —
         same URI, same split geometry, same declared format, same parser
         kwargs — so a cache hit is bit-identical to a fresh parse by
-        construction."""
+        construction. Baked shards fold in a content token (format
+        version + per-file footer crc32 + the armed shuffle seed/window,
+        io/shard.py ``cache_token``): the URI of a re-baked or re-seeded
+        shard no longer names the same parsed bytes, so it must not hit
+        the old entry."""
+        from dmlc_tpu.io import shard
+
         spec = json.dumps(
             [str(uri), int(part), int(nparts), str(data_format),
-             sorted((parser_kwargs or {}).items())],
+             sorted((parser_kwargs or {}).items()),
+             shard.cache_token(uri, str(data_format))],
             sort_keys=True, default=repr)
         return hashlib.sha256(spec.encode()).hexdigest()
 

@@ -62,12 +62,16 @@ _HIGHER_SUFFIXES = ("_mbps", "_gbps", "_mrows_s")
 # source-cache hit ratio from the multijob bench tier (1.0 = the second
 # tenant parsed nothing), and the SPMD in-graph step's ICI utilization
 # (achieved/peak on the gradient psum — the ≥90% ROADMAP target).
-# spmd_psum_step_gbps is listed too for explicitness, though the _gbps
-# suffix rule already gates it.
+# spmd_psum_step_gbps and the baked-shard tier keys (shard_ingest_gbps /
+# sgd_e2e_shard_mbps / bake_mbps, the ISSUE's acceptance trio) are listed
+# too for explicitness, though the suffix rule already gates them.
 _HIGHER_KEYS = (
     "cache_cross_job_hit_ratio",
     "ici_utilization",
     "spmd_psum_step_gbps",
+    "shard_ingest_gbps",
+    "sgd_e2e_shard_mbps",
+    "bake_mbps",
 )
 _STALL_PREFIX = "stall."
 # lower-is-better key families: stall stages, XLA compile counts, and

@@ -175,6 +175,20 @@ add five more:
   (default 45; the same figure bench_collective.py scores utilization
   against)
 
+Baked columnar shards (io/shard.py + tools/bake.py, see
+docs/pipeline.md "Baked shards & global shuffle") add three more:
+
+- ``DMLC_TPU_SHUFFLE`` — windowed global-shuffle seed for shard reads
+  (≥ 0 arms a seeded permutation of the global window table, a pure
+  function of (seed, epoch); -1 — the default — reads windows in baked
+  order). The ``shuffle_chunks`` URI arg beats the env per dataset.
+- ``DMLC_TPU_SHUFFLE_WINDOW`` — shuffle unit in consecutive baked
+  windows (default 1, floor 1): larger units trade shuffle quality for
+  longer sequential runs on disk
+- ``DMLC_TPU_SHARD_MMAP`` — zero-copy shard reads: windows decode as
+  ``np.frombuffer`` views over one file mapping (default on; 0 falls
+  back to seek+read per window — NFS or map-exhausted hosts)
+
 The determinism audit plane (obs/audit.py, see docs/observability.md
 "Audit plane") adds two more:
 
@@ -526,6 +540,31 @@ def parse_procs() -> int:
     return max(0, get_env("DMLC_TPU_PARSE_PROCS", 0))
 
 
+def shuffle_seed() -> int:
+    """Windowed global-shuffle seed for baked shard reads
+    (``DMLC_TPU_SHUFFLE``, default -1 = shuffle off). A seed ≥ 0 arms a
+    seeded permutation of the shard window table — a pure function of
+    (seed, epoch), independent of the world size, so re-sharding and
+    resume replay the same global order (io/shard.py). A
+    ``shuffle_chunks=`` URI arg overrides the env per dataset."""
+    return int(get_env("DMLC_TPU_SHUFFLE", -1))
+
+
+def shuffle_window() -> int:
+    """Shuffle unit in consecutive baked windows
+    (``DMLC_TPU_SHUFFLE_WINDOW``, default 1, floor 1): the permutation
+    moves runs of this many windows together, trading shuffle quality
+    for longer sequential reads."""
+    return max(1, get_env("DMLC_TPU_SHUFFLE_WINDOW", 1))
+
+
+def shard_mmap() -> bool:
+    """Zero-copy shard reads (``DMLC_TPU_SHARD_MMAP``, default on):
+    window decodes are ``np.frombuffer`` views over one shared file
+    mapping. 0 falls back to seek+read per window."""
+    return bool(get_env("DMLC_TPU_SHARD_MMAP", True))
+
+
 def collective_engine() -> str:
     """Collective engine selection (``DMLC_TPU_COLLECTIVE``): one of
     ``auto`` (the default — device when a multi-process mesh is up,
@@ -599,6 +638,10 @@ KNOWN_KNOBS = (
     # goodput ledger + runtime watchdog
     "DMLC_TPU_WATCHDOG_STALL_S",
     "DMLC_TPU_WATCHDOG_PROFILE",
+    # baked columnar shards
+    "DMLC_TPU_SHUFFLE",
+    "DMLC_TPU_SHUFFLE_WINDOW",
+    "DMLC_TPU_SHARD_MMAP",
     # determinism audit plane
     "DMLC_TPU_AUDIT",
     "DMLC_TPU_AUDIT_SAMPLE_N",

@@ -31,6 +31,7 @@ import sys
 from typing import List, Optional
 
 _COMMANDS = {
+    "bake": "dmlc_tpu.tools.bake",
     "split_read": "dmlc_tpu.tools.split_read",
     "parse": "dmlc_tpu.tools.parse",
     "recordio": "dmlc_tpu.tools.recordio",

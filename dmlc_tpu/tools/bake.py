@@ -1,0 +1,184 @@
+"""Offline shard bake: text corpus → pre-tokenized columnar shards.
+
+``python -m dmlc_tpu.tools bake <src> <dst.dtsh>`` runs the source once
+through the ordinary parser stack (so the vectorized/native backends do
+the tokenizing) and writes the resulting RowBlocks as ``.dtsh`` shards
+(io/shard.py). After that, every epoch reads typed columns instead of
+re-parsing text — see docs/pipeline.md "Baked shards & global shuffle".
+
+``--nparts N`` bakes N shard files in parallel, one per input
+partition (the same byte-split ``create_parser`` uses, so part k of the
+bake is part k of a text read). Re-bakes are idempotent: a sidecar
+``<dst>.bake.json`` records a content digest of the source plus the
+bake parameters, and a matching sidecar with all outputs present skips
+the work (``--force`` overrides). Outputs commit via tmp-file +
+``os.replace`` so an interrupted bake never leaves a readable-but-torn
+shard under the final name (readers also verify the footer crc).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from dmlc_tpu.io.shard import SHARD_SUFFIX, ShardWriter, _local_path
+
+
+def _source_digest(uri: str) -> str:
+    """Streaming blake2b over the source files (name, size, bytes) — the
+    idempotency fingerprint: same corpus bytes ⇒ same digest."""
+    from dmlc_tpu.io.filesystem import create_stream, list_split_files
+
+    h = hashlib.blake2b(digest_size=16)
+    for info in sorted(list_split_files(uri), key=lambda i: i.path.name):
+        h.update(info.path.name.encode())
+        h.update(str(info.size).encode())
+        stream = create_stream(info.path.name, "r")
+        try:
+            while True:
+                buf = stream.read(1 << 20)
+                if not buf:
+                    break
+                h.update(buf)
+        finally:
+            stream.close()
+    return h.hexdigest()
+
+
+def _part_path(dst: str, k: int, nparts: int) -> str:
+    if nparts == 1:
+        return dst
+    base = dst[: -len(SHARD_SUFFIX)] if dst.endswith(SHARD_SUFFIX) else dst
+    return "%s-%05d-of-%05d%s" % (base, k, nparts, SHARD_SUFFIX)
+
+
+def _bake_part(src: str, dst: str, data_format: str, k: int, nparts: int,
+               rows_per_window: int, nthread: Optional[int]) -> Dict:
+    from dmlc_tpu.data.parsers import create_parser
+
+    tmp = "%s.tmp.%d" % (dst, os.getpid())
+    parser = create_parser(src, k, nparts, data_format=data_format,
+                           nthread=nthread)
+    try:
+        writer = ShardWriter(tmp, rows_per_window=rows_per_window)
+        try:
+            for block in parser:
+                writer.write_block(block)
+        finally:
+            writer.close()
+        os.replace(tmp, dst)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    finally:
+        parser.close()
+    return {"path": dst, "rows": writer.rows_written,
+            "nnz": writer.nnz_written, "bytes": os.path.getsize(dst)}
+
+
+def bake_dataset(
+    src: str,
+    dst: str,
+    data_format: str = "auto",
+    nparts: int = 1,
+    rows_per_window: int = 4096,
+    nthread: Optional[int] = None,
+    force: bool = False,
+) -> Dict:
+    """Bake ``src`` (LibSVM/CSV/... — any create_parser format) into
+    ``nparts`` shard files rooted at ``dst``. Returns a summary dict
+    (``skipped`` True when the idempotency sidecar matched)."""
+    dst = _local_path(dst)
+    nparts = max(1, int(nparts))
+    if data_format == "auto":
+        # pin the resolved format into the idempotency sig so
+        # `bake x.svm` and `bake x.svm --format libsvm` are one bake
+        from dmlc_tpu.io.uri_spec import URISpec
+
+        data_format = URISpec(src).args.get("format") or "libsvm"
+    if data_format == "shard":
+        raise ValueError("source is already baked; bake reads text formats")
+    sig = {
+        "format": "dtsh-v1",
+        "src": str(src),
+        "src_digest": _source_digest(src),
+        "data_format": str(data_format),
+        "nparts": nparts,
+        "rows_per_window": int(rows_per_window),
+    }
+    sidecar = dst + ".bake.json"
+    outputs = [_part_path(dst, k, nparts) for k in range(nparts)]
+    if not force and os.path.exists(sidecar):
+        try:
+            with open(sidecar) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = None
+        if (prev and prev.get("sig") == sig
+                and all(os.path.exists(p) for p in outputs)):
+            return dict(prev, skipped=True)
+    t0 = time.monotonic()
+    if nparts == 1:
+        parts = [_bake_part(src, outputs[0], data_format, 0, 1,
+                            rows_per_window, nthread)]
+    else:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=nparts) as pool:
+            parts = list(pool.map(
+                lambda k: _bake_part(src, outputs[k], data_format, k, nparts,
+                                     rows_per_window, nthread),
+                range(nparts)))
+    elapsed = time.monotonic() - t0
+    summary = {
+        "sig": sig,
+        "outputs": parts,
+        "rows": sum(p["rows"] for p in parts),
+        "bytes": sum(p["bytes"] for p in parts),
+        "seconds": round(elapsed, 3),
+        "skipped": False,
+    }
+    tmp = "%s.tmp.%d" % (sidecar, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(summary, f, indent=1, sort_keys=True)
+    os.replace(tmp, sidecar)
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dmlc_tpu.tools bake",
+        description="bake a text corpus into columnar .dtsh shards")
+    ap.add_argument("src", help="source URI (libsvm/csv/...)")
+    ap.add_argument("dst", help="output shard path (*.dtsh)")
+    ap.add_argument("--format", default="auto", dest="data_format",
+                    help="source format (default: auto via ?format= arg)")
+    ap.add_argument("--nparts", type=int, default=1,
+                    help="parallel bake partitions → N shard files")
+    ap.add_argument("--rows-per-window", type=int, default=4096,
+                    help="rows per indexed window (shuffle/audit granule)")
+    ap.add_argument("--nthread", type=int, default=None,
+                    help="parse workers per partition")
+    ap.add_argument("--force", action="store_true",
+                    help="re-bake even when the content digest matches")
+    args = ap.parse_args(argv)
+    summary = bake_dataset(
+        args.src, args.dst, data_format=args.data_format,
+        nparts=args.nparts, rows_per_window=args.rows_per_window,
+        nthread=args.nthread, force=args.force)
+    if summary.get("skipped"):
+        print("bake: up to date (%d rows, digest %s)"
+              % (summary["rows"], summary["sig"]["src_digest"][:12]))
+        return 0
+    mb = summary["bytes"] / 1e6
+    secs = max(summary["seconds"], 1e-9)
+    print("bake: %d rows -> %d shard file(s), %.1f MB in %.2fs (%.1f MB/s)"
+          % (summary["rows"], len(summary["outputs"]), mb,
+             summary["seconds"], mb / secs))
+    return 0

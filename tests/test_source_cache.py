@@ -47,6 +47,39 @@ class TestChunkKey:
         ):
             assert other != base
 
+    def test_shard_content_folds_into_key(self, tmp_path, monkeypatch):
+        """A baked shard's key covers its *content* (footer crc) and the
+        armed shuffle seed/window: a re-bake or a re-seed must never hit
+        the stale entry (io/shard.py ``cache_token``)."""
+        from dmlc_tpu.data.row_block import RowBlockContainer
+        from dmlc_tpu.io.shard import ShardWriter
+
+        dst = str(tmp_path / "keyed.dtsh")
+
+        def bake(nrows):
+            rows = RowBlockContainer()
+            for i in range(nrows):
+                rows.push_row(float(i), [i % 3], value=[1.0 + i])
+            with ShardWriter(dst, rows_per_window=8) as w:
+                w.write_block(rows.to_block())
+
+        bake(32)
+        base = SourceCache.chunk_key(dst, 0, 4, "shard")
+        assert base == SourceCache.chunk_key(dst, 0, 4, "shard")  # stable
+        monkeypatch.setenv("DMLC_TPU_SHUFFLE", "3")
+        reseeded = SourceCache.chunk_key(dst, 0, 4, "shard")
+        monkeypatch.setenv("DMLC_TPU_SHUFFLE_WINDOW", "4")
+        rewindowed = SourceCache.chunk_key(dst, 0, 4, "shard")
+        monkeypatch.delenv("DMLC_TPU_SHUFFLE")
+        monkeypatch.delenv("DMLC_TPU_SHUFFLE_WINDOW")
+        bake(33)  # same path, new bytes
+        rebaked = SourceCache.chunk_key(dst, 0, 4, "shard")
+        keys = {base, reseeded, rewindowed, rebaked}
+        assert len(keys) == 4
+        # text sources keep their pre-shard keys (token is None)
+        assert SourceCache.chunk_key("a.svm", 0, 4, "libsvm", {"k": 1}) == \
+            SourceCache.chunk_key("a.svm", 0, 4, "libsvm", {"k": 1})
+
 
 class TestLRUBudget:
     def test_hit_miss_accounting_and_populate_once(self):
