@@ -1196,6 +1196,51 @@ def _bench_multijob(path: str) -> dict:
         reset_source_cache()
 
 
+def _bench_snapshot(path: str) -> dict:
+    """Preemption-proof snapshot overhead: the SAME ingest→SGD epoch
+    armed with async job snapshots vs unarmed (ckpt_overhead_ratio —
+    the ≤5% acceptance bar), plus the wall time a relaunched run pays
+    to restore the committed snapshot (resume_restore_s). Both are
+    sentry-gated lower-is-better."""
+    import shutil
+    import tempfile
+
+    from dmlc_tpu.collective.checkpoint import JobSnapshot
+    from dmlc_tpu.collective.snapshot import load_snapshot
+    from dmlc_tpu.models.linear import LinearLearner
+
+    def _fit_s(snapshot_uri=None):
+        learner = LinearLearner(learning_rate=0.1)
+        t0 = time.time()
+        learner.fit_uri(path, batch_size=16384, epochs=1, num_features=29,
+                        snapshot_uri=snapshot_uri)
+        return time.time() - t0
+
+    snap_dir = tempfile.mkdtemp(prefix="dmlc-bench-snap-")
+    try:
+        unarmed = [_fit_s() for _ in range(TRIALS + 1)][1:]
+        armed = [
+            _fit_s(snapshot_uri=os.path.join(snap_dir, f"t{trial}"))
+            for trial in range(TRIALS + 1)
+        ][1:]
+        base_s = statistics.median(unarmed)
+        armed_s = statistics.median(armed)
+        snap = JobSnapshot(os.path.join(snap_dir, f"t{TRIALS}"))
+        t0 = time.time()
+        version, _state, _meta = load_snapshot(snap)
+        restore_s = time.time() - t0
+        return {
+            "ckpt_overhead_ratio": round(
+                max(0.0, armed_s / base_s - 1.0), 4),
+            "resume_restore_s": round(restore_s, 4),
+            "snapshot_restored_version": version,
+            "snapshot_unarmed_trials_s": [round(v, 3) for v in unarmed],
+            "snapshot_armed_trials_s": [round(v, 3) for v in armed],
+        }
+    finally:
+        shutil.rmtree(snap_dir, ignore_errors=True)
+
+
 # keys lifted verbatim from the full record into the compact stdout line:
 # every tier median + device/collective status the verdict reads
 _COMPACT_KEYS = (
@@ -1213,7 +1258,7 @@ _COMPACT_KEYS = (
     "resident_binding_stage",
     "gbdt_fit_mrows_s",
     "sgd_e2e_multijob_mbps", "cache_cross_job_hit_ratio",
-    "sgd_goodput_ratio",
+    "sgd_goodput_ratio", "ckpt_overhead_ratio", "resume_restore_s",
     "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",
     "device_tier_probes_gbps",
     "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
@@ -1233,6 +1278,9 @@ _COMPACT_KEYS = (
 BENCH_DIRECTIONS = {
     "sgd_goodput_ratio": "higher",
     "h2d_overlap_ratio": "higher",
+    # snapshot tax and restore latency regress upward: gate them down
+    "ckpt_overhead_ratio": "lower",
+    "resume_restore_s": "lower",
 }
 
 
@@ -1486,6 +1534,7 @@ def main() -> None:
             (_bench_criteo_sgd, "criteo_sgd_error"),
             (lambda: _bench_gbdt(path), "gbdt_error"),
             (lambda: _bench_multijob(path), "multijob_error"),
+            (lambda: _bench_snapshot(path), "snapshot_error"),
         ):
             tier_probes[err_key.replace("_error", "_probe_gbps")] = (
                 _host_probe()

@@ -56,7 +56,8 @@ from dmlc_tpu.collective.device import (
     psum,
     ppermute_next,
 )
-from dmlc_tpu.collective.checkpoint import CheckpointManager
+from dmlc_tpu.collective.checkpoint import CheckpointManager, JobSnapshot
+from dmlc_tpu.collective.snapshot import Snapshotter, load_snapshot
 from dmlc_tpu.collective.socket_engine import SocketEngine
 from dmlc_tpu.io.serializer import load_obj, save_obj
 from dmlc_tpu.io.stream import MemoryStream

@@ -1,0 +1,296 @@
+"""Async job snapshotting: capture on the step path, commit off it.
+
+:class:`~dmlc_tpu.collective.checkpoint.JobSnapshot` gives the durable
+two-phase-commit format; this module keeps it off the training step
+path. The split:
+
+- :meth:`Snapshotter.capture` runs at the epoch boundary on the
+  training thread: it materializes a host copy of the state tree
+  (timed into ``dmlc_snap_capture_ns`` — this is the *donation-safe*
+  copy: the next epoch's donating steps are free to invalidate the
+  device buffers once capture returned) and hands it to the writer.
+- A background writer thread serializes and two-phase-commits the
+  snapshot (``dmlc_snap_write_ns``), completely off the step path. The
+  goodput ledger's ``checkpoint`` stage reads ``dmlc_snap_capture_ns``,
+  so the overhead the training loop actually pays is first-class in the
+  stall attribution.
+
+The writer holds a single *newest-wins* slot, not a queue: if epoch N's
+snapshot is still writing when N+1's capture lands, N+1 replaces any
+not-yet-started N (``dmlc_snap_skipped_total``) — a slow filesystem
+can only ever delay durability, never build an unbounded backlog.
+Because a skip can hit one rank and not another, version numbers are
+*epoch-derived* (not a local commit counter): a skipped epoch leaves a
+gap in that rank's version sequence, the same epoch maps to the same
+version on every rank, and rank 0's part barrier detects a peer that
+moved past the awaited version (its ``snap.rank{R}.frontier`` marker)
+and abandons the superseded commit instead of stalling on a part that
+will never be written.
+
+Cadence: every ``every_epochs`` epoch boundary commits, and the
+``DMLC_TPU_SNAP_EVERY_S`` wall-clock trigger promotes a boundary to a
+commit when enough time passed since the last one. On a preemption
+notice, :meth:`Snapshotter.finalize` enqueues the freshest captured
+state and drains the writer within the grace window
+(``DMLC_TPU_PREEMPT_DEADLINE_S``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+from dmlc_tpu import obs
+from dmlc_tpu.collective.checkpoint import JobSnapshot, _to_host
+from dmlc_tpu.utils.logging import log_info, log_warning
+
+
+class Snapshotter:
+    """Background two-phase-commit writer over a :class:`JobSnapshot`.
+
+    ``capture`` is the only method the training loop calls per epoch;
+    ``finalize`` is the preemption path; ``close`` drains and stops the
+    writer. All public methods are safe to call from the training
+    thread only (the writer thread is internal).
+    """
+
+    def __init__(
+        self,
+        snap: JobSnapshot,
+        every_epochs: int = 1,
+        every_s: Optional[float] = None,
+        install_sigterm: bool = True,
+    ):
+        from dmlc_tpu.params import knobs
+        from dmlc_tpu.resilience import preempt
+
+        self._snap = snap
+        # epoch->version mapping base: versions are derived from the
+        # captured epoch (version_base + epoch - epoch_base) so every
+        # rank names the same epoch's part with the same version number,
+        # even when newest-wins coalescing skips an epoch on one rank
+        # but not another — a skip leaves a gap in that rank's version
+        # sequence instead of silently pairing different epochs under
+        # one manifest (and wedging rank 0's part barrier on a version
+        # the peer never writes). Re-based by mark_restored after a
+        # resume.
+        self._version_base = snap.version_number
+        self._epoch_base = -1
+        self._every_epochs = max(0, int(every_epochs))
+        self._every_s = knobs.snap_every_s() if every_s is None else max(
+            0.0, float(every_s))
+        reg = obs.registry()
+        self._h_capture = reg.histogram(
+            "dmlc_snap_capture_ns",
+            "per-snapshot device->host state capture on the training "
+            "thread (the goodput ledger's checkpoint stage)")
+        self._h_write = reg.histogram(
+            "dmlc_snap_write_ns",
+            "per-snapshot serialize + two-phase commit on the writer "
+            "thread (off the step path)")
+        self._m_commits = reg.counter(
+            "dmlc_snap_commits_total", "job snapshots committed")
+        self._m_skipped = reg.counter(
+            "dmlc_snap_skipped_total",
+            "captured snapshots superseded before the writer started "
+            "them (newest-wins slot)")
+        self._m_bytes = reg.counter(
+            "dmlc_snap_bytes_total",
+            "serialized snapshot part bytes written by this rank")
+        self._cond = threading.Condition()
+        self._slot: Optional[Tuple[int, Any, Optional[Dict]]] = None
+        self._pending: Optional[Tuple[int, Any, Optional[Dict]]] = None
+        self._writing = False
+        self._stop = False
+        self._committed_epoch = -1
+        self._last_commit_t = time.monotonic()
+        self.last_error: Optional[BaseException] = None
+        if install_sigterm:
+            preempt.install()
+        self._thread = threading.Thread(
+            target=self._run, name="dmlc-snap-writer", daemon=True)
+        self._thread.start()
+
+    # ---- training-thread surface ---------------------------------------
+    def capture(
+        self,
+        epoch: int,
+        state: Union[Any, Callable[[], Any]],
+        meta: Optional[Dict[str, Any]] = None,
+        force: bool = False,
+    ) -> bool:
+        """Host-copy ``state`` at an epoch boundary; maybe enqueue a commit.
+
+        ``state`` may be the tree itself or a zero-arg callable building
+        it (evaluated here, on the training thread, so the builder may
+        read live device buffers). The host copy always becomes the
+        freshest *pending* snapshot — a later preemption finalize can
+        commit it even when the cadence said "not this epoch". Returns
+        True when a commit was enqueued.
+        """
+        t0 = time.monotonic_ns()
+        host = _to_host(state() if callable(state) else state)
+        self._h_capture.observe(time.monotonic_ns() - t0)
+        with self._cond:
+            self._pending = (epoch, host, meta)
+            if force or self._due_locked(epoch):
+                self._enqueue_locked()
+                return True
+        return False
+
+    def finalize(self, deadline_s: Optional[float] = None) -> bool:
+        """Just-in-time commit for a preemption notice.
+
+        Enqueues the freshest captured state (unless that epoch already
+        committed) and waits for the writer to drain, at most
+        ``deadline_s`` seconds (default: the remaining preemption grace
+        window). Returns True when everything captured is durably
+        committed.
+        """
+        from dmlc_tpu.resilience import preempt
+
+        if deadline_s is None:
+            deadline_s = preempt.deadline_remaining()
+        with self._cond:
+            if (self._pending is not None
+                    and self._pending[0] > self._committed_epoch
+                    and (self._slot is None
+                         or self._slot[0] < self._pending[0])):
+                self._enqueue_locked()
+            drained = self._cond.wait_for(
+                lambda: self._slot is None and not self._writing,
+                timeout=max(0.0, deadline_s))
+        if not drained:
+            log_warning(
+                "snapshot finalize missed the %.1fs preemption deadline; "
+                "resume will use the last committed version", deadline_s)
+        return drained and self.last_error is None
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the writer is idle (tests, clean shutdown)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self._slot is None and not self._writing,
+                timeout=timeout)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain and stop the writer (the fit loop's ``finally`` path).
+
+        Under a pending preemption the drain budget is the *remaining*
+        grace window: :meth:`finalize` already spent its share waiting,
+        and re-waiting the full timeout here would delay the exit-75
+        relaunch past the deadline. The writer thread is a daemon — an
+        in-flight commit it never finishes is a torn (ignored) version.
+        """
+        from dmlc_tpu.resilience import preempt
+
+        if preempt.requested():
+            timeout = min(timeout, preempt.deadline_remaining())
+        self.drain(timeout)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    def mark_restored(self, epoch: int) -> None:
+        """After a resume: seed the cadence and the epoch->version base.
+
+        Every rank restores the same committed manifest, so anchoring
+        the mapping at (restored version, restored epoch) keeps version
+        numbers rank-consistent across relaunches.
+        """
+        with self._cond:
+            self._committed_epoch = epoch
+            self._last_commit_t = time.monotonic()
+            self._version_base = self._snap.version_number
+            self._epoch_base = epoch
+
+    @property
+    def committed_epoch(self) -> int:
+        with self._cond:
+            return self._committed_epoch
+
+    @property
+    def version_number(self) -> int:
+        return self._snap.version_number
+
+    # ---- internals -----------------------------------------------------
+    def _due_locked(self, epoch: int) -> bool:
+        if self._every_epochs > 0 and epoch % self._every_epochs == 0:
+            return True
+        return (self._every_s > 0
+                and time.monotonic() - self._last_commit_t >= self._every_s)
+
+    def _enqueue_locked(self) -> None:
+        if self._slot is not None:
+            self._m_skipped.inc()
+        self._slot = self._pending
+        self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._slot is not None or self._stop)
+                if self._slot is None:
+                    return
+                epoch, state, meta = self._slot
+                self._slot = None
+                self._writing = True
+                version = self._version_base + (epoch - self._epoch_base)
+            try:
+                t0 = time.monotonic_ns()
+                info = dict(meta or {})
+                info["epoch"] = epoch
+                self._snap.commit(state, meta=info, version=version)
+                self._h_write.observe(time.monotonic_ns() - t0)
+                self._m_commits.inc()
+                self._m_bytes.inc(self._snap.last_part_bytes)
+                with self._cond:
+                    self._committed_epoch = max(self._committed_epoch, epoch)
+                    self._last_commit_t = time.monotonic()
+                    self.last_error = None
+            except BaseException as err:  # writer thread must not die
+                self.last_error = err
+                log_warning("job snapshot commit failed (epoch %d): %s",
+                            epoch, err)
+            finally:
+                with self._cond:
+                    self._writing = False
+                    self._cond.notify_all()
+
+
+def load_snapshot(snap: JobSnapshot):
+    """Restore the newest committed snapshot; re-arm the audit plane.
+
+    Returns ``(version, state, meta)`` — ``(0, None, {})`` when the
+    directory holds no committed snapshot yet. When the state tree
+    carries an ``audit`` section (exported digest-chain heads), it is
+    re-injected into the process auditor so the resumed run's chains
+    extend the interrupted run's — the cross-rank audit plane then
+    verifies the resumed run matches an uninterrupted one.
+    """
+    t0 = time.monotonic_ns()
+    version, state, meta = snap.restore()
+    obs.registry().histogram(
+        "dmlc_snap_restore_ns",
+        "manifest + part read and state restore on resume",
+    ).observe(time.monotonic_ns() - t0)
+    if not version or state is None:
+        return version, state, meta
+    audit_restored = False
+    audit_state = state.get("audit") if isinstance(state, dict) else None
+    if audit_state:
+        from dmlc_tpu.obs.audit import auditor
+
+        audit_restored = auditor().restore_state(audit_state)
+    from dmlc_tpu.obs import flight
+
+    flight.record_event("resume.verified", version=version,
+                        epoch=(meta or {}).get("epoch", -1),
+                        audit=audit_restored)
+    log_info("resumed from job snapshot v%d (epoch %s, audit %s)",
+             version, (meta or {}).get("epoch", "?"),
+             "re-armed" if audit_restored else "fresh")
+    return version, state, meta

@@ -249,6 +249,32 @@ def register_job(
     return reply
 
 
+def job_frontier(client: DispatcherClient, name: str) -> Dict:
+    """Fetch job ``name``'s ledger frontier (epoch + acked seqs) over
+    RPC — what a job snapshot persists so a relaunched run never
+    re-leases settled chunks."""
+    reply = client.call({"op": "job", "name": str(name),
+                         "export_frontier": True})
+    if not reply.get("ok"):
+        raise DMLCError(
+            "frontier export for job %r failed: %s"
+            % (name, reply.get("error")))
+    return {"epoch": reply["epoch"], "acked": reply["acked"]}
+
+
+def restore_job_frontier(client: DispatcherClient, name: str,
+                         frontier: Dict) -> int:
+    """Re-seed job ``name``'s ledger from a snapshotted frontier over
+    RPC; returns the number of seqs settled as acked."""
+    reply = client.call({"op": "job", "name": str(name),
+                         "restore_frontier": dict(frontier)})
+    if not reply.get("ok"):
+        raise DMLCError(
+            "frontier restore for job %r failed: %s"
+            % (name, reply.get("error")))
+    return int(reply.get("acked", 0))
+
+
 class DataDispatcher:
     """Registry of data workers + per-job lease tables over one fleet.
 
@@ -476,6 +502,55 @@ class DataDispatcher:
             self._all_acked.clear()
             return job["epoch"]
 
+    def export_frontier(self, name: str) -> Dict:
+        """Job ``name``'s resumable ledger frontier for a job snapshot:
+        the epoch counter and the seqs settled (acked) so far. Leased or
+        delivered-but-unacked chunks are deliberately NOT exported — a
+        restart replays them (at-least-once lease, exactly-once ack)."""
+        with self._lock:
+            jid = self._job_names.get(str(name))
+            check(jid is not None, "unknown job %r", name)
+            job = self._jobs[jid]
+            return {
+                "epoch": job["epoch"],
+                "acked": [c["seq"] for c in job["chunks"]
+                          if c["state"] == _ACKED],
+            }
+
+    def restore_frontier(self, name: str, frontier: Dict) -> int:
+        """Re-seed job ``name``'s ledger from a snapshot frontier: the
+        epoch counter is restored and every snapshotted acked seq is
+        settled — those chunks are never leased again (exactly-once).
+        Everything else returns to queued, dropping the dead attempt's
+        leases. Returns the count of seqs marked acked."""
+        epoch = max(1, int(frontier.get("epoch", 1)))
+        acked = {int(s) for s in frontier.get("acked", ())}
+        with self._lock:
+            jid = self._job_names.get(str(name))
+            check(jid is not None, "unknown job %r", name)
+            job = self._jobs[jid]
+            bad = acked - {c["seq"] for c in job["chunks"]}
+            check(not bad,
+                  "frontier for job %r names unknown seqs %s", name,
+                  sorted(bad)[:8])
+            for c in job["chunks"]:
+                c["state"] = _ACKED if c["seq"] in acked else _QUEUED
+                c["worker"] = -1
+                c["client"] = -1
+                c["deadline"] = 0.0
+                c["flow"] = 0
+            job["epoch"] = epoch
+            job["granted"] = 0
+            if all(c["state"] == _ACKED for c in job["chunks"]):
+                job["all_acked"].set()
+            else:
+                job["all_acked"].clear()
+                self._all_acked.clear()
+            self._update_all_acked_locked()
+        record_event("dispatch.frontier_restore", job=str(name),
+                     epoch=epoch, acked=len(acked))
+        return len(acked)
+
     def drain_worker(self, wid: int) -> None:
         """Mark worker ``wid`` draining for scale-down: it gets no new
         leases, and once its in-flight leases settle, its next idle
@@ -606,6 +681,12 @@ class DataDispatcher:
             return {"ok": True, "removed": self.remove_job(name)}
         if obj.get("reset"):
             return {"ok": True, "epoch": self.reset_job(name)}
+        if obj.get("export_frontier"):
+            return dict(self.export_frontier(name), ok=True)
+        frontier = obj.get("restore_frontier")
+        if frontier is not None:
+            return {"ok": True,
+                    "acked": self.restore_frontier(name, frontier)}
         uri = obj.get("uri")
         if uri is None:
             return {"ok": False, "error": "job registration needs a uri"}

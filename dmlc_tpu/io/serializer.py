@@ -98,10 +98,13 @@ def _save(s: Stream, obj: Any) -> None:
             _save(s, item)
     elif isinstance(obj, np.ndarray):
         _tag(s, _T_NDARRAY)
+        # record the ORIGINAL shape: ascontiguousarray promotes 0-d
+        # arrays to (1,), which would silently rewrite scalar params
+        # (e.g. a bias of shape ()) across a save/load round trip
         arr = np.ascontiguousarray(obj)
         s.write_bytes_prefixed(str(arr.dtype).encode("ascii"))
-        s.write_uint64(arr.ndim)
-        for dim in arr.shape:
+        s.write_uint64(obj.ndim)
+        for dim in obj.shape:
             s.write_uint64(dim)
         s.write(arr.tobytes())
     elif isinstance(obj, (np.integer,)):

@@ -571,6 +571,41 @@ class ShardParser:
             "shuffle_window": int(self._unit),
         }
 
+    # ---- job-snapshot state ---------------------------------------------
+    def snapshot_state(self) -> dict:
+        """Resumable read-plan state for a job snapshot: everything the
+        permutation is a pure function of. The order itself is *not*
+        serialized — resume re-derives it from (seed, epoch) and
+        re-slices for the current partition, so the snapshot stays tiny
+        and a restore is provably the same plan, not a copied one."""
+        return {
+            "uri": self.uri,
+            "seed": int(self._seed),
+            "window": int(self._unit),
+            "epoch": int(self._epoch),
+            "part": int(self._part),
+            "nparts": int(self._nparts),
+        }
+
+    def restore_state(self, st: dict) -> None:
+        """Jump to the epoch boundary *after* ``st["epoch"]`` (snapshots
+        are taken at epoch boundaries: the snapshotted epoch finished, so
+        the resumed run starts the next one). Re-derives the epoch
+        permutation from the restored (seed, epoch) and re-slices it for
+        this parser's *current* partition — resuming with a different
+        part/nparts split composes the same way elastic re-sharding
+        does."""
+        check(st.get("uri", self.uri) == self.uri,
+              "snapshot read-plan is for %s, not %s",
+              st.get("uri"), self.uri)
+        check(int(st.get("window", self._unit)) == self._unit,
+              "snapshot shuffle window %s != configured %d (the epoch "
+              "permutation would differ)", st.get("window"), self._unit)
+        self._seed = int(st["seed"])
+        self._epoch = int(st["epoch"]) + 1
+        self._epoch_base = self._seq
+        self._reorder()
+
     def close(self) -> None:
         if self._closed:
             return

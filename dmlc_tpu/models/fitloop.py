@@ -67,7 +67,8 @@ class FitLoopObs:
 
     def end_epoch(self, epoch: int, nstep: int, t0_ns: int,
                   loss: Optional[float], feed=None,
-                  log_every: int = 0, params=None) -> Optional[dict]:
+                  log_every: int = 0, params=None,
+                  snapshotter=None, snap_state=None) -> Optional[dict]:
         """Close one epoch: fit metrics, a goodput-ledger window fed to
         the watchdog, the unified stall/goodput log line (every
         ``log_every``-th epoch), and the registry export. Returns the
@@ -76,7 +77,15 @@ class FitLoopObs:
         ``params`` (optional dict of device arrays) extends the audit
         model-digest chain over a strided parameter sample — one small
         epoch-cadence fetch that doubles as the numeric-health sentinel
-        (non-finite counts feed the watchdog's ``numeric`` alert)."""
+        (non-finite counts feed the watchdog's ``numeric`` alert).
+
+        ``snapshotter`` + ``snap_state`` (a zero-arg state-tree builder)
+        arm job snapshotting: after the audit roll, the boundary's state
+        is host-captured and handed to the async writer
+        (collective/snapshot.py) — capture after the roll so the
+        exported audit state describes the *closed* epoch and a resume
+        re-arms the chains exactly where an uninterrupted run would
+        be."""
         self.h_epoch.observe(time.monotonic_ns() - t0_ns)
         self.m_steps.inc(nstep)
         self.m_epochs.inc()
@@ -102,4 +111,6 @@ class FitLoopObs:
         # rode the heartbeat; this also runs the epoch-over-epoch
         # self-check (first divergence writes the replay bundle)
         self.audit.roll_epoch(epoch)
+        if snapshotter is not None and snap_state is not None:
+            snapshotter.capture(epoch, snap_state)
         return win

@@ -237,9 +237,16 @@ class FMLearner:
         )
         self._step = None
 
-    def fit_feed(self, feed, epochs: int = 1, log_every: int = 0):
+    def fit_feed(self, feed, epochs: int = 1, log_every: int = 0,
+                 snapshotter=None, start_epoch: int = 0, history=None):
         """Train over a csr DeviceFeed; ``log_every`` (epochs) also logs
-        the feed's per-stage stall breakdown (device.feed.stall_breakdown)."""
+        the feed's per-stage stall breakdown (device.feed.stall_breakdown).
+
+        ``snapshotter``/``start_epoch``/``history`` follow the same
+        preemption-proof contract as LinearLearner.fit_feed: epoch
+        boundaries hand a state tree to the async snapshot writer, a
+        preemption notice finalizes a just-in-time commit and raises
+        ``Preempted`` (see docs/robustness.md "Preemption & resume")."""
         from dmlc_tpu.models.linear import EpochMetrics
 
         check(feed.spec.layout == "csr", "FM consumes csr batches")
@@ -251,12 +258,14 @@ class FMLearner:
         )
         from dmlc_tpu import obs
         from dmlc_tpu.models.fitloop import FitLoopObs
+        from dmlc_tpu.resilience import Preempted, preempt
 
         fl = FitLoopObs("fm")
-        history = []
-        for epoch in range(epochs):
+        history = list(history) if history else []
+        for epoch in range(start_epoch, epochs):
             acc = EpochMetrics()
             nstep = 0
+            preempted = False
             t0 = time.monotonic_ns()
             with obs.span("epoch", model="fm", epoch=epoch):
                 for batch in feed:
@@ -269,13 +278,51 @@ class FMLearner:
                     acc.add(metrics)
                     fl.note_step()
                     nstep += 1
+                    if snapshotter is not None and preempt.poll():
+                        preempted = True
+                        break
+            if preempted:
+                snapshotter.finalize()
+                raise Preempted(
+                    "preempted in epoch %d after %d steps" % (epoch, nstep))
             loss = acc.mean_loss()
             history.append(loss)
-            fl.end_epoch(epoch, nstep, t0, loss, feed=feed,
-                         log_every=log_every, params=self.params)
+            fl.end_epoch(
+                epoch, nstep, t0, loss, feed=feed,
+                log_every=log_every, params=self.params,
+                snapshotter=snapshotter,
+                snap_state=(None if snapshotter is None else
+                            lambda e=epoch: self._snapshot_state(
+                                feed, e, history)),
+            )
             if epoch + 1 < epochs:
                 feed.before_first()
         return history
+
+    def _snapshot_state(self, feed, epoch: int, history) -> Dict:
+        """Job-snapshot state tree at one epoch boundary (see
+        LinearLearner._snapshot_state — FM has no velocity term)."""
+        from dmlc_tpu.obs import audit
+
+        state = {
+            "model": {"params": dict(self.params)},
+            "epoch": int(epoch),
+            "history": [float(x) for x in history],
+            "rng": None,
+            "audit": audit.auditor().export_state(),
+        }
+        parser = getattr(feed, "_parser", None)
+        if hasattr(parser, "snapshot_state"):
+            state["data"] = {"parser": parser.snapshot_state()}
+        return state
+
+    def restore_snapshot_model(self, model: Dict) -> None:
+        """Re-place a snapshot's host FM params on device (mesh-placed
+        when this learner runs on a mesh)."""
+        self.params = {k: jnp.asarray(v) for k, v in model["params"].items()}
+        if self.mesh is not None:
+            self.params = shard_params(
+                self.params, self.mesh, rules=FM_PARTITION_RULES)
 
     def predict_batch(self, batch) -> np.ndarray:
         num_rows = int(batch["label"].shape[0])

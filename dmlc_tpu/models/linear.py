@@ -667,13 +667,28 @@ class LinearLearner:
         num_parts: Optional[int] = None,
         drop_remainder: bool = False,
         log_every: int = 0,
+        snapshot_uri: Optional[str] = None,
+        resume: bool = False,
+        snap_every_epochs: int = 1,
     ):
         """One call from data URI to fitted params: InputSplit part →
         parser → DeviceFeed → fit_feed. The part defaults to this
         worker's collective rank/world (each worker reads its own byte
         range — the reference's ``InputSplit::Create(uri, rank, world)``
         contract), so the same line works single-process, on a mesh, or
-        under dmlc-submit with the socket engine."""
+        under dmlc-submit with the socket engine.
+
+        ``snapshot_uri`` arms preemption-proof job snapshots: every
+        ``snap_every_epochs`` epoch boundary (plus the
+        ``DMLC_TPU_SNAP_EVERY_S`` wall-clock trigger) commits model +
+        optimizer + read-plan + audit state through the async
+        two-phase-commit writer, and a SIGTERM mid-epoch finalizes a
+        just-in-time snapshot and exits with the relaunch code.
+        ``resume=True`` loads the newest committed snapshot first: the
+        model restores, the shuffle re-derives the interrupted epoch
+        permutation, the audit chains re-arm, and training continues at
+        the next epoch — bit-identical to a run that was never killed
+        (see docs/robustness.md "Preemption & resume")."""
         from dmlc_tpu import collective
         from dmlc_tpu.data import create_parser
         from dmlc_tpu.device import BatchSpec, DeviceFeed
@@ -690,10 +705,64 @@ class LinearLearner:
                       num_features=nf, drop_remainder=drop_remainder),
             mesh=self.mesh,
         )
-        return self.fit_feed(feed, epochs=epochs, log_every=log_every)
+        if snapshot_uri is None:
+            check(not resume, "resume=True requires snapshot_uri")
+            return self.fit_feed(feed, epochs=epochs, log_every=log_every)
+        from dmlc_tpu.collective import JobSnapshot, Snapshotter, \
+            load_snapshot
 
-    def fit_feed(self, feed, epochs: int = 1, log_every: int = 0):
-        """Train over a DeviceFeed for N epochs; returns per-epoch losses."""
+        snap = JobSnapshot(snapshot_uri, rank=collective.rank(),
+                           world_size=collective.world_size())
+        start_epoch = 0
+        history = None
+        snapshotter = Snapshotter(snap, every_epochs=snap_every_epochs)
+        try:
+            if resume:
+                version, state, _meta = load_snapshot(snap)
+                if version and state is not None:
+                    self._restore_snapshot_model(state["model"])
+                    start_epoch = int(state.get("epoch", -1)) + 1
+                    history = list(state.get("history", ()))
+                    pst = (state.get("data") or {}).get("parser")
+                    parser = getattr(feed, "_parser", None)
+                    if pst and hasattr(parser, "restore_state"):
+                        parser.restore_state(pst)
+                    snapshotter.mark_restored(start_epoch - 1)
+            return self.fit_feed(
+                feed, epochs=epochs, log_every=log_every,
+                snapshotter=snapshotter, start_epoch=start_epoch,
+                history=history,
+            )
+        finally:
+            snapshotter.close()
+
+    def _restore_snapshot_model(self, model: Dict) -> None:
+        """Re-place a snapshot's host model/optimizer state on device
+        (mesh-placed when this learner runs spmd on a mesh)."""
+        self.params = {k: jnp.asarray(v) for k, v in model["params"].items()}
+        velocity = model.get("velocity")
+        if velocity is not None:
+            self.velocity = {k: jnp.asarray(v) for k, v in velocity.items()}
+        if self.mesh is not None and self.sync == "spmd":
+            self.params = shard_params(
+                self.params, self.mesh, rules=LINEAR_PARTITION_RULES)
+            if self.velocity is not None:
+                self.velocity = shard_params(
+                    self.velocity, self.mesh, rules=LINEAR_PARTITION_RULES)
+
+    def fit_feed(self, feed, epochs: int = 1, log_every: int = 0,
+                 snapshotter=None, start_epoch: int = 0, history=None):
+        """Train over a DeviceFeed for N epochs; returns per-epoch losses.
+
+        With ``snapshotter`` armed the loop polls for preemption notices
+        between steps (SIGTERM via resilience/preempt.py, or the
+        injectable ``preempt.notice`` faultpoint): a notice stops the
+        partial epoch, finalizes the freshest epoch-boundary snapshot
+        within the grace window, and raises
+        :class:`~dmlc_tpu.resilience.Preempted` so the process exits
+        with the launcher's relaunch code. ``start_epoch``/``history``
+        continue a resumed run (the returned history covers ALL epochs,
+        restored ones included)."""
         from dmlc_tpu.utils.logging import log_info
 
         layout = feed.spec.layout
@@ -707,12 +776,14 @@ class LinearLearner:
         )
         from dmlc_tpu import obs
         from dmlc_tpu.models.fitloop import FitLoopObs
+        from dmlc_tpu.resilience import Preempted, preempt
 
         fl = FitLoopObs("linear")
-        history = []
-        for epoch in range(epochs):
+        history = list(history) if history else []
+        for epoch in range(start_epoch, epochs):
             acc = EpochMetrics()
             nstep = 0
+            preempted = False
             t0 = time.monotonic_ns()
             with obs.span("epoch", model="linear", epoch=epoch):
                 for batch in feed:
@@ -733,13 +804,51 @@ class LinearLearner:
                             "epoch %d step %d loss %.6f",
                             epoch, nstep, acc.mean_loss(),
                         )
+                    if snapshotter is not None and preempt.poll():
+                        preempted = True
+                        break
+            if preempted:
+                # a partial epoch is never snapshotted (resume replays it
+                # in full — that is what keeps the relaunch bit-identical);
+                # commit the freshest epoch-boundary capture and exit with
+                # the relaunch code
+                snapshotter.finalize()
+                raise Preempted(
+                    "preempted in epoch %d after %d steps; last committed "
+                    "snapshot epoch %d"
+                    % (epoch, nstep, snapshotter.committed_epoch))
             loss = acc.mean_loss()
             history.append(loss)
-            fl.end_epoch(epoch, nstep, t0, loss, feed=feed,
-                         log_every=log_every, params=self.params)
+            fl.end_epoch(
+                epoch, nstep, t0, loss, feed=feed,
+                log_every=log_every, params=self.params,
+                snapshotter=snapshotter,
+                snap_state=(None if snapshotter is None else
+                            lambda e=epoch: self._snapshot_state(
+                                feed, e, history)),
+            )
             if epoch + 1 < epochs:
                 feed.before_first()
         return history
+
+    def _snapshot_state(self, feed, epoch: int, history) -> Dict:
+        """The job-snapshot state tree at one epoch boundary (built on
+        the training thread; the snapshotter host-copies it before the
+        next epoch's donating steps run)."""
+        from dmlc_tpu.obs import audit
+
+        state = {
+            "model": {"params": dict(self.params),
+                      "velocity": dict(self.velocity or {})},
+            "epoch": int(epoch),
+            "history": [float(x) for x in history],
+            "rng": None,  # SGD path draws no step-time randomness
+            "audit": audit.auditor().export_state(),
+        }
+        parser = getattr(feed, "_parser", None)
+        if hasattr(parser, "snapshot_state"):
+            state["data"] = {"parser": parser.snapshot_state()}
+        return state
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         check(self.params is not None, "model not fitted")

@@ -178,6 +178,12 @@ class _NoopAuditor:
     def snapshot(self):
         return {}
 
+    def export_state(self):
+        return {}
+
+    def restore_state(self, st):
+        return False
+
 
 NOOP_AUDITOR = _NoopAuditor()
 
@@ -383,6 +389,58 @@ class Auditor:
                 "chains": chains,
                 "divergences": len(self.divergences),
             }
+
+    def export_state(self) -> Dict:
+        """Resumable chain state for a job snapshot (JobSnapshot part).
+
+        Full-fidelity where :meth:`export` windows: the model digest
+        chain + rolling head (so a resumed run's final model head equals
+        an uninterrupted run's) and the archived previous-epoch data
+        chains (so the first resumed ``roll_epoch`` still self-checks
+        against the interrupted run when the shard signature matches).
+        Empty dict when nothing was digested yet.
+        """
+        with self._lock:
+            model = self._chains.get("model")
+            if model is None and not self._prev and self._prev_epoch < 0:
+                return {}
+            return {
+                "epoch": self.epoch,
+                "every": self.every,
+                "model": {
+                    "chain": dict(model or {}),
+                    "head": self._heads.get("model", ""),
+                },
+                "prev": {s: dict(c) for s, c in self._prev.items()},
+                "prev_epoch": self._prev_epoch,
+                "prev_shard": self._prev_shard,
+            }
+
+    def restore_state(self, st: Dict) -> bool:
+        """Re-inject chain state exported by :meth:`export_state`.
+
+        Call *after* the data parser stamped :meth:`set_shard` for the
+        resumed epoch — restore only refills chain/archive state, it
+        never rewrites the live shard signature. Returns True when
+        state was applied.
+        """
+        if not st:
+            return False
+        with self._lock:
+            model = st.get("model") or {}
+            chain = {int(k): v for k, v in (model.get("chain") or {}).items()}
+            if chain:
+                self._chains["model"] = chain
+            if model.get("head"):
+                self._heads["model"] = model["head"]
+            self._prev = {
+                stage: {int(k): v for k, v in c.items()}
+                for stage, c in (st.get("prev") or {}).items()
+            }
+            self._prev_epoch = int(st.get("prev_epoch", -1))
+            self._prev_shard = str(st.get("prev_shard", ""))
+            self.epoch = int(st.get("epoch", self.epoch))
+        return True
 
     def snapshot(self) -> Dict:
         """Local view for logs/tests: chain lengths + divergence list."""

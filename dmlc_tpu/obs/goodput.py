@@ -30,7 +30,10 @@ Stage budgets come from the flat registry deltas (metrics.flat_values):
   matrix) falls back to ``dmlc_fit_epoch_ns``.
 - ``collective``  — ``dmlc_collective_op_ns`` (socket/D2H fallback ops;
   in-graph psums live inside the device step)
-- ``checkpoint``  — reserved (no timer today; always 0.0)
+- ``checkpoint``  — ``dmlc_snap_capture_ns`` (job-snapshot state
+  capture on the training thread; the serialize + two-phase commit runs
+  on the async writer thread off the step path, so this stage staying
+  tiny is the *proof* the snapshotter is off the critical path)
 - ``host_wait``   — ``dmlc_feed_host_wait_ns`` (consumer starved by the
   host producer — the classic input-bound signature)
 - ``idle``        — residual wall not covered by the serial-stage sum
@@ -83,6 +86,7 @@ _STAGE_SOURCES = {
     "host_wait": "dmlc_feed_host_wait_ns",
     "device_step": "dmlc_feed_consume_ns",
     "collective": "dmlc_collective_op_ns",
+    "checkpoint": "dmlc_snap_capture_ns",
 }
 _FIT_EPOCH = "dmlc_fit_epoch_ns"
 
@@ -135,7 +139,6 @@ def stage_seconds(delta: Dict[str, float]) -> Dict[str, float]:
     out = {}
     for stage, family in _STAGE_SOURCES.items():
         out[stage] = _sum_named(delta, family, ":sum") / 1e9
-    out["checkpoint"] = 0.0
     if out["device_step"] <= 0.0:
         # feed-less fits (GBDT's binned matrix) time the whole fit as
         # one epoch histogram; book it as device-step work

@@ -66,6 +66,19 @@ The resilience layer (dmlc_tpu/resilience) adds five more:
   ``CheckpointManager`` commits to when the primary URI exhausts its
   retry budget (empty = no fallback, the default)
 
+Preemption-proof snapshots (collective/snapshot.py +
+resilience/preempt.py, see docs/robustness.md "Preemption & resume")
+add two more:
+
+- ``DMLC_TPU_SNAP_EVERY_S`` — wall-clock job-snapshot cadence in
+  seconds: with a ``Snapshotter`` armed, an epoch boundary also commits
+  when this much time passed since the last committed snapshot, on top
+  of the epoch cadence (0 = epoch cadence only, the default)
+- ``DMLC_TPU_PREEMPT_DEADLINE_S`` — seconds the preemption handler
+  budgets between a preemption notice (SIGTERM or injected
+  ``preempt.notice``) and process exit; the just-in-time snapshot
+  commit must land inside it (default 30)
+
 Elastic membership (tracker/rendezvous.py + collective, see
 docs/robustness.md "Elastic membership") adds four more:
 
@@ -330,6 +343,22 @@ def ckpt_fallback_uri() -> str:
     URI exhaust their retry budget (``DMLC_TPU_CKPT_FALLBACK_URI``;
     empty = no fallback, the default)."""
     return get_env("DMLC_TPU_CKPT_FALLBACK_URI", "")
+
+
+def snap_every_s() -> float:
+    """Wall-clock job-snapshot cadence (``DMLC_TPU_SNAP_EVERY_S``,
+    default 0 = epoch cadence only): with a ``Snapshotter`` armed, an
+    epoch boundary also commits when this many seconds passed since the
+    last committed snapshot, whatever the epoch cadence says."""
+    return max(0.0, float(get_env("DMLC_TPU_SNAP_EVERY_S", 0.0)))
+
+
+def preempt_deadline_s() -> float:
+    """Seconds budgeted between a preemption notice (SIGTERM or an
+    injected ``preempt.notice`` fault) and process exit
+    (``DMLC_TPU_PREEMPT_DEADLINE_S``, default 30): the just-in-time
+    coordinated snapshot commit must land inside this window."""
+    return max(0.0, float(get_env("DMLC_TPU_PREEMPT_DEADLINE_S", 30.0)))
 
 
 def elastic_enabled() -> bool:
@@ -661,6 +690,9 @@ KNOWN_KNOBS = (
     "DMLC_TPU_FAULTS",
     "DMLC_TPU_HEDGE_S",
     "DMLC_TPU_CKPT_FALLBACK_URI",
+    # preemption-proof snapshots
+    "DMLC_TPU_SNAP_EVERY_S",
+    "DMLC_TPU_PREEMPT_DEADLINE_S",
     # elastic membership
     "DMLC_TPU_ELASTIC",
     "DMLC_TPU_ELASTIC_WINDOW_S",

@@ -11,6 +11,10 @@ Three pieces, one contract:
   when disabled.
 - :mod:`~dmlc_tpu.resilience.hedge` — :func:`hedged_call` backup
   requests for tail-latency degradation (``DMLC_TPU_HEDGE_S``).
+- :mod:`~dmlc_tpu.resilience.preempt` — SIGTERM preemption notices,
+  the :data:`EXIT_PREEMPTED` relaunch contract, and the injectable
+  ``preempt.notice`` faultpoint (see docs/robustness.md "Preemption &
+  resume").
 
 See ``docs/robustness.md`` for the fault model, the faultpoint catalog,
 and the chaos-suite how-to.
@@ -27,6 +31,7 @@ from dmlc_tpu.resilience.faults import (
     reset,
 )
 from dmlc_tpu.resilience.hedge import hedged_call
+from dmlc_tpu.resilience.preempt import EXIT_PREEMPTED, Preempted
 from dmlc_tpu.resilience.retry import (
     RetryBudget,
     RetryPolicy,
@@ -40,9 +45,11 @@ from dmlc_tpu.resilience.retry import (
 )
 
 __all__ = [
+    "EXIT_PREEMPTED",
     "FaultSpecError",
     "InjectedFault",
     "NOOP",
+    "Preempted",
     "RetryBudget",
     "RetryPolicy",
     "RetryState",

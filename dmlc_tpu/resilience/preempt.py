@@ -1,0 +1,169 @@
+"""Preemption notices: SIGTERM-with-deadline made survivable.
+
+TPU fleets preempt whole hosts: the process gets a SIGTERM and a grace
+window, then a hard kill. This module turns that into a cooperative
+protocol:
+
+- :func:`install` registers a SIGTERM handler (main thread only,
+  idempotent) that records a *preemption notice* instead of dying.
+- The training loop calls :func:`poll` between steps; once a notice is
+  pending it stops cleanly, asks its :class:`~dmlc_tpu.collective.snapshot.Snapshotter`
+  to finalize a just-in-time coordinated snapshot within
+  :func:`~dmlc_tpu.params.knobs.preempt_deadline_s` seconds, and raises
+  :class:`Preempted`.
+- :class:`Preempted` is a ``SystemExit`` with :data:`EXIT_PREEMPTED`
+  (75, ``EX_TEMPFAIL``): left uncaught it exits the process with that
+  code, which the local launcher recognizes and relaunches *without*
+  consuming a retry attempt (tracker/launchers/local.py).
+
+For deterministic tests, :func:`poll` also fires the ``preempt.notice``
+faultpoint — ``DMLC_TPU_FAULTS="preempt.notice:nth=K"`` simulates a
+preemption notice on the K-th poll without any signal plumbing.
+
+See docs/robustness.md "Preemption & resume" for the full signal flow.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Optional
+
+from dmlc_tpu.resilience.faults import InjectedFault, faultpoint
+from dmlc_tpu.utils.logging import log_info
+
+#: Exit code signalling "preempted after a committed snapshot — relaunch
+#: me" (sysexits EX_TEMPFAIL). Distinct from crash codes so the launcher
+#: can relaunch without burning a retry attempt.
+EXIT_PREEMPTED = 75
+
+
+class Preempted(SystemExit):
+    """Raised by the training loop after the just-in-time snapshot.
+
+    A ``SystemExit`` subclass: uncaught, the interpreter exits with
+    :data:`EXIT_PREEMPTED` — no traceback, no crash-path teardown.
+    """
+
+    def __init__(self, message: str = "preempted"):
+        super().__init__(EXIT_PREEMPTED)
+        self.message = message
+
+
+_lock = threading.Lock()
+_requested = threading.Event()
+_notice_at: Optional[float] = None
+_deadline_s: Optional[float] = None
+_installed = False
+_prev_handler = None
+
+
+def install(deadline_s: Optional[float] = None) -> bool:
+    """Arm the SIGTERM preemption handler; returns True when installed.
+
+    Only the main thread may set signal handlers — elsewhere this is a
+    no-op (the faultpoint path in :func:`poll` still works). Idempotent:
+    a second call just updates the deadline. The handler does NOT chain
+    to a previously installed one: a preemption notice means "drain and
+    snapshot", which supersedes dump-and-die handlers (the flight
+    recorder still dumps on the clean exit path).
+    """
+    global _installed, _prev_handler, _deadline_s
+    with _lock:
+        if deadline_s is not None:
+            _deadline_s = deadline_s
+        if _installed:
+            return True
+        try:
+            _prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:  # not the main thread
+            return False
+        _installed = True
+        return True
+
+
+def uninstall() -> None:
+    """Restore the pre-:func:`install` SIGTERM disposition (tests)."""
+    global _installed, _prev_handler
+    with _lock:
+        if not _installed:
+            return
+        try:
+            signal.signal(signal.SIGTERM, _prev_handler or signal.SIG_DFL)
+        except ValueError:
+            pass
+        _installed = False
+        _prev_handler = None
+
+
+def _on_sigterm(signum, frame) -> None:
+    notice("sigterm")
+
+
+def notice(source: str) -> None:
+    """Record a preemption notice (signal handler or injected fault)."""
+    global _notice_at
+    if _requested.is_set():
+        return
+    _notice_at = time.monotonic()
+    _requested.set()
+    # signal-safe enough: counters are plain ints behind a lock-free inc,
+    # and the flight recorder appends to a deque
+    from dmlc_tpu import obs
+    from dmlc_tpu.obs import flight
+
+    obs.registry().counter(
+        "dmlc_preempt_notices_total",
+        "preemption notices received (SIGTERM or injected)",
+    ).inc()
+    flight.record_event("preempt.notice", source=source,
+                        deadline_s=deadline_s())
+    log_info("preemption notice (%s): snapshot deadline %.1fs",
+             source, deadline_s())
+
+
+def poll() -> bool:
+    """True once a preemption notice is pending (call between steps).
+
+    Also the injection point for simulated preemptions: each poll fires
+    the ``preempt.notice`` faultpoint, so
+    ``DMLC_TPU_FAULTS="preempt.notice:nth=K"`` turns the K-th poll into
+    a notice — deterministic chaos without signals.
+    """
+    if _requested.is_set():
+        return True
+    try:
+        faultpoint("preempt.notice")
+    except InjectedFault:
+        notice("injected")
+        return True
+    return False
+
+
+def requested() -> bool:
+    return _requested.is_set()
+
+
+def deadline_s() -> float:
+    """The configured grace window (install() override or the knob)."""
+    if _deadline_s is not None:
+        return _deadline_s
+    from dmlc_tpu.params.knobs import preempt_deadline_s
+
+    return preempt_deadline_s()
+
+
+def deadline_remaining() -> float:
+    """Seconds left in the grace window (full window when no notice)."""
+    if _notice_at is None:
+        return deadline_s()
+    return max(0.0, deadline_s() - (time.monotonic() - _notice_at))
+
+
+def reset() -> None:
+    """Clear notice state (tests). Does not touch the signal handler."""
+    global _notice_at
+    with _lock:
+        _requested.clear()
+        _notice_at = None

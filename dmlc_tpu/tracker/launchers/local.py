@@ -13,8 +13,14 @@ import subprocess
 import threading
 from typing import Dict, List
 
+from dmlc_tpu.resilience.preempt import EXIT_PREEMPTED
 from dmlc_tpu.tracker.launchers.common import task_env
 from dmlc_tpu.tracker.rendezvous import submit_with_tracker
+
+#: relaunch-after-preemption ceiling: exit-75 restarts do not consume
+#: --max-attempts (a preempted task did nothing wrong), but an unbounded
+#: loop would hide a task that exits 75 pathologically
+MAX_PREEMPT_RELAUNCHES = 32
 
 
 def submit(args) -> None:
@@ -31,6 +37,7 @@ def submit(args) -> None:
             extra["DMLC_TPU_SPARE"] = "1"
         env = task_env(envs, task_id, role, "local", extra=extra)
         attempts = max(1, nrepeat)
+        preempt_relaunches = 0
         while attempts > 0:
             full = os.environ.copy()
             full.update(env)
@@ -38,6 +45,17 @@ def submit(args) -> None:
             code = subprocess.Popen(cmd, env=full, shell=True).wait()
             if code == 0:
                 return
+            if (code == EXIT_PREEMPTED
+                    and preempt_relaunches < MAX_PREEMPT_RELAUNCHES):
+                # the preemption handler committed a job snapshot and
+                # exited with the relaunch code: restart WITHOUT burning
+                # a retry attempt — the relaunched task resumes from the
+                # committed manifest (docs/robustness.md)
+                preempt_relaunches += 1
+                print(f"{role} {task_id} preempted (exit {code}); "
+                      f"relaunching to resume from its job snapshot "
+                      f"(relaunch {preempt_relaunches})")
+                continue
             flight_dir = full.get("DMLC_TPU_FLIGHTREC")
             if flight_dir:
                 print(f"{role} {task_id} exited {code}; flight-recorder "

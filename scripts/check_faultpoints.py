@@ -61,8 +61,10 @@ def planted_sites() -> dict:
     """site -> list of relative paths where faultpoint(site) is planted."""
     out: dict = {}
     for path in _walk():
-        if "resilience" in path.parts:
+        if path.name == "faults.py" and "resilience" in path.parts:
             continue  # the harness itself defines, not plants, the hook
+            # (other resilience modules may legitimately plant sites,
+            # e.g. preempt.py's simulated preemption notice)
         text = path.read_text()
         for site in SITE_CALL_RE.findall(text):
             out.setdefault(site, []).append(str(path.relative_to(ROOT)))

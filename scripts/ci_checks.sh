@@ -778,4 +778,130 @@ print("ci_checks: baked-shard smoke OK (bake == text bit-exact; "
       "shuffled 2-worker epoch row-set identical, 0 divergences)")
 EOF
 
+# preemption smoke: a 2-process dmlc-submit fit with job snapshots and
+# the determinism audit armed is SIGTERMed mid-epoch on both ranks once
+# each wrote its epoch-0 snapshot part; each rank finalizes a just-in-time
+# coordinated snapshot, exits with the relaunch code (75), the launcher
+# relaunches without consuming attempts, and the resumed job's per-rank
+# final params + loss history + audit chain heads are bit-identical to
+# an uninterrupted run with zero audit divergences.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import os, shutil, subprocess, sys, tempfile
+
+import numpy as np
+
+WORKER = r'''
+import hashlib, os, signal, sys, threading, time
+import numpy as np
+from dmlc_tpu import collective as rabit
+from dmlc_tpu.models import LinearLearner
+from dmlc_tpu.obs.audit import auditor
+
+DATA, SNAP, KILL, SENTDIR = sys.argv[1:5]
+NFEAT, EPOCHS = 6, 4
+
+rabit.init()
+rank = rabit.rank()
+sentinel = os.path.join(SENTDIR, "life.rank%d" % rank)
+first = not os.path.exists(sentinel)
+if first:
+    with open(sentinel, "w") as fh:
+        fh.write("armed")
+if KILL == "sigterm" and first:
+    # the "cloud" preempts this host: once this rank wrote its epoch-0
+    # snapshot part it gets a real SIGTERM, solidly mid-epoch-1 for the
+    # rank. Keying on the rank's OWN part (not the global LATEST, which
+    # needs every drifting rank's part + the rank-0 barrier) keeps the
+    # kill deterministically inside the fit.
+    def preempt_host():
+        part = os.path.join(SNAP, "snap_v1.rank%d" % rank)
+        while not os.path.exists(part):
+            time.sleep(0.002)
+        os.kill(os.getpid(), signal.SIGTERM)
+    threading.Thread(target=preempt_host, daemon=True).start()
+
+model = LinearLearner(learning_rate=0.5)
+history = model.fit_uri(DATA, batch_size=16, epochs=EPOCHS,
+                        num_features=NFEAT, drop_remainder=True,
+                        snapshot_uri=SNAP, resume=not first)
+blob = b"".join(np.ascontiguousarray(np.asarray(model.params[k]))
+                .tobytes() for k in ("w", "b"))
+blob += repr([round(float(x), 12) for x in history]).encode()
+audit = auditor()
+head = (audit.export_state() or {}).get("model", {}).get("head", "-")
+div = len(getattr(audit, "divergences", ()))
+rabit.tracker_print(
+    "RESULT rank=%d digest=%s epochs=%d head=%s div=%d"
+    % (rank, hashlib.sha256(blob).hexdigest()[:16], len(history),
+       (head or "-")[:16], div))
+rabit.finalize()
+'''
+
+workdir = tempfile.mkdtemp(prefix="dmlc_preempt_smoke_")
+rng = np.random.RandomState(23)
+data = os.path.join(workdir, "p.svm")
+with open(data, "w") as fh:
+    for _ in range(320):
+        x = rng.rand(6)
+        fh.write("%d %s\n" % (int(x.sum() > 3), " ".join(
+            "%d:%.6f" % (j, x[j]) for j in range(6))))
+worker_py = os.path.join(workdir, "worker.py")
+open(worker_py, "w").write(WORKER)
+
+
+def run_job(tag, kill, max_attempts):
+    snap = os.path.join(workdir, "snap_%s" % tag)
+    sent = os.path.join(workdir, "sent_%s" % tag)
+    os.makedirs(sent)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DMLC_TPU_AUDIT="1",
+               DMLC_TPU_PREEMPT_DEADLINE_S="10",
+               PYTHONPATH=os.getcwd())
+    env.pop("DMLC_TPU_FAULTS", None)
+    proc = subprocess.run(
+        [sys.executable, "dmlc-submit", "--cluster", "local", "-n", "2",
+         "--max-attempts", str(max_attempts), "--host-ip", "127.0.0.1",
+         sys.executable, worker_py, data, snap, kill, sent],
+        capture_output=True, text=True, timeout=300, env=env)
+    out = proc.stdout + proc.stderr
+    if proc.returncode != 0:
+        sys.exit("ci_checks: preemption smoke %s run failed (rc=%d)\n%s"
+                 % (tag, proc.returncode, out))
+    results = {}
+    for line in out.splitlines():
+        if "RESULT" in line:
+            kv = dict(p.split("=")
+                      for p in line.split("RESULT", 1)[1].split())
+            results[int(kv["rank"])] = kv
+    if sorted(results) != [0, 1]:
+        sys.exit("ci_checks: preemption smoke %s: missing RESULT "
+                 "lines\n%s" % (tag, out))
+    for r, kv in sorted(results.items()):
+        if int(kv["epochs"]) != 4:
+            sys.exit("ci_checks: %s rank %d finished %s epochs, want 4"
+                     % (tag, r, kv["epochs"]))
+        if int(kv["div"]) != 0:
+            sys.exit("ci_checks: %s rank %d reported %s audit "
+                     "divergences" % (tag, r, kv["div"]))
+    return results, out
+
+
+try:
+    clean, _ = run_job("clean", "none", max_attempts=1)
+    chaos, out = run_job("sigterm", "sigterm", max_attempts=2)
+    if "preempted (exit 75)" not in out:
+        sys.exit("ci_checks: SIGTERM never engaged the exit-75 relaunch "
+                 "path\n%s" % out)
+    for r in (0, 1):
+        if (chaos[r]["digest"] != clean[r]["digest"]
+                or chaos[r]["head"] != clean[r]["head"]):
+            sys.exit("ci_checks: rank %d resumed run diverged from the "
+                     "uninterrupted twin:\n  clean %r\n  chaos %r"
+                     % (r, clean[r], chaos[r]))
+finally:
+    shutil.rmtree(workdir, ignore_errors=True)
+print("ci_checks: preemption smoke OK (2-proc SIGTERM -> exit-75 "
+      "relaunch; per-rank params+history+audit bit-identical, 0 "
+      "divergences)")
+EOF
+
 echo "ci_checks: all checks passed"

@@ -561,6 +561,135 @@ def test_chaos_cache_populate_fault_degrades_not_corrupts(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# preemption chaos: SIGTERM / SIGKILL a snapshotting fit mid-run, the
+# launcher relaunches, the resumed run finishes bit-identical
+# ---------------------------------------------------------------------------
+
+PREEMPT_WORKER = textwrap.dedent("""
+    import hashlib, os, signal, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from dmlc_tpu import collective as rabit
+    from dmlc_tpu.models import LinearLearner
+    from dmlc_tpu.obs.audit import auditor
+
+    DATA = sys.argv[1]
+    SNAP = sys.argv[2]
+    KILL = sys.argv[3]          # "none", "sigterm", or "sigkill"
+    SENTINEL = sys.argv[4]
+    NFEAT, EPOCHS = 6, 4
+
+    rabit.init()
+    first = not os.path.exists(SENTINEL)
+    if first:
+        with open(SENTINEL, "w") as fh:
+            fh.write("armed")
+    if KILL != "none" and first:
+        # a real preemption: once the epoch-1 snapshot committed
+        # (LATEST >= 1), the "cloud" signals this host mid-epoch
+        sig = signal.SIGTERM if KILL == "sigterm" else signal.SIGKILL
+        def preempt_host():
+            latest = os.path.join(SNAP, "LATEST")
+            while True:
+                try:
+                    with open(latest) as fh:
+                        if int(fh.read().strip() or 0) >= 1:
+                            os.kill(os.getpid(), sig)
+                            return
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.002)
+        threading.Thread(target=preempt_host, daemon=True).start()
+
+    model = LinearLearner(learning_rate=0.5)
+    history = model.fit_uri(
+        DATA, batch_size=16, epochs=EPOCHS, num_features=NFEAT,
+        drop_remainder=True, snapshot_uri=SNAP, resume=not first)
+    blob = b"".join(np.ascontiguousarray(np.asarray(model.params[k]))
+                    .tobytes() for k in ("w", "b"))
+    blob += repr([round(float(x), 12) for x in history]).encode()
+    digest = hashlib.sha256(blob).hexdigest()
+    audit = auditor()
+    head = (audit.export_state() or {{}}).get("model", {{}}).get("head", "-")
+    divergences = len(getattr(audit, "divergences", ()))
+    rabit.tracker_print(
+        f"RESULT rank={{rabit.rank()}} digest={{digest[:16]}} "
+        f"epochs={{len(history)}} head={{head[:16] or '-'}} "
+        f"div={{divergences}}")
+    rabit.finalize()
+""")
+
+
+def _run_preempt_job(tmp_path, kill: str, tag: str, max_attempts: int):
+    """One dmlc-submit run of the snapshotting fit; returns (digest,
+    audit-head, divergence count, launcher output)."""
+    rng = np.random.RandomState(23)
+    data = tmp_path / "preempt.svm"
+    if not data.exists():
+        with open(data, "w") as fh:
+            for _ in range(320):
+                x = rng.rand(6)
+                fh.write(f"{int(x.sum() > 3)} " + " ".join(
+                    f"{j}:{x[j]:.6f}" for j in range(6)) + "\n")
+    script = tmp_path / "pworker.py"
+    script.write_text(PREEMPT_WORKER.format(repo=REPO))
+    snap = tmp_path / f"snap_{tag}"
+    sentinel = tmp_path / f"sentinel_{tag}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "DMLC_TPU_AUDIT": "1"}
+    env.pop("DMLC_TPU_FAULTS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dmlc-submit"),
+         "--cluster", "local", "-n", "1",
+         "--max-attempts", str(max_attempts), "--host-ip", "127.0.0.1",
+         sys.executable, str(script), str(data), str(snap), kill,
+         str(sentinel)],
+        capture_output=True, text=True, timeout=240, env=env)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    result = {}
+    for line in out.splitlines():
+        if "RESULT" in line:
+            result = dict(
+                p.split("=") for p in line.split("RESULT", 1)[1].split())
+    assert result, out
+    assert int(result["epochs"]) == 4, out
+    return result["digest"], result["head"], int(result["div"]), out
+
+
+def test_chaos_preempt_sigterm_resumes_bit_identical(tmp_path):
+    """The tentpole acceptance: a fit is SIGTERMed mid-epoch after the
+    epoch-1 snapshot committed; it finalizes a just-in-time snapshot,
+    exits with the relaunch code (which must NOT consume the single
+    retry attempt), the launcher relaunches it, and the resumed run's
+    final params + loss history + audit chain head are bit-identical to
+    an uninterrupted run, with zero audit divergences."""
+    clean, clean_head, clean_div, _ = _run_preempt_job(
+        tmp_path, kill="none", tag="clean", max_attempts=1)
+    assert clean_div == 0
+    chaos, head, div, out = _run_preempt_job(
+        tmp_path, kill="sigterm", tag="sigterm", max_attempts=1)
+    assert "preempted (exit 75)" in out, out  # the relaunch path engaged
+    assert chaos == clean
+    assert head == clean_head
+    assert div == 0
+
+
+def test_chaos_preempt_kill9_resumes_bit_identical(tmp_path):
+    """SIGKILL leaves no grace window (no just-in-time snapshot, a torn
+    attempt on disk is possible): the relaunch must fall back to the
+    newest *committed* epoch boundary, replay, and still land
+    bit-identical."""
+    clean, clean_head, clean_div, _ = _run_preempt_job(
+        tmp_path, kill="none", tag="clean9", max_attempts=1)
+    chaos, head, div, out = _run_preempt_job(
+        tmp_path, kill="sigkill", tag="kill9", max_attempts=2)
+    assert "retrying" in out, out  # a hard kill consumes a retry attempt
+    assert chaos == clean
+    assert head == clean_head
+    assert div == 0 == clean_div
+
+
+# ---------------------------------------------------------------------------
 # io.read chaos: ranged reads under probabilistic faults stay byte-exact
 # ---------------------------------------------------------------------------
 
