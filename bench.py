@@ -1258,7 +1258,8 @@ _COMPACT_KEYS = (
     "resident_binding_stage",
     "gbdt_fit_mrows_s",
     "sgd_e2e_multijob_mbps", "cache_cross_job_hit_ratio",
-    "sgd_goodput_ratio", "ckpt_overhead_ratio", "resume_restore_s",
+    "sgd_goodput_ratio", "sgd_mfu", "ckpt_overhead_ratio",
+    "resume_restore_s",
     "device", "device_feed_probe_gbps", "device_feed_probe_gbps_post",
     "device_tier_probes_gbps",
     "socket_tree_64k_gbps", "socket_ring_8m_gbps", "socket_world",
@@ -1281,6 +1282,9 @@ BENCH_DIRECTIONS = {
     # snapshot tax and restore latency regress upward: gate them down
     "ckpt_overhead_ratio": "lower",
     "resume_restore_s": "lower",
+    # model FLOP utilization of the whole-run goodput window
+    # (obs/xla_cost.py analytics over the peak-FLOPs ceiling)
+    "sgd_mfu": "higher",
 }
 
 
@@ -1663,6 +1667,17 @@ def main() -> None:
         extra["device_telemetry_error"] = str(err)[:120]
 
     try:
+        # compiled-program cost records (obs/xla_cost.py): per-jit-site
+        # flops / bytes accessed / peak memory / in-graph collective
+        # bytes, cached at compile time by the instrumented_jit hook —
+        # the SPMD psum step's dmlc_xla_collective_bytes lands here
+        from dmlc_tpu.obs import xla_cost
+
+        extra["xla"] = xla_cost.detail_section()
+    except Exception as err:
+        extra["xla_error"] = str(err)[:120]
+
+    try:
         # whole-run goodput attribution (obs/goodput.py): the run's
         # registry totals ARE the delta-from-zero, the wall is this
         # process's elapsed time, and the ceilings are the run's OWN
@@ -1691,6 +1706,11 @@ def main() -> None:
         )
         extra["goodput"] = att
         extra["sgd_goodput_ratio"] = att["goodput"]["ratio"]
+        if att.get("mfu") is not None:
+            # model FLOP utilization rides the record only when the
+            # run compiled an analyzable hot step — sentry gates it
+            # higher-is-better via BENCH_DIRECTIONS
+            extra["sgd_mfu"] = att["mfu"]
     except Exception as err:
         extra["goodput_error"] = str(err)[:120]
 

@@ -5,6 +5,15 @@ native equivalent"): inside jit, collectives are axis-name primitives
 (psum/pmean/all_gather/ppermute) that XLA lowers to ICI AllReduce etc.; at
 the host level, cross-process reductions ride a jitted psum over the global
 mesh via jax.experimental.multihost_utils.
+
+Byte accounting: in-graph psums are invisible to the host-side
+``dmlc_collective_*`` counters (those meter the socket/D2H fallback ops),
+but every jit site here goes through ``instrumented_jit``, so the
+compile-time analytics hook (obs/xla_cost.py) reads each compiled
+program's collective traffic out of its optimized HLO —
+``dmlc_xla_collective_bytes{fn="collective.allreduce_step"}`` (and the
+SPMD model steps' own labels) is where the in-graph allreduce bytes
+surface.
 """
 
 from __future__ import annotations

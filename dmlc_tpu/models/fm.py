@@ -277,6 +277,10 @@ class FMLearner:
                         )
                     acc.add(metrics)
                     fl.note_step()
+                    # every DMLC_TPU_STEP_SAMPLE_N-th step: one timed
+                    # block_until_ready -> dmlc_step_device_ms (no sync
+                    # on the other N-1 steps)
+                    fl.sample_latency(metrics)
                     nstep += 1
                     if snapshotter is not None and preempt.poll():
                         preempted = True

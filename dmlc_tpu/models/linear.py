@@ -798,6 +798,10 @@ class LinearLearner:
                         )
                     acc.add(metrics)
                     fl.note_step()
+                    # every DMLC_TPU_STEP_SAMPLE_N-th step: one timed
+                    # block_until_ready -> dmlc_step_device_ms (no sync
+                    # on the other N-1 steps)
+                    fl.sample_latency(metrics)
                     nstep += 1
                     if log_every and nstep % log_every == 0:
                         log_info(

@@ -11,7 +11,11 @@ module lights up the device side on the same registry:
   The trick is that jit traces the wrapped Python body exactly once per
   cache miss, so a counter bump inside the body IS a compile counter — no
   private jax APIs. This turns FixedShapePool's one-trace-per-bucket design
-  claim into a live invariant.
+  claim into a live invariant. Each compiling call also hands the executable
+  to obs/xla_cost.py (``note_compile``) which caches the compiled program's
+  cost/memory analytics per (fn, bucket shape) — compile-time only, never
+  per step, and ``jitted.lower`` reuses the cached trace so the recompile
+  sentinel itself is not perturbed.
 - ``sample()`` — per-device HBM gauges from ``device.memory_stats()``
   (``dmlc_device_hbm_bytes{device=}``; graceful no-op on CPU backends where
   the runtime reports nothing) plus a live-buffer census over
@@ -84,6 +88,7 @@ class InstrumentedJit:
         "compiles",
         "calls",
         "_jitted",
+        "_reg",
         "_m_compiles",
         "_m_recompiles",
         "_h_compile_ns",
@@ -100,6 +105,7 @@ class InstrumentedJit:
         import jax
 
         reg = reg if reg is not None else registry()
+        self._reg = reg
         self.fn_name = name
         self.warmup_calls = int(warmup_calls)
         self.compiles = 0
@@ -140,6 +146,17 @@ class InstrumentedJit:
         self.calls += 1
         if self.compiles != before:
             self._h_compile_ns.observe(time.monotonic_ns() - t0)
+            try:
+                from dmlc_tpu.obs import xla_cost
+
+                xla_cost.note_compile(
+                    self.fn_name, self._jitted, args, kwargs, reg=self._reg)
+            except Exception:  # noqa: BLE001 - analytics never kill a step
+                logger.debug(
+                    "xla cost extraction failed for %s",
+                    self.fn_name,
+                    exc_info=True,
+                )
             if self.calls > self.warmup_calls:
                 self._m_recompiles.inc()
                 flight.record_event(
@@ -494,10 +511,15 @@ def detail_section(reg: Optional[Registry] = None) -> Dict[str, Any]:
 
 
 def reset() -> None:
-    """Forget process-level state (tests): peak HBM and poller/capture flags."""
+    """Forget process-level state (tests): peak HBM, poller/capture flags,
+    and the xla cost-record cache (stale records would otherwise pin their
+    gauges to a previous test's registry)."""
     global _peak_hbm, _poller_started, _capturing
     with _state_lock:
         _peak_hbm = 0
         _poller_started = False
     with _capture_lock:
         _capturing = False
+    from dmlc_tpu.obs import xla_cost
+
+    xla_cost.reset()

@@ -53,6 +53,21 @@ Roofline ceilings (MB/s unless noted), merged over
   (``DMLC_TPU_ICI_PEAK_GBPS``, default 45 — same knob
   bench_collective.py scores against)
 
+Those ceilings are all *measured-probe* style (a bench tier, a feed
+probe, a spec sheet). The compiled-step cost records (obs/xla_cost.py)
+add the *model-based* pair: the window's flop/byte estimate is steps ×
+the hot step's per-call XLA analytics (``dmlc_xla_flops{fn=}`` /
+``dmlc_xla_bytes_accessed{fn=}`` over the ``*.step``/``*.step_mp``
+sites, read from the ``current`` snapshot — gauges, so never from a
+clamped delta), scored against ``peak_flops``
+(``DMLC_TPU_PEAK_FLOPS``, default = the measured matmul probe) and
+``hbm_gbps`` (``DMLC_TPU_PEAK_HBM_GBPS``, default = the measured
+streaming probe). When computable the verdict gains ``mfu`` (model
+FLOP utilization ∈ (0, 1]), ``hbm_fraction``, and a ``compute`` block
+naming device_step's model-predicted floor seconds next to its
+measured budget — all keys absent otherwise, so surfaces that render
+conditionally (obs-top's mfu column) stay byte-stable.
+
 The per-step :class:`GoodputLedger` is the in-run form: ``note_step()``
 on the hot path (one integer add), ``tick()`` at window boundaries
 (epoch ends) snapshots the registry, attributes the delta, updates the
@@ -170,6 +185,8 @@ def default_ceilings() -> Dict[str, float]:
         "h2d_mbps": 0.0,
         "step_mbps": knobs.step_peak_mbps(),
         "ici_gbps": knobs.ici_peak_gbps(),
+        "peak_flops": knobs.peak_flops(),
+        "hbm_gbps": knobs.peak_hbm_gbps(),
     }
 
 
@@ -242,7 +259,7 @@ def _finish(stages: Dict[str, float], counters: Dict[str, float],
     util = roofline.get(binding, {}).get("utilization")
     if util is not None and util >= 0.8:
         at_roof = True
-    return {
+    out = {
         "window_s": round(wall_s, 6),
         "budget_s": {k: round(v, 6) for k, v in budget.items()},
         "counters": {k: round(v, 3) for k, v in counters.items()},
@@ -255,6 +272,38 @@ def _finish(stages: Dict[str, float], counters: Dict[str, float],
         "binding": binding,
         "at_roof": at_roof,
     }
+    # model-based roofline: the window's XLA flop/byte estimate (steps ×
+    # per-step compiled-program analytics, injected by attribute() or
+    # summed across ranks by rolled()) against the peak knobs, with the
+    # measured probes standing in for unset knobs. All three keys stay
+    # absent when nothing is computable — conditional surfaces key off
+    # their presence.
+    xla_flops = counters.get("xla_flops", 0.0)
+    if xla_flops > 0.0:
+        peak = float(ceil.get("peak_flops", 0.0) or 0.0)
+        if peak <= 0.0:
+            from dmlc_tpu.obs import xla_cost
+
+            peak = xla_cost.probed_peak_flops()
+        if peak > 0.0:
+            out["mfu"] = round(min(1.0, xla_flops / wall_s / peak), 4)
+            out["compute"] = {
+                "flops": round(xla_flops, 3),
+                "peak_flops": round(peak, 3),
+                "floor_s": round(xla_flops / peak, 6),
+                "measured_s": round(stages["device_step"], 6),
+            }
+    xla_bytes = counters.get("xla_bytes", 0.0)
+    if xla_bytes > 0.0:
+        gbps = float(ceil.get("hbm_gbps", 0.0) or 0.0)
+        if gbps <= 0.0:
+            from dmlc_tpu.obs import xla_cost
+
+            gbps = xla_cost.probed_hbm_gbps()
+        if gbps > 0.0:
+            out["hbm_fraction"] = round(
+                min(1.0, xla_bytes / wall_s / (gbps * 1e9)), 4)
+    return out
 
 
 def attribute(delta: Dict[str, float], wall_s: float,
@@ -265,9 +314,22 @@ def attribute(delta: Dict[str, float], wall_s: float,
     ``delta`` is :func:`flat_delta` between two ``flat_values()``
     snapshots (or the totals themselves for a whole-run window);
     ``current`` optionally supplies the live snapshot for gauge reads
-    (the straggler rank)."""
-    att = _finish(stage_seconds(delta), progress_counters(delta),
-                  wall_s, ceilings)
+    (the straggler rank, the per-step XLA cost gauges — flat_delta
+    clamps gauges, so they must come from a real snapshot)."""
+    counters = progress_counters(delta)
+    steps = counters.get("steps", 0.0)
+    if steps > 0.0:
+        from dmlc_tpu.obs import xla_cost
+
+        costs = xla_cost.step_costs(current if current else delta)
+        # only materialize the model-based counters when a compiled hot
+        # step has actually been analyzed — their absence keeps the
+        # mfu/compute keys (and every conditional surface) absent too
+        if costs["flops"] > 0.0:
+            counters["xla_flops"] = steps * costs["flops"]
+        if costs["bytes"] > 0.0:
+            counters["xla_bytes"] = steps * costs["bytes"]
+    att = _finish(stage_seconds(delta), counters, wall_s, ceilings)
     if current:
         att["straggler_rank"] = int(_max_named(
             current, "dmlc_job_straggler_rank", default=-1.0))
@@ -330,6 +392,17 @@ def format_attribution(att: Dict, label: str = "goodput") -> str:
             "-" if not ceiling else "%.1f" % ceiling,
             "-" if util is None else "%.0f%%" % (100.0 * util),
             mark))
+    comp = att.get("compute")
+    if comp:
+        # the model-based floor under device_step: what the window's
+        # XLA flop estimate predicts at peak vs what was measured
+        mfu = att.get("mfu")
+        lines.append(
+            "compute      %10.3f floor vs %.3f measured  "
+            "(%.3g FLOPs @ %.3g FLOP/s%s)" % (
+                comp.get("floor_s", 0.0), comp.get("measured_s", 0.0),
+                comp.get("flops", 0.0), comp.get("peak_flops", 0.0),
+                "" if mfu is None else ", mfu %.0f%%" % (100.0 * mfu)))
     return "\n".join(lines)
 
 
@@ -348,6 +421,11 @@ class GoodputLedger:
         self._g_ratio = self._reg.gauge(
             "dmlc_goodput_ratio_value",
             "useful-work fraction of the last ledger window")
+        self._g_mfu = self._reg.gauge(
+            "dmlc_goodput_mfu_ratio",
+            "model FLOP utilization of the last ledger window (window "
+            "XLA flop estimate over the peak-FLOPs ceiling; stays 0 "
+            "until a compiled hot step has been analyzed)")
         self.windows: Deque[Dict] = collections.deque(maxlen=history)
         self._steps = 0
         self._prev = self._reg.flat_values()
@@ -374,6 +452,8 @@ class GoodputLedger:
         self._prev = flat
         self._t0 = now
         self._g_ratio.set(att["goodput"]["ratio"])
+        if att.get("mfu") is not None:
+            self._g_mfu.set(att["mfu"])
         self.windows.append(att)
         return att
 

@@ -22,9 +22,11 @@ timelines — so:
   last-seen/lag/straggler), ``/metrics`` (Prometheus text merged
   across ranks), ``/trace`` (job-wide Chrome-trace JSON), ``/data``
   (the data dispatcher's worker/lease/requeue view, when one is
-  attached — see data/dispatcher.py), and ``/goodput`` (per-rank +
+  attached — see data/dispatcher.py), ``/goodput`` (per-rank +
   job-rolled goodput attribution from consecutive metric snapshots —
-  obs/goodput.py).
+  obs/goodput.py), and ``/xla`` (per-rank compiled-program cost tables
+  parsed from the heartbeat metric snapshots plus the local record
+  cache — obs/xla_cost.py).
 - **Clock skew** — each payload carries the worker's send wall-time and
   its last measured heartbeat RTT; the tracker estimates per-rank offset
   as ``recv − sent − rtt/2`` (the NTP/obs-aggregate midpoint idea) and
@@ -59,7 +61,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Deque, Dict, List, Optional, Tuple
 
-from dmlc_tpu.obs import audit, goodput, trace
+from dmlc_tpu.obs import audit, goodput, trace, xla_cost
 from dmlc_tpu.obs.exporters import prometheus_lines
 from dmlc_tpu.obs.metrics import Registry, registry
 from dmlc_tpu.params.knobs import obs_payload_max, obs_publish_enabled
@@ -506,6 +508,26 @@ class StatusPlane:
             "job": goodput.rolled(list(per_rank.values())),
         }
 
+    def xla_view(self) -> Dict:
+        """The ``/xla`` body: per-rank compiled-program cost tables plus
+        this process's own record cache.
+
+        ``ranks`` is parsed back out of each worker's latest flat metric
+        snapshot (the ``dmlc_xla_*{fn=}`` gauges ride the heartbeat like
+        every other metric — no new wire field), keyed rank → jit site →
+        {flops, bytes_accessed, peak_bytes, collective_bytes};
+        ``local`` is obs/xla_cost.py's in-process view (per-site latest
+        records with bucket counts, plus the extraction count) for
+        single-process runs and the tracker's own jits."""
+        with self._lock:
+            per_rank = {
+                str(rank): sites
+                for rank, v in sorted(self._views.items())
+                for sites in (xla_cost.sites_from_flat(v.metrics),)
+                if sites
+            }
+        return {"ranks": per_rank, "local": xla_cost.detail_section()}
+
     def membership(self) -> Dict:
         """``{"world_version": N, "events": [...]}`` — the elastic half of
         the ``/workers`` response."""
@@ -711,6 +733,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 ctype = "application/json"
             elif path == "/audit":
                 body = json.dumps(plane.audit_view()).encode()
+                ctype = "application/json"
+            elif path == "/xla":
+                body = json.dumps(plane.xla_view()).encode()
                 ctype = "application/json"
             elif path == "/profile":
                 from urllib.parse import parse_qs

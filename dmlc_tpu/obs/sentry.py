@@ -196,7 +196,9 @@ def record_values(rec: Dict) -> Dict[str, float]:
     ``directions`` map are gated too, whatever their suffix — the
     per-record direction registry that replaces growing
     ``_HIGHER_KEYS`` (``record_directions`` collects the map for
-    :func:`gate`)."""
+    :func:`gate`). That is how bench's ``sgd_goodput_ratio`` and
+    ``sgd_mfu`` (model FLOP utilization, higher-is-better) gate with no
+    sentry-side changes."""
     vals: Dict[str, float] = {}
     if _is_number(rec.get("value")) and rec.get("metric"):
         vals[str(rec["metric"])] = float(rec["value"])

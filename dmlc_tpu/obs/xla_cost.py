@@ -1,0 +1,388 @@
+"""Compiled-program cost analytics: per-jit-site FLOP/byte/memory records.
+
+PR 13 moved the training hot path inside the compiled graph (in-graph
+``psum`` SPMD steps) and PR 16 made it device-resident, which left the
+obs plane blind past the jit boundary: FLOPs executed, HBM bytes moved,
+and ICI collective traffic all happen inside one opaque dispatch. This
+module restores that visibility *at compile time, never per step*: when
+an :class:`~dmlc_tpu.obs.device_telemetry.InstrumentedJit` site compiles
+a new (fn, bucket-shape) signature, :func:`note_compile` re-lowers the
+same arguments (``jitted.lower(...)`` reads cached jaxprs and argument
+avals only — it does not re-trace the Python body, so the recompile
+sentinel is untouched; verified against donated/deleted buffers) and
+reads the compiled executable's analytics:
+
+- ``compiled.cost_analysis()`` → per-call ``flops`` and ``bytes
+  accessed`` (``dmlc_xla_flops{fn=}``,
+  ``dmlc_xla_bytes_accessed{fn=}``);
+- ``compiled.memory_analysis()`` → peak program bytes: argument +
+  output + temp + generated code, minus donation aliasing
+  (``dmlc_xla_peak_bytes{fn=}``);
+- the optimized HLO text → bytes moved by in-graph collectives
+  (all-reduce / all-gather / reduce-scatter / collective-permute /
+  all-to-all result shapes summed; ``dmlc_xla_collective_bytes{fn=}``)
+  — the allreduce traffic ``dmlc_collective_*`` stopped seeing when the
+  psum moved in-graph.
+
+Records are cached per (fn, bucket signature): a bucket that has been
+analyzed once is never re-extracted (pinned by test), so steady-state
+training pays nothing. Every probe is wrapped in try/except — a backend
+without ``cost_analysis`` (or an opaque analysis shape) degrades to
+absent gauges, never a crash. Under ``DMLC_TPU_METRICS=0`` the hook
+returns immediately.
+
+The same records feed the model-based roofline: obs/goodput.py turns
+steps × per-step flops into an MFU verdict against
+``DMLC_TPU_PEAK_FLOPS`` / ``DMLC_TPU_PEAK_HBM_GBPS`` (or the measured
+:func:`probed_peak_flops` / :func:`probed_hbm_gbps` defaults), the
+``/xla`` status endpoint and ``obs-report --xla`` render the per-site
+tables, and bench's detail artifact carries the ``xla`` section plus
+``sgd_mfu`` (sentry-gated higher-is-better).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from dmlc_tpu.obs.metrics import Registry, metrics_enabled, registry
+
+logger = logging.getLogger("dmlc_tpu.obs.xla_cost")
+
+__all__ = [
+    "bucket_signature",
+    "collective_bytes_from_hlo",
+    "note_compile",
+    "extraction_count",
+    "records",
+    "per_fn",
+    "sites_from_flat",
+    "step_costs",
+    "detail_section",
+    "probed_peak_flops",
+    "probed_hbm_gbps",
+    "reset",
+]
+
+_lock = threading.Lock()
+# (fn, bucket signature) -> record; insertion-ordered, so per_fn() keeps
+# the LATEST bucket per site while counting all of them
+_records: Dict[Tuple[str, str], Dict[str, Any]] = {}
+_extractions = 0
+
+#: the gauge fields every record carries (and the flat-metric parser reads)
+FIELDS = ("flops", "bytes_accessed", "peak_bytes", "collective_bytes")
+
+
+def bucket_signature(args: tuple, kwargs: Optional[dict] = None) -> str:
+    """Shape/dtype signature of one call's argument tree — the cache key
+    half that distinguishes FixedShapePool buckets. Non-array leaves
+    contribute their type name only (their values do not retrace)."""
+    import jax
+
+    parts: List[str] = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs or {})):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            parts.append(type(leaf).__name__)
+        else:
+            parts.append(
+                "%s[%s]" % (dtype, ",".join(str(d) for d in shape)))
+    return ";".join(parts)
+
+
+# one collective *call site* per match: the op name must be applied
+# (trailing "(" ), so parameter/operand shape mentions don't count, and
+# async pairs count once — "-start" matches, "-done" cannot (the hyphen
+# is outside [\w.]).
+_COLL_CALL_RE = re.compile(
+    r"=\s*([^=]*?)\s*"
+    r"(?:all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?[\w.]*\(")
+_SHAPE_RE = re.compile(r"\b(pred|[a-z]+[0-9]+[a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _dtype_bytes(token: str) -> int:
+    if token == "pred":
+        return 1
+    m = re.search(r"(\d+)", token)
+    bits = int(m.group(1)) if m else 8
+    return max(1, bits // 8)
+
+
+def collective_bytes_from_hlo(hlo_text: str) -> float:
+    """Bytes produced by in-graph collective ops, summed over the result
+    shapes in one optimized-HLO module text. XLA's CPU ``cost_analysis``
+    carries no collective byte keys, so this is derived from the program
+    itself — the per-call ICI payload of an SPMD psum step."""
+    total = 0.0
+    for m in _COLL_CALL_RE.finditer(hlo_text):
+        for token, dims in _SHAPE_RE.findall(m.group(1)):
+            count = 1
+            for dim in dims.split(","):
+                if dim:
+                    count *= int(dim)
+            total += count * _dtype_bytes(token)
+    return total
+
+
+def _extract(jitted, args: tuple, kwargs: dict) -> Dict[str, float]:
+    """One executable's analytics, each probe independently best-effort."""
+    compiled = jitted.lower(*args, **kwargs).compile()
+    out = {field: 0.0 for field in FIELDS}
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            # older jax returns one dict per partition; they agree for
+            # SPMD programs, so the first speaks for the site
+            analysis = analysis[0] if analysis else {}
+        if isinstance(analysis, dict):
+            out["flops"] = max(0.0, float(analysis.get("flops", 0.0) or 0.0))
+            out["bytes_accessed"] = max(
+                0.0, float(analysis.get("bytes accessed", 0.0) or 0.0))
+    except Exception:
+        logger.debug("cost_analysis unavailable", exc_info=True)
+    try:
+        mem = compiled.memory_analysis()
+        peak = 0.0
+        for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            peak += float(getattr(mem, field, 0) or 0)
+        # donated buffers alias an argument onto an output: counted once
+        peak -= float(getattr(mem, "alias_size_in_bytes", 0) or 0)
+        out["peak_bytes"] = max(0.0, peak)
+    except Exception:
+        logger.debug("memory_analysis unavailable", exc_info=True)
+    try:
+        out["collective_bytes"] = collective_bytes_from_hlo(
+            compiled.as_text())
+    except Exception:
+        logger.debug("hlo text unavailable", exc_info=True)
+    return out
+
+
+def _set_gauges(fn_name: str, rec: Dict[str, Any],
+                reg: Optional[Registry] = None) -> None:
+    reg = reg if reg is not None else registry()
+    reg.gauge(
+        "dmlc_xla_flops",
+        "per-call FLOPs of the latest compiled bucket per jit site "
+        "(XLA cost_analysis)", fn=fn_name,
+    ).set(float(rec.get("flops", 0.0)))
+    reg.gauge(
+        "dmlc_xla_bytes_accessed",
+        "per-call memory traffic of the latest compiled bucket per jit "
+        "site (XLA cost_analysis 'bytes accessed')", fn=fn_name,
+    ).set(float(rec.get("bytes_accessed", 0.0)))
+    reg.gauge(
+        "dmlc_xla_peak_bytes",
+        "compiled-program peak bytes per jit site (memory_analysis: "
+        "argument+output+temp+code, donation aliases counted once)",
+        fn=fn_name,
+    ).set(float(rec.get("peak_bytes", 0.0)))
+    reg.gauge(
+        "dmlc_xla_collective_bytes",
+        "per-call bytes produced by in-graph collectives per jit site "
+        "(summed from the optimized HLO's all-reduce/all-gather/"
+        "reduce-scatter/collective-permute/all-to-all result shapes)",
+        fn=fn_name,
+    ).set(float(rec.get("collective_bytes", 0.0)))
+
+
+def note_compile(fn_name: str, jitted, args: tuple,
+                 kwargs: Optional[dict] = None,
+                 reg: Optional[Registry] = None) -> Optional[Dict[str, Any]]:
+    """Record one jit site's compiled-program analytics; the
+    InstrumentedJit compile-branch hook.
+
+    Runs only when a call actually compiled, and extracts at most once
+    per (fn, bucket signature) — a signature already analyzed returns
+    its cached record with no lowering, no compile, no gauge write.
+    Returns the record, or None when metrics are off or every probe
+    failed (absent gauges, never a crash)."""
+    if not metrics_enabled():
+        return None
+    kwargs = kwargs or {}
+    try:
+        key = (fn_name, bucket_signature(args, kwargs))
+    except Exception:
+        logger.debug("bucket signature failed for %s", fn_name,
+                     exc_info=True)
+        return None
+    with _lock:
+        rec = _records.get(key)
+    if rec is not None:
+        return rec
+    t0 = time.monotonic_ns()
+    try:
+        costs = _extract(jitted, args, kwargs)
+    except Exception as err:
+        logger.debug("xla cost extraction failed for %s: %s", fn_name, err)
+        return None
+    rec = dict(costs, fn=fn_name, bucket=key[1],
+               extract_ms=round((time.monotonic_ns() - t0) / 1e6, 3))
+    global _extractions
+    with _lock:
+        if key in _records:  # lost a race: first extraction already won
+            return _records[key]
+        _records[key] = rec
+        _extractions += 1
+    _set_gauges(fn_name, rec, reg)
+    return rec
+
+
+def extraction_count() -> int:
+    """Extractions actually performed this process (cache misses only) —
+    what the no-re-extract pin asserts against."""
+    with _lock:
+        return _extractions
+
+
+def records() -> List[Dict[str, Any]]:
+    """Every cached record, extraction order (one per fn × bucket)."""
+    with _lock:
+        return [dict(rec) for rec in _records.values()]
+
+
+def per_fn() -> Dict[str, Dict[str, Any]]:
+    """Latest record per jit site plus its bucket count — the ``/xla``
+    local view and bench's ``xla`` detail section rows."""
+    out: Dict[str, Dict[str, Any]] = {}
+    with _lock:
+        items = list(_records.items())
+    for (fn, _bucket), rec in items:
+        row = dict(rec)
+        row["buckets"] = out[fn]["buckets"] + 1 if fn in out else 1
+        out[fn] = row
+    return out
+
+
+_FLAT_XLA_RE = re.compile(
+    r'^(dmlc_xla_(?:flops|bytes_accessed|peak_bytes|collective_bytes))'
+    r'\{[^}]*?fn="((?:[^"\\]|\\.)*)"')
+
+
+def sites_from_flat(flat: Dict[str, float]) -> Dict[str, Dict[str, float]]:
+    """Per-site cost rows parsed back out of a flat registry snapshot —
+    how the tracker reads a *worker's* records off its heartbeat payload
+    (the gauges ride ``flat_values()`` like every other metric)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for key, value in flat.items():
+        m = _FLAT_XLA_RE.match(key)
+        if not m:
+            continue
+        name, fn = m.groups()
+        fn = fn.replace('\\"', '"').replace("\\\\", "\\")
+        out.setdefault(fn, {})[name[len("dmlc_xla_"):]] = float(value)
+    return out
+
+
+def step_costs(flat: Dict[str, float]) -> Dict[str, float]:
+    """The model train step's per-call flops/bytes from a flat snapshot:
+    the max across ``*.step`` / ``*.step_mp`` sites (the dominant bucket
+    of the hot step). Feeds goodput's window flop estimate
+    (steps × per-step flops) and the MFU verdict."""
+    out = {"flops": 0.0, "bytes": 0.0}
+    for fn, rec in sites_from_flat(flat).items():
+        if fn.rsplit(".", 1)[-1] not in ("step", "step_mp"):
+            continue
+        out["flops"] = max(out["flops"], rec.get("flops", 0.0))
+        out["bytes"] = max(out["bytes"], rec.get("bytes_accessed", 0.0))
+    return out
+
+
+def detail_section() -> Dict[str, Any]:
+    """The ``xla`` block for bench's detail artifact and the ``/xla``
+    endpoint's local half: per-site latest records + extraction count."""
+    return {"sites": per_fn(), "extractions": extraction_count()}
+
+
+# ---------------------------------------------------------------------------
+# measured peaks: the auto-probed defaults behind DMLC_TPU_PEAK_FLOPS /
+# DMLC_TPU_PEAK_HBM_GBPS (knob > 0 wins; these run once per process,
+# lazily, only when a model-based verdict is actually requested)
+# ---------------------------------------------------------------------------
+
+_probe_lock = threading.Lock()
+_peak_flops_probe: Optional[float] = None
+_hbm_gbps_probe: Optional[float] = None
+
+
+def _best_seconds(fn, arg, repeats: int = 3) -> float:
+    import jax
+
+    jax.block_until_ready(fn(arg))  # compile + warm outside the timing
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(arg))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def probed_peak_flops() -> float:
+    """Measured matmul FLOP rate (FLOP/s), probed once per process: a
+    256×256 f32 matmul timed best-of-3. A *measured* ceiling, so MFU
+    against it reads as "fraction of what this backend demonstrably
+    sustains"; 0.0 when the probe fails (MFU then stays absent)."""
+    global _peak_flops_probe
+    with _probe_lock:
+        if _peak_flops_probe is not None:
+            return _peak_flops_probe
+    val = 0.0
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n = 256
+        a = jnp.ones((n, n), jnp.float32)
+        best = _best_seconds(jax.jit(lambda x: x @ x), a)
+        if best > 0:
+            val = 2.0 * n ** 3 / best
+    except Exception:
+        logger.debug("peak-flops probe failed", exc_info=True)
+    with _probe_lock:
+        if _peak_flops_probe is None:
+            _peak_flops_probe = val
+        return _peak_flops_probe
+
+
+def probed_hbm_gbps() -> float:
+    """Measured device memory bandwidth (GB/s), probed once per process:
+    a 32 MiB f32 element-wise pass (read + write) timed best-of-3; 0.0
+    when the probe fails (the HBM fraction then stays absent)."""
+    global _hbm_gbps_probe
+    with _probe_lock:
+        if _hbm_gbps_probe is not None:
+            return _hbm_gbps_probe
+    val = 0.0
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones(8 * 1024 * 1024, jnp.float32)  # 32 MiB
+        best = _best_seconds(jax.jit(lambda v: v * 1.0000001), x)
+        if best > 0:
+            val = 2.0 * x.size * 4 / best / 1e9
+    except Exception:
+        logger.debug("hbm-bandwidth probe failed", exc_info=True)
+    with _probe_lock:
+        if _hbm_gbps_probe is None:
+            _hbm_gbps_probe = val
+        return _hbm_gbps_probe
+
+
+def reset() -> None:
+    """Forget process-level state (tests): records, the extraction
+    counter, and both measured-peak probes."""
+    global _extractions, _peak_flops_probe, _hbm_gbps_probe
+    with _lock:
+        _records.clear()
+        _extractions = 0
+    with _probe_lock:
+        _peak_flops_probe = None
+        _hbm_gbps_probe = None

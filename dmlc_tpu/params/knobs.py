@@ -188,6 +188,19 @@ add five more:
   (default 45; the same figure bench_collective.py scores utilization
   against)
 
+The compiled-step cost attribution layer (obs/xla_cost.py, see
+docs/observability.md "Compiled-step cost attribution") adds three
+more:
+
+- ``DMLC_TPU_STEP_SAMPLE_N`` — device-step latency sampling stride:
+  every N-th step gets a ``block_until_ready`` and a
+  ``dmlc_step_device_ms`` observation (default 64; 0 = never)
+- ``DMLC_TPU_PEAK_FLOPS`` — model-based roofline peak in FLOP/s for the
+  MFU verdict (default 0 = use the measured matmul probe)
+- ``DMLC_TPU_PEAK_HBM_GBPS`` — model-based memory-bandwidth peak in
+  GB/s for the achieved-HBM-fraction verdict (default 0 = use the
+  measured streaming probe)
+
 Baked columnar shards (io/shard.py + tools/bake.py, see
 docs/pipeline.md "Baked shards & global shuffle") add three more:
 
@@ -529,6 +542,32 @@ def ici_peak_gbps() -> float:
     return max(0.0, float(get_env("DMLC_TPU_ICI_PEAK_GBPS", 45.0)))
 
 
+def step_sample_n() -> int:
+    """Device-step latency sampling stride (``DMLC_TPU_STEP_SAMPLE_N``,
+    default 64, floor 0 = never sample): every N-th step the fit loop
+    adds one ``block_until_ready`` around the step output and records
+    ``dmlc_step_device_ms`` — the other N−1 steps dispatch async with no
+    added sync. Read once per fit, at FitLoopObs construction."""
+    return max(0, int(get_env("DMLC_TPU_STEP_SAMPLE_N", 64)))
+
+
+def peak_flops() -> float:
+    """Model-based roofline peak in FLOP/s (``DMLC_TPU_PEAK_FLOPS``,
+    default 0 = auto: the measured matmul probe
+    ``obs.xla_cost.probed_peak_flops`` stands in). The MFU verdict is
+    window FLOPs (steps × per-step XLA flops) over this ceiling."""
+    return max(0.0, float(get_env("DMLC_TPU_PEAK_FLOPS", 0.0)))
+
+
+def peak_hbm_gbps() -> float:
+    """Model-based device-memory-bandwidth peak in GB/s
+    (``DMLC_TPU_PEAK_HBM_GBPS``, default 0 = auto: the measured
+    streaming probe ``obs.xla_cost.probed_hbm_gbps`` stands in). The
+    achieved-HBM-fraction verdict is window bytes accessed over this
+    ceiling."""
+    return max(0.0, float(get_env("DMLC_TPU_PEAK_HBM_GBPS", 0.0)))
+
+
 def audit_mode() -> str:
     """Determinism-audit ledger mode (``DMLC_TPU_AUDIT``): ``full``
     (aliases ``1``/``on``) digests every chunk, parsed block, emitted
@@ -677,6 +716,9 @@ KNOWN_KNOBS = (
     "DMLC_TPU_PARSE_PEAK_MBPS",
     "DMLC_TPU_STEP_PEAK_MBPS",
     "DMLC_TPU_ICI_PEAK_GBPS",
+    "DMLC_TPU_STEP_SAMPLE_N",
+    "DMLC_TPU_PEAK_FLOPS",
+    "DMLC_TPU_PEAK_HBM_GBPS",
     # collective / distributed bootstrap
     "DMLC_TPU_COLLECTIVE",
     "DMLC_TPU_RECOVER_TIMEOUT",

@@ -22,6 +22,10 @@ Renders a human-readable summary of a job's observability artifacts:
   the per-rank + job-rolled stage-budget/roofline attribution tables
   (obs/goodput.py — the same code path the bench detail record and
   obs-top's goodput column use), binding constraint flagged per window.
+- ``--xla`` — with ``--status``: fetch ``/xla`` and render the per-rank
+  per-jit-site compiled-program cost tables (flops, bytes accessed,
+  peak program bytes, in-graph collective bytes — obs/xla_cost.py's
+  compile-time records).
 - ``--audit`` — with ``--status``: fetch ``/audit`` and render the
   determinism audit plane's per-rank digest-chain summary + fork table
   (obs/audit.py — the same view ``audit-report --status`` renders);
@@ -341,6 +345,35 @@ def _report_device(metrics_text: str) -> bool:
     return True
 
 
+def _report_xla(xla_obj: Dict) -> bool:
+    """The ``/xla`` endpoint rendered: one per-jit-site compiled-program
+    cost table per reporting rank (flops, bytes accessed, peak program
+    bytes, in-graph collective bytes — obs/xla_cost.py), plus the
+    serving process's local record cache when it has one."""
+    def _table(label: str, sites: Dict[str, Dict]) -> None:
+        print(f"{label}:")
+        print(f"{'fn':<28} {'flops':>12} {'bytes_acc':>12} "
+              f"{'peak_MB':>8} {'coll_B':>10}")
+        for fn in sorted(sites):
+            rec = sites[fn] or {}
+            print(f"{fn:<28} {rec.get('flops', 0.0):>12.3g} "
+                  f"{rec.get('bytes_accessed', 0.0):>12.3g} "
+                  f"{rec.get('peak_bytes', 0.0) / 1e6:>8.1f} "
+                  f"{rec.get('collective_bytes', 0.0):>10.3g}")
+
+    ranks = xla_obj.get("ranks") or {}
+    local = (xla_obj.get("local") or {}).get("sites") or {}
+    if not ranks and not local:
+        print("== xla cost: no compiled sites reported yet ==")
+        return False
+    print("== xla cost attribution ==")
+    for rank in sorted(ranks, key=lambda r: int(r)):
+        _table(f"rank {rank}", ranks[rank])
+    if local:
+        _table("local", local)
+    return True
+
+
 def _report_attribution(goodput_obj: Dict) -> bool:
     """The ``/goodput`` endpoint rendered: one stage-budget/roofline
     table per reporting rank plus the job-rolled view, through the one
@@ -387,9 +420,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="Render the determinism audit plane: /audit "
                         "with --status, else audit-rank*.json bundles "
                         "under --flightrec (or the cwd).")
+    parser.add_argument("--xla", action="store_true",
+                        help="With --status: render the /xla per-site "
+                        "compiled-program cost tables (flops, bytes, "
+                        "peak memory, in-graph collective bytes).")
     args = parser.parse_args(argv)
-    if (args.top or args.attribution) and not args.status:
-        print("obs-report: --top/--attribution need --status",
+    if (args.top or args.attribution or args.xla) and not args.status:
+        print("obs-report: --top/--attribution/--xla need --status",
               file=sys.stderr)
         return 2
     reported = False
@@ -415,6 +452,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             goodput_obj = _fetch(args.status, "/goodput")
             if goodput_obj is not None:
                 reported = _report_attribution(goodput_obj) or reported
+        if args.xla:
+            xla_obj = _fetch(args.status, "/xla")
+            if xla_obj is not None:
+                reported = _report_xla(xla_obj) or reported
         if args.audit:
             audit_obj = _fetch(args.status, "/audit")
             if audit_obj is not None:

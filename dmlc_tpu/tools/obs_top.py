@@ -15,6 +15,9 @@ XLA compile counts, and the straggler flag — refreshing in place.
   samples exist (the histogram mean seeds the first frame).
 - ``--once``: print a single frame and exit — the CI smoke and what
   ``obs-report --top`` renders as the non-live fallback.
+- conditional columns (job, goodput/binding, mfu, audit) appear only
+  when the tracker reports them — a frame without them stays
+  byte-identical to the older layouts.
 
 Stdlib only (urllib + the text parser below), like obs-report: the tool
 must run on a machine with nothing but the checkout.
@@ -180,6 +183,7 @@ def build_rows(
             "recompiles": int(recompiles.get(rank, 0)),
             "goodput_ratio": gp.get("ratio"),
             "binding": att.get("binding"),
+            "mfu": att.get("mfu"),
             "audit_n": audit_n,
             "audit_diverged": audit_diverged,
         })
@@ -204,13 +208,18 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
     # audit plane has chains for some rank, so a no-audit frame keeps
     # the exact pre-audit byte layout
     with_audit = any(r.get("audit_n") is not None for r in rows)
+    # and for the mfu column: a window that carried no model-based
+    # verdict (no compiled hot step analyzed yet, or no peak) keeps the
+    # pre-mfu byte layout
+    with_mfu = any(r.get("mfu") is not None for r in rows)
     job_hdr = f"{'job':>10} " if with_jobs else ""
     gp_hdr = f"{'goodput':>7} {'binding':>11} " if with_goodput else ""
+    mfu_hdr = f"{'mfu':>5} " if with_mfu else ""
     audit_hdr = f"{'audit':>7} " if with_audit else ""
     lines.append(
         f"{'rank':>4} {job_hdr}{'epoch':>6} {'lag_s':>7} {'step_ms':>8} "
         f"{'h2d_MBps':>9} {'hbm_MB':>8} {'compiles':>8} {'recomp':>6} "
-        f"{gp_hdr}{audit_hdr} flag")
+        f"{gp_hdr}{mfu_hdr}{audit_hdr} flag")
     if not rows:
         lines.append("(no ranks reporting yet)")
     for r in rows:
@@ -224,6 +233,12 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
             gp_col = f"{gp:>7} {(r.get('binding') or '-'):>11} "
         else:
             gp_col = ""
+        if with_mfu:
+            mfu = r.get("mfu")
+            mfu_cell = f"{mfu * 100.0:.0f}%" if mfu is not None else "-"
+            mfu_col = f"{mfu_cell:>5} "
+        else:
+            mfu_col = ""
         if with_audit:
             if r.get("audit_diverged"):
                 audit_cell = "FORK"
@@ -239,7 +254,7 @@ def render_table(rows: List[Dict], world_version: Optional[int] = None) -> str:
             f"{r['step_ms']:>8.1f} "
             f"{r['h2d_mbps']:>9.1f} {r['hbm_mb']:>8.1f} "
             f"{r['compiles']:>8d} {r['recompiles']:>6d} "
-            f"{gp_col}{audit_col} {flag}")
+            f"{gp_col}{mfu_col}{audit_col} {flag}")
     return "\n".join(lines)
 
 

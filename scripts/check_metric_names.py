@@ -24,7 +24,11 @@ ROOT = pathlib.Path(__file__).resolve().parents[1]
 DOC = ROOT / "docs" / "observability.md"
 
 UNITS = {"total", "ns", "bytes", "rows", "value", "count", "rank", "version",
-         "mbps"}
+         "mbps",
+         # compiled-step cost attribution (obs/xla_cost.py + goodput MFU):
+         # per-call FLOPs, "bytes accessed" (XLA cost_analysis's own key,
+         # kept verbatim), a 0..1 utilization ratio, sampled milliseconds
+         "flops", "accessed", "ratio", "ms"}
 
 # ".counter(" / ".gauge(" / ".histogram(" followed by a string literal —
 # matches across the line break of a wrapped call
@@ -35,7 +39,8 @@ CALL_RE = re.compile(
 # read as metric names
 DOC_NAME_RE = re.compile(
     r"`(dmlc_[a-z0-9_]+_"
-    r"(?:total|ns|bytes|rows|value|count|rank|version|mbps))"
+    r"(?:total|ns|bytes|rows|value|count|rank|version|mbps"
+    r"|flops|accessed|ratio|ms))"
 )
 
 

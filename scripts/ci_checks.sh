@@ -904,4 +904,88 @@ print("ci_checks: preemption smoke OK (2-proc SIGTERM -> exit-75 "
       "divergences)")
 EOF
 
+# MFU smoke: a short CPU linear fit with device telemetry on must leave
+# compiled-program analytics behind — /xla serves nonzero flops for
+# linear.step, the bench-detail assembly (same goodput.attribute path)
+# carries a gateable sgd_mfu, and the extraction's second lowering must
+# not show up as a post-warmup recompile. bench-gate --smoke already ran
+# above, so a regressing sgd_mfu fails this script either way.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" DMLC_TPU_PEAK_FLOPS=1e6 \
+python - <<'EOF'
+import json, os, shutil, sys, tempfile, time, urllib.request
+
+import numpy as np
+
+import bench
+from dmlc_tpu import obs
+from dmlc_tpu.models import LinearLearner
+from dmlc_tpu.obs import device_telemetry as dt
+from dmlc_tpu.obs import goodput, plane, xla_cost
+
+NF, ROWS = 12, 400
+rng = np.random.RandomState(7)
+workdir = tempfile.mkdtemp(prefix="dmlc_mfu_smoke_")
+svm = os.path.join(workdir, "m.svm")
+with open(svm, "w") as fh:
+    for i in range(ROWS):
+        ids = np.sort(rng.choice(NF, size=1 + i % 4, replace=False))
+        fh.write("%d %s\n" % (i % 2, " ".join(
+            "%d:%.4f" % (j, rng.rand()) for j in ids)))
+
+dt.reset()
+t0 = time.time()
+learner = LinearLearner(objective="logistic", learning_rate=0.1,
+                        num_features=NF)
+list(learner.fit_uri(svm, batch_size=64, epochs=1, num_features=NF))
+warm = dict(dt.compile_counts())
+list(learner.fit_uri(svm, batch_size=64, epochs=2, num_features=NF))
+wall = max(time.time() - t0, 1e-9)
+if dict(dt.compile_counts()) != warm:
+    sys.exit("ci_checks: mfu smoke recompiled past warmup: %r -> %r"
+             % (warm, dt.compile_counts()))
+
+reg = obs.registry()
+flat = reg.flat_values()
+if flat.get('dmlc_xla_recompiles_total{fn="linear.step"}', 0.0):
+    sys.exit("ci_checks: mfu smoke tripped the recompile sentinel")
+sites = xla_cost.sites_from_flat(flat)
+if sites.get("linear.step", {}).get("flops", 0.0) <= 0.0:
+    sys.exit("ci_checks: no analyzed linear.step in the registry: %r"
+             % sorted(sites))
+
+# the /xla endpoint end to end, fed by the worker's own payload blob
+sp = plane.StatusPlane(num_workers=1)
+blob, _ = plane.build_payload(rank=0, epoch=2, reg=reg)
+sp.note_payload(0, json.loads(blob), time.time_ns())
+srv = plane.StatusServer(sp, port=0)
+srv.start()
+try:
+    url = "http://127.0.0.1:%d/xla" % srv.port
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        body = json.loads(resp.read())
+finally:
+    srv.close()
+served = body.get("ranks", {}).get("0", {}).get("linear.step", {})
+if served.get("flops", 0.0) <= 0.0:
+    sys.exit("ci_checks: /xla served no linear.step flops: %r" % body)
+
+# the bench-detail assembly: same attribute() call bench.py makes,
+# against the tiny DMLC_TPU_PEAK_FLOPS ceiling set for this smoke
+extra = {"xla": xla_cost.detail_section()}
+att = goodput.attribute(flat, wall, current=flat)
+if att.get("mfu") is not None:
+    extra["sgd_mfu"] = att["mfu"]
+if not extra["xla"]["sites"].get("linear.step"):
+    sys.exit("ci_checks: bench detail xla section lost linear.step")
+if extra.get("sgd_mfu", 0.0) <= 0.0:
+    sys.exit("ci_checks: bench detail carries no sgd_mfu (att=%r)"
+             % {k: att.get(k) for k in ("mfu", "compute", "counters")})
+if bench.BENCH_DIRECTIONS.get("sgd_mfu") != "higher":
+    sys.exit("ci_checks: sgd_mfu is not gated higher-is-better")
+shutil.rmtree(workdir, ignore_errors=True)
+print("ci_checks: mfu smoke OK (/xla serves linear.step flops; "
+      "sgd_mfu %.4f rides the detail record; 0 post-warmup recompiles)"
+      % extra["sgd_mfu"])
+EOF
+
 echo "ci_checks: all checks passed"
